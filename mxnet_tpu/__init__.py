@@ -1,0 +1,63 @@
+"""mxnet_tpu — a TPU-native deep learning framework with Apache MXNet 2.0's
+capabilities.
+
+This is NOT a port of MXNet: the compute path is JAX/XLA (eager dispatch +
+``hybridize()``-to-``jax.jit`` tracing), parallelism is ``jax.sharding`` meshes
+with XLA collectives over ICI/DCN, and hot kernels are Pallas. The *API surface*
+mirrors MXNet (reference: ``python/mxnet/__init__.py`` of apache/incubator-mxnet
+2.0) so that Gluon user code carries over:
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd, np, npx
+
+Layer map vs the reference (see SURVEY.md):
+  - MXNet ThreadedEngine (src/engine/)      -> PJRT async dispatch (jax arrays
+    are futures; ``wait_to_read`` = block_until_ready)
+  - NDArray/Chunk/Storage (src/ndarray/)    -> ndarray over jax.Array (+sharding)
+  - deferred-compute trace -> CachedOp      -> trace -> jax.jit executable cache
+  - KVStore (src/kvstore/)                  -> XLA collectives on a device mesh
+  - src/operator/** kernels                 -> jnp/lax lowering + Pallas kernels
+"""
+
+__version__ = "2.0.0a1"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, device, num_gpus, num_tpus
+from . import engine
+from . import numpy as np  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import kvstore
+from .kvstore import KVStore
+from . import gluon
+from . import parallel
+from . import amp
+from . import profiler
+from . import util
+from . import runtime
+from . import library
+from . import test_utils
+from . import recordio
+from . import io
+from . import image
+
+kv = kvstore
+
+
+def waitall():
+    """Block until all pending device computation is done.
+
+    Reference parity: ``mx.nd.waitall`` / ``Engine::WaitForAll``
+    (include/mxnet/engine.h:255). On TPU, pending work is the set of
+    undelivered jax.Arrays; the engine module tracks live arrays.
+    """
+    engine.wait_all()

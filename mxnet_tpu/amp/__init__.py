@@ -1,0 +1,100 @@
+"""mx.amp — automatic mixed precision.
+
+Reference parity: python/mxnet/amp/ (op-list driven cast insertion at the
+python wrapper level amp.py:105-246, fp16/bf16 lists, convert_hybrid_block
+via the ReducePrecision NNVM pass src/nnvm/low_precision_pass.cc, dynamic
+LossScaler amp/loss_scaler.py:26-60).
+
+TPU-native design: bf16 is the native matmul dtype; "init" installs a dtype
+policy that casts inputs of MXU ops (dot/conv/attention) to the target dtype
+at dispatch time — the wrapper-level cast strategy of the reference, applied
+in _invoke. convert_hybrid_block casts parameters (XLA then propagates).
+bf16 needs no loss scaling; the LossScaler is kept for fp16 parity.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .loss_scaler import LossScaler  # noqa: F401
+from . import lists  # noqa: F401
+
+_state = threading.local()
+
+# ops that should run in low precision (the FP16_FUNCS analog): MXU ops
+_WIDEST = ("matmul", "dot", "einsum", "convolution", "fully_connected",
+           "multi_head_attention", "interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt", "batch_dot", "tensordot")
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Install the global dtype policy (reference: amp.init)."""
+    _state.dtype = np_dtype(target_dtype)
+    _state.active = True
+
+
+def is_active():
+    return getattr(_state, "active", False)
+
+
+def target_dtype():
+    return getattr(_state, "dtype", jnp.bfloat16)
+
+
+def _maybe_cast_op_inputs(name, raws):
+    """Called by the dispatcher for low-precision-listed ops."""
+    if not is_active() or name not in _WIDEST:
+        return raws
+    dt = target_dtype()
+    return [r.astype(dt) if hasattr(r, "dtype")
+            and jnp.issubdtype(r.dtype, jnp.floating) else r for r in raws]
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None,
+                         cast_params_offline=True, **kwargs):
+    """Cast a block's parameters to the target dtype (reference:
+    amp.convert_hybrid_block over low_precision_pass.cc). BatchNorm
+    gamma/beta/stats stay fp32 (the AMPInferUnknown behavior)."""
+    dt = np_dtype(target_dtype)
+    for name, p in block.collect_params().items():
+        if name.endswith(("gamma", "beta", "running_mean", "running_var")):
+            continue
+        p.cast(dt)
+    return block
+
+
+def convert_symbol(sym, **kwargs):
+    raise NotImplementedError(
+        "legacy symbol AMP conversion: use convert_hybrid_block")
+
+
+def scale_loss(loss, trainer):
+    """Context helper (reference: amp.scale_loss): scales loss up; trainer
+    step is adjusted by the scaler."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            scaler = LossScaler()
+            trainer._amp_loss_scaler = scaler
+        if isinstance(loss, (list, tuple)):
+            yield [l * scaler.loss_scale for l in loss]
+        else:
+            yield loss * scaler.loss_scale
+    return _scope()
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data is not None:
+            g = p.grad()
+            g._rebind(g._data * inv)

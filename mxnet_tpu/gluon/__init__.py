@@ -1,0 +1,12 @@
+"""mx.gluon (reference: python/mxnet/gluon/__init__.py)."""
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import Parameter, Constant, DeferredInitializationError  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+from . import metric  # noqa: F401
+from . import rnn  # noqa: F401
+from . import data  # noqa: F401
+from . import model_zoo  # noqa: F401
+from . import contrib  # noqa: F401

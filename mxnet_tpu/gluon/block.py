@@ -1,0 +1,486 @@
+"""gluon.Block / HybridBlock.
+
+Reference parity: python/mxnet/gluon/block.py (Block :202, HybridBlock :997,
+SymbolBlock :1638). The reference traces a hybridized block with deferred
+compute into an NNVM graph and replays it through CachedOp
+(src/imperative/cached_op.cc); shape-specialized re-planning happens in
+SetForwardGraph (cached_op.cc:169).
+
+TPU-native design: ``hybridize()`` makes ``__call__`` run the user's
+``forward`` inside ``jax.jit`` — the trace *is* the graph, XLA does memory
+planning/fusion, and the executable cache keyed by input shapes/dtypes is the
+CachedOp shape-signature cache. Mutable aux state (BatchNorm running stats)
+is handled functionally: the traced function returns the set of parameters it
+mutated, and the wrapper writes them back — the analog of CachedOp's mutable
+input handling. Under ``autograd.record()`` the whole compiled forward is one
+tape node (reference: CachedOp registers itself as one ``_CachedOp`` tape
+node, cached_op.cc:968,1276).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError
+from ..numpy.multiarray import ndarray, _wrap
+from .parameter import Parameter, DeferredInitializationError
+from .. import random as _random
+
+
+def _is_nd(x):
+    return isinstance(x, ndarray)
+
+
+def _flatten_args(args):
+    leaves, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
+    return leaves, treedef
+
+
+class Block:
+    """Base neural-network container (reference: gluon/block.py:202).
+
+    Child blocks and Parameters are discovered through attribute assignment,
+    MXNet-2.0-style (no name_scope); structural names are attribute paths.
+    """
+
+    def __init__(self, prefix=None, params=None):
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            existing = self.__dict__.get("_reg_params")
+            if existing is not None:
+                existing[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- parameter management ----------------------------------------------
+    def collect_params(self, select=None):
+        """dict structural-name -> Parameter (reference: block.py
+        collect_params; select is a regex like '.*weight')."""
+        out = {}
+        self._collect_params(out, "")
+        if select is not None:
+            pattern = re.compile(select)
+            out = {k: v for k, v in out.items() if pattern.match(k)}
+        return out
+
+    def _collect_params(self, out, prefix):
+        for name, p in self._reg_params.items():
+            full = f"{prefix}{name}"
+            p._structure_name = full
+            out[full] = p
+        for cname, child in self._children.items():
+            child._collect_params(out, f"{prefix}{cname}.")
+
+    @property
+    def params(self):
+        return dict(self._reg_params)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False, device=None):
+        """Initialize all parameters (reference: block.py initialize)."""
+        for p in self.collect_params().values():
+            p.initialize(init=p.init, ctx=device if device is not None else ctx,
+                         default_init=init, force_reinit=force_reinit)
+        return self
+
+    def setattr(self, name, value):
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def cast(self, dtype):
+        """Cast parameters (+ future inputs) to dtype (reference: block.py
+        cast; the AMP bf16 path uses this)."""
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        self._dtype = dtype
+        return self
+
+    def reset_ctx(self, ctx):
+        for p in self.collect_params().values():
+            p.reset_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            p.zero_grad()
+
+    def share_parameters(self, shared):
+        """Reference: block.py share_parameters (dict name->Parameter)."""
+        mine = self.collect_params()
+        for name, p in shared.items():
+            if name in mine:
+                self._set_param_by_path(name, p)
+        return self
+
+    def _set_param_by_path(self, path, p):
+        parts = path.split(".")
+        obj = self
+        for part in parts[:-1]:
+            obj = obj._children[part] if part in obj._children else getattr(obj, part)
+        setattr(obj, parts[-1], p)
+
+    # -- save / load -------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        """npz of structural-name -> value (reference: block.py:340 over
+        src/serialization/cnpy.cc)."""
+        import numpy as onp
+        params = self.collect_params()
+        arrays = {}
+        for name, p in params.items():
+            if p._data is not None:
+                arrays[name] = p.data().asnumpy()
+        onp.savez(filename, **arrays)
+        if not filename.endswith(".npz") and not os.path.exists(filename):
+            os.rename(filename + ".npz", filename)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current", device=None):
+        """Reference: block.py:378."""
+        import numpy as onp
+        from ..numpy import array
+        path = filename if os.path.exists(filename) else filename + ".npz"
+        with onp.load(path, allow_pickle=False) as data:
+            loaded = {k: data[k] for k in data.files}
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(array(loaded[name]))
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing in {filename}")
+        extra = set(loaded) - set(params)
+        if extra and not ignore_extra:
+            raise MXNetError(f"file {filename} has extra parameters {sorted(extra)}")
+        if ctx is not None or device is not None:
+            self.reset_ctx(device if device is not None else ctx)
+
+    def save(self, prefix):
+        """Structural checkpoint (reference: block.py:576)."""
+        self.save_parameters(prefix + "-model.params")
+
+    def load(self, prefix):
+        self.load_parameters(prefix + "-model.params")
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        """No-op on plain Blocks except recursing into children (reference:
+        block.py Block.hybridize)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        params = self.collect_params()
+        lines = [f"{type(self).__name__}:"]
+        total = 0
+        for name, p in params.items():
+            n = 1
+            for s in (p.shape or ()):
+                n *= max(s, 0)
+            total += n
+            lines.append(f"  {name:60s} {str(p.shape):20s} {n}")
+        lines.append(f"Total params: {total}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            s += f"\n  ({name}): {repr(child)}"
+        return s + ("\n)" if self._children else ")")
+
+
+class _CachedGraph:
+    """Compiled forward for one (block, train_mode): the CachedOp analog.
+
+    One jax.jit'd pure function; XLA's executable cache keyed on input
+    shapes/dtypes replaces CachedOp::SetForwardGraph shape re-planning.
+    """
+
+    def __init__(self, block, train_mode):
+        self.block = block
+        self.train_mode = train_mode
+        params = block.collect_params()
+        self.param_names = [n for n, p in params.items() if p._data is not None]
+        self.params = {n: params[n] for n in self.param_names}
+        self.trainable = [n for n in self.param_names
+                          if self.params[n].grad_req != "null"]
+        self.aux = [n for n in self.param_names
+                    if self.params[n].grad_req == "null"]
+        self._jit = jax.jit(self._pure, static_argnames=("sig_key",))
+        self._signatures = {}  # sig_key -> (treedef, static_leaves)
+        self._out_trees = {}   # sig_key -> output treedef (set at trace time)
+
+    def _pure(self, trainable_raws, aux_raws, input_raws, rng_key, sig_key):
+        treedef, static_leaves = self._signatures[sig_key]
+        saved = {}
+        try:
+            for n in self.param_names:
+                p = self.params[n]
+                saved[n] = p._data._data
+                p._data._data = (trainable_raws[n] if n in trainable_raws
+                                 else aux_raws[n])
+            markers = {n: self.params[n]._data._data for n in self.aux}
+            leaves = list(static_leaves)
+            it = iter(input_raws)
+            for i, l in enumerate(leaves):
+                if l is _ARR:
+                    leaves[i] = _wrap(next(it))
+            args = jax.tree_util.tree_unflatten(treedef, leaves)
+            with autograd._RecordingStateScope(False, self.train_mode), \
+                    _random.trace_key_scope(rng_key):
+                out = self.block.forward(*args)
+            out_leaves, out_tree = _flatten_args(out)
+            out_raws = [l._data if _is_nd(l) else l for l in out_leaves]
+            self._out_trees[sig_key] = out_tree  # trace-time side channel
+            mutated = {n: self.params[n]._data._data for n in self.aux
+                       if self.params[n]._data._data is not markers[n]}
+            return out_raws, mutated
+        finally:
+            for n, raw in saved.items():
+                self.params[n]._data._data = raw
+
+    def __call__(self, args):
+        leaves, treedef = _flatten_args(args)
+        input_raws, static_leaves = [], []
+        for l in leaves:
+            if _is_nd(l):
+                input_raws.append(l._data)
+                static_leaves.append(_ARR)
+            else:
+                static_leaves.append(l)
+        sig = (str(treedef),
+               tuple("A" if l is _ARR else repr(l) for l in static_leaves),
+               tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
+        sig_key = hash(sig)
+        self._signatures[sig_key] = (treedef, static_leaves)
+
+        rng = _random._next_key()
+        trainable_raws = {n: self.params[n]._data._data for n in self.trainable}
+        aux_raws = {n: self.params[n]._data._data for n in self.aux}
+
+        nd_leaves = [l for l in leaves if _is_nd(l)]
+        arr_inputs = [l for l in nd_leaves
+                      if jnp.issubdtype(l.dtype, jnp.inexact)]
+        param_arrays = [self.params[n]._data for n in self.trainable]
+        recording = autograd.is_recording() and (
+            any(a._entry is not None for a in arr_inputs)
+            or any(a._entry is not None for a in param_arrays))
+
+        if recording:
+            diff_input_raws = [l._data for l in arr_inputs]
+
+            def fn(tr, diff_inp):
+                raws, di = list(input_raws), 0
+                for i, l in enumerate(nd_leaves):
+                    if jnp.issubdtype(l.dtype, jnp.inexact):
+                        raws[i] = diff_inp[di]
+                        di += 1
+                return self._jit(tr, aux_raws, raws, rng, sig_key=sig_key)
+
+            (out_raws, mutated), vjp_fn = jax.vjp(
+                fn, trainable_raws, diff_input_raws)
+        else:
+            out_raws, mutated = self._jit(
+                trainable_raws, aux_raws, input_raws, rng, sig_key=sig_key)
+
+        # write back mutated aux state (BatchNorm running stats etc.) — the
+        # analog of CachedOp mutable inputs
+        for n, raw in mutated.items():
+            self.params[n]._data._rebind(raw)
+
+        out_wrapped = [_wrap(r) for r in out_raws]
+        out = jax.tree_util.tree_unflatten(self._out_trees[sig_key], out_wrapped)
+
+        if recording:
+            mut_shapes = {n: (raw.shape, raw.dtype) for n, raw in mutated.items()}
+            trainable_names = list(self.trainable)
+
+            def node_vjp(cots, _vjp=vjp_fn):
+                cots = cots if isinstance(cots, tuple) else (cots,)
+                mut_zeros = {n: jnp.zeros(s, d) for n, (s, d) in mut_shapes.items()}
+                tr_cots, inp_cots = _vjp((list(cots), mut_zeros))
+                return tuple(tr_cots[n] for n in trainable_names) + tuple(inp_cots)
+
+            autograd._record_op(node_vjp, param_arrays + arr_inputs,
+                                out_wrapped,
+                                f"CachedOp:{type(self.block).__name__}")
+        return out
+
+
+class _ArrSentinel:
+    pass
+
+
+_ARR = _ArrSentinel()
+
+
+def _hashable(x):
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+class HybridBlock(Block):
+    """Traceable block (reference: gluon/block.py:997)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_graphs = {}
+        self._flags = {}
+        self._backend = None
+
+    def hybridize(self, active=True, backend=None, backend_opts=None,
+                  clear=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        """Reference: block.py hybridize. static_alloc/static_shape map to
+        XLA buffer donation/compiled executables — both are automatic here;
+        the flags are accepted for compatibility."""
+        self._active = active
+        self._backend = backend
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        if clear:
+            self._cached_graphs = {}
+        super().hybridize(active, backend=backend, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Reference: block.py optimize_for — compiles for a backend then
+        runs once. XLA is the only backend; equivalent to hybridize+call."""
+        self.hybridize(True, backend=backend, clear=clear, **kwargs)
+        return self(x, *args)
+
+    def _ensure_init(self, *args):
+        """Run deferred shape inference by executing forward eagerly once."""
+        params = self.collect_params()
+        pending = [p for p in params.values()
+                   if p._data is None and p._deferred_init is not None]
+        uninit = [p for p in params.values()
+                  if p._data is None and p._deferred_init is None]
+        if uninit:
+            raise MXNetError(
+                f"parameters {[p.name for p in uninit]} not initialized; "
+                "call .initialize()")
+        return bool(pending)
+
+    def __call__(self, *args, **kwargs):
+        if not self._active:
+            return super().__call__(*args, **kwargs)
+        if kwargs:
+            return super().__call__(*args, **kwargs)
+        if self._ensure_init(*args):
+            # first call: eager, triggers deferred init (the reference's
+            # _build_cache also runs a traced forward first, block.py:1095)
+            return super().__call__(*args)
+        key = self._train_key()
+        graph = self._cached_graphs.get(key)
+        if graph is None:
+            graph = _CachedGraph(self, key)
+            self._cached_graphs[key] = graph
+        return graph(args)
+
+    @staticmethod
+    def _train_key():
+        return autograd.is_training()
+
+    # -- export (reference: block.py:1471 export to json+params) -----------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Save compiled-model artifacts: params npz + a model config json.
+
+        The reference writes NNVM json; the graph here is the traced jax
+        program, so we persist the block class path + params. StableHLO
+        export lives in mxnet_tpu.onnx / compiled-artifact tooling.
+        """
+        params_file = f"{path}-{epoch:04d}.params.npz"
+        self.save_parameters(params_file)
+        meta = {
+            "format": "mxnet_tpu-hybrid-v1",
+            "block_class": f"{type(self).__module__}.{type(self).__name__}",
+            "params": os.path.basename(params_file),
+        }
+        json_file = f"{path}-symbol.json"
+        with open(json_file, "w") as f:
+            json.dump(meta, f, indent=2)
+        return json_file, params_file
+
+    def infer_shape(self, *args):
+        """Trigger deferred-shape inference without full compute where
+        possible (falls back to an eager forward)."""
+        with autograd.pause():
+            self(*args)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise MXNetError(
+            "hybrid_forward(F, ...) is the MXNet 1.x API; implement "
+            "forward(self, x) (MXNet 2.0 / Gluon 2 style) instead")
+
+
+class SymbolBlock(HybridBlock):
+    """Load an exported model without its python class (reference:
+    block.py:1638). Minimal: reloads params into a user-supplied block; full
+    graph-only reload is a compiled-artifact (AOT) feature tracked for a
+    later round."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__()
+        self._outputs = outputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        mod_name, cls_name = meta["block_class"].rsplit(".", 1)
+        import importlib
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        block = cls()
+        if param_file:
+            block.load_parameters(param_file, ctx=ctx)
+        return block

@@ -1,0 +1,13 @@
+"""gluon.contrib.estimator — keras-like fit loop.
+
+Reference parity: python/mxnet/gluon/contrib/estimator/ (Estimator with
+event handlers; CheckpointHandler at event_handler.py:336, EarlyStopping
+:614, ValidationHandler :160) — the reference's only automatic periodic
+checkpointing lives here (SURVEY §5 checkpoint/resume).
+"""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
+    StoppingHandler, MetricHandler, ValidationHandler, LoggingHandler,
+    CheckpointHandler, EarlyStoppingHandler,
+)

@@ -1,0 +1,74 @@
+"""Estimator fit loop (reference: gluon/contrib/estimator/estimator.py)."""
+from __future__ import annotations
+
+from .... import autograd
+from ...metric import Accuracy, Loss as LossMetric
+from ...trainer import Trainer
+from .event_handler import (
+    BatchBegin, BatchEnd, EpochBegin, EpochEnd, MetricHandler,
+    StoppingHandler, TrainBegin, TrainEnd,
+)
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, device=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [Accuracy()]
+        if not isinstance(self.train_metrics, list):
+            self.train_metrics = [self.train_metrics]
+        self.train_metrics.append(LossMetric("train_loss"))
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+
+    def _handlers(self, event_handlers, epochs, batches):
+        handlers = list(event_handlers or [])
+        stop = StoppingHandler(epochs, batches)
+        handlers.append(stop)
+        handlers.append(MetricHandler(self.train_metrics))
+        return handlers, stop
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        epochs = epochs or (None if batches else 1)
+        handlers, stop = self._handlers(event_handlers, epochs, batches)
+
+        def _dispatch(kind, *args, **kwargs):
+            for h in handlers:
+                fn = getattr(h, kind, None)
+                if fn is not None:
+                    fn(self, *args, **kwargs)
+
+        _dispatch("train_begin")
+        while not stop.stop_training:
+            _dispatch("epoch_begin")
+            for batch in train_data:
+                if stop.stop_training:
+                    break
+                data, label = batch[0], batch[1]
+                _dispatch("batch_begin")
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[batch_axis])
+                _dispatch("batch_end", pred=[pred], label=[label],
+                          loss=[loss])
+            _dispatch("epoch_end")
+            if epochs is None and batches is None:
+                break
+        _dispatch("train_end")
+        return self
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0):
+        metrics = val_metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            pred = self.net(data)
+            for m in metrics:
+                if not isinstance(m, LossMetric):
+                    m.update([label], [pred])
+        return metrics

@@ -1,0 +1,39 @@
+"""gluon.contrib.nn (reference: gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ... import nn as _nn
+from ....numpy.multiarray import ndarray
+from .... import numpy as _np
+from ...block import HybridBlock
+
+
+class HybridConcurrent(HybridBlock):
+    """Parallel branches concatenated (reference: contrib/nn
+    HybridConcurrent)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+        self._n = 0
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b, str(self._n))
+            self._n += 1
+
+    def forward(self, x):
+        return _np.concatenate([b(x) for b in self._children.values()],
+                               axis=self._axis)
+
+
+class Concurrent(HybridConcurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Dense-gradient embedding (sparse grads are dense on TPU)."""

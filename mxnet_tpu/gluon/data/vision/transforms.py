@@ -1,0 +1,142 @@
+"""Vision transforms.
+
+Reference parity: python/mxnet/gluon/data/vision/transforms/ (ToTensor,
+Normalize, Resize, CenterCrop, RandomResizedCrop, RandomFlipLeftRight, Cast,
+Compose). Transforms are Blocks operating on HWC uint8/float arrays.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import numpy as _np
+from ....numpy.multiarray import ndarray
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+
+class Compose(Sequential):
+    """Reference: transforms Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        self.add(*transforms)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ToTensor)."""
+
+    def forward(self, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose(2, 0, 1)
+        return x.transpose(0, 3, 1, 2)
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype=onp.float32)
+        self._std = onp.asarray(std, dtype=onp.float32)
+
+    def forward(self, x):
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return (x - _np.array(mean)) / _np.array(std)
+
+
+class Resize(Block):
+    """Bilinear resize HWC (reference: transforms Resize over image resize op)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        raw = x._data if isinstance(x, ndarray) else jnp.asarray(x)
+        h, w = self._size[1], self._size[0]
+        out = jax.image.resize(raw.astype(jnp.float32),
+                               (h, w) + tuple(raw.shape[2:]), method="bilinear")
+        from ....numpy.multiarray import _wrap
+        return _wrap(out.astype(raw.dtype))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        scale = onp.random.uniform(*self._scale)
+        ratio = onp.random.uniform(*self._ratio)
+        w = int(round((area * scale * ratio) ** 0.5))
+        h = int(round((area * scale / ratio) ** 0.5))
+        w, h = min(w, W), min(h, H)
+        x0 = onp.random.randint(0, W - w + 1)
+        y0 = onp.random.randint(0, H - h + 1)
+        crop = x[y0:y0 + h, x0:x0 + w]
+        return Resize(self._size).forward(crop)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[:, ::-1]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if onp.random.rand() < 0.5:
+            return x[::-1]
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        f = 1.0 + onp.random.uniform(-self._b, self._b)
+        return (x.astype("float32") * f).clip(0, 255).astype(x.dtype)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        f = 1.0 + onp.random.uniform(-self._c, self._c)
+        xf = x.astype("float32")
+        mean = xf.mean()
+        return ((xf - mean) * f + mean).clip(0, 255).astype(x.dtype)

@@ -1,0 +1,279 @@
+"""gluon.loss (reference: python/mxnet/gluon/loss.py).
+
+All losses are HybridBlocks over mx.np ops; per-element weighting and batch
+axis handling mirror the reference's _apply_weighting/_reshape_like helpers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import numpy as _np
+from .. import numpy_extension as npx
+from .block import HybridBlock
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    return label.reshape(pred.shape)
+
+
+class Loss(HybridBlock):
+    """Base loss (reference: loss.py:56)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        loss = _np.square(label.reshape(pred.shape) - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean(loss)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        loss = _np.abs(label.reshape(pred.shape) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        err = _np.abs(label.reshape(pred.shape) - pred)
+        loss = _np.where(err > self._rho,
+                         err - 0.5 * self._rho,
+                         (0.5 / self._rho) * _np.square(err))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """Reference: loss.py SigmoidBCELoss (numerically stable logits form)."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = label.reshape(pred.shape)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                loss = _np.maximum(pred, 0) - pred * label + \
+                    _np.log(1 + _np.exp(-_np.abs(pred)))
+            else:
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = pred - pred * label + log_weight * (
+                    _np.log(1 + _np.exp(-_np.abs(pred)))
+                    + _np.maximum(-pred, 0))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(_np.log(pred + eps) * label
+                         + _np.log(1 - pred + eps) * (1 - label))
+            else:
+                loss = -(_np.log(pred + eps) * label * pos_weight
+                         + _np.log(1 - pred + eps) * (1 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Reference: loss.py SoftmaxCrossEntropyLoss (sparse or dense labels)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -npx.pick(pred, label, axis=self._axis)
+        else:
+            label = label.reshape(pred.shape)
+            loss = -(pred * label).sum(axis=self._axis)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        loss = label * (_np.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class CTCLoss(Loss):
+    """Reference: loss.py CTCLoss over src/operator/nn/ctc_loss.cc (WarpCTC).
+    TPU-native: optax.ctc_loss (XLA-lowered dynamic programming)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import optax
+        from ..block import _flatten_args
+        from ..parameter import Parameter  # noqa: F401
+
+        if self._layout == "TNC":
+            pred = pred.swapaxes(0, 1)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+
+        def fn(logits, labels):
+            b, t = logits.shape[0], logits.shape[1]
+            lp = (jnp.zeros((b, t)) if pred_lengths is None else
+                  jnp.arange(t)[None, :] >=
+                  jnp.asarray(pred_lengths._data if hasattr(pred_lengths, "_data")
+                              else pred_lengths)[:, None]).astype(jnp.float32)
+            ln = labels.shape[1]
+            if label_lengths is not None:
+                ll = jnp.asarray(label_lengths._data
+                                 if hasattr(label_lengths, "_data")
+                                 else label_lengths)
+                lpad = (jnp.arange(ln)[None, :] >= ll[:, None]).astype(jnp.float32)
+            else:
+                lpad = (labels == 0).astype(jnp.float32)
+            return optax.ctc_loss(logits, lp, labels.astype(jnp.int32), lpad,
+                                  blank_id=0)
+        from ..numpy.multiarray import _invoke
+        loss = _invoke(fn, (pred, label), name="ctc_loss")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        loss = _np.maximum(self._margin - pred * label.reshape(pred.shape), 0)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        loss = _np.square(_np.maximum(
+            self._margin - pred * label.reshape(pred.shape), 0))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = _np.maximum(pred, 0) - pred * label + \
+            _np.log(1 + _np.exp(-_np.abs(pred)))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = positive.reshape(pred.shape)
+        negative = negative.reshape(pred.shape)
+        loss = _np.sum(_np.square(pred - positive)
+                       - _np.square(pred - negative),
+                       axis=tuple(range(1, pred.ndim)))
+        loss = _np.maximum(loss + self._margin, 0)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        eps = 1e-12
+        dot = _np.sum(input1 * input2, axis=-1)
+        n1 = _np.sqrt(_np.sum(_np.square(input1), axis=-1) + eps)
+        n2 = _np.sqrt(_np.sum(_np.square(input2), axis=-1) + eps)
+        cos = dot / (n1 * n2)
+        label = label.reshape(cos.shape)
+        loss = _np.where(label == 1, 1 - cos,
+                         _np.maximum(cos - self._margin, 0))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = target.reshape(pred.shape)
+        if self._from_logits:
+            loss = _np.exp(pred) - target * pred
+        else:
+            loss = pred - target * _np.log(pred + epsilon)
+        if self._compute_full:
+            stirling = target * _np.log(target + 1e-12) - target \
+                + 0.5 * _np.log(2 * _np.pi * (target + 1e-12))
+            loss = loss + _np.where(target > 1, stirling,
+                                    _np.zeros_like(target))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()

@@ -1,0 +1,326 @@
+"""gluon.metric (reference: python/mxnet/gluon/metric.py, 1.9k LoC).
+
+EvalMetric registry + the common metrics. Metrics are host-side bookkeeping
+(pure frontend in the reference too); arrays are fetched via asnumpy().
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError, _Registry
+from ..numpy.multiarray import ndarray
+
+_registry = _Registry("metric")
+register = _registry.register
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if not shape:
+        if len(labels) != len(preds):
+            raise MXNetError(
+                f"label/pred count mismatch: {len(labels)} vs {len(preds)}")
+
+
+class EvalMetric:
+    """Base metric (reference: metric.py EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def update_dict(self, label, pred):
+        self.update(list(label.values()), list(pred.values()))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+@register("acc")
+@register()
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(self.axis)
+            pred = pred.astype(onp.int64).flat
+            label = label.astype(onp.int64).flat
+            n = len(label)
+            self.sum_metric += float((onp.asarray(pred[:n]) ==
+                                      onp.asarray(label[:n])).sum())
+            self.num_inst += n
+
+
+@register("top_k_accuracy")
+@register()
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            idx = onp.argsort(pred, axis=-1)[:, -self.top_k:]
+            label = label.astype(onp.int64).reshape(-1, 1)
+            self.sum_metric += float((idx == label).any(axis=-1).sum())
+            self.num_inst += label.shape[0]
+
+
+@register()
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            self.sum_metric += float(onp.abs(label.reshape(pred.shape)
+                                             - pred).mean()) * label.shape[0]
+            self.num_inst += label.shape[0]
+
+
+@register()
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            self.sum_metric += float(((label.reshape(pred.shape) - pred) ** 2)
+                                     .mean()) * label.shape[0]
+            self.num_inst += label.shape[0]
+
+
+@register()
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, (self.sum_metric / self.num_inst) ** 0.5
+
+
+@register("ce")
+@register()
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            label = label.ravel().astype(onp.int64)
+            prob = pred[onp.arange(label.shape[0]), label]
+            self.sum_metric += float((-onp.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register()
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(onp.exp(self.sum_metric / self.num_inst))
+
+
+@register()
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "_tp"):
+            self.reset_stats()
+        else:
+            self.reset_stats()
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.ravel()
+            label = label.ravel()
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += label.shape[0]
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+@register()
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred, label = pred.ravel(), label.ravel()
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self.num_inst += label.shape[0]
+
+    def get(self):
+        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        denom = ((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)) ** 0.5
+        mcc = (tp * tn - fp * fn) / denom if denom > 0 else 0.0
+        return self.name, mcc
+
+
+@register()
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._labels, self._preds = [], []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            self._labels.append(_as_np(label).ravel())
+            self._preds.append(_as_np(pred).ravel())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        l = onp.concatenate(self._labels)
+        p = onp.concatenate(self._preds)
+        return self.name, float(onp.corrcoef(l, p)[0, 1])
+
+
+@register("loss")
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for pred in preds:
+            loss = float(_as_np(pred).sum())
+            self.sum_metric += loss
+            self.num_inst += _as_np(pred).size
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = metrics or []
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            name, value = m.get()
+            names.append(name)
+            values.append(value)
+        return names, values
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            v = self._feval(_as_np(label), _as_np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    return CustomMetric(numpy_feval, name, allow_extra_outputs)
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        return CompositeEvalMetric([create(m) for m in metric])
+    return _registry.get(metric)(*args, **kwargs)

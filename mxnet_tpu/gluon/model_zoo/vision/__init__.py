@@ -1,0 +1,32 @@
+"""gluon.model_zoo.vision (reference: model_zoo/vision/__init__.py get_model
+registry)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
+import importlib as _importlib
+
+_models = {}
+for _mod_name in ("resnet", "alexnet", "vgg", "mobilenet", "squeezenet",
+                  "densenet", "inception"):
+    _mod = _importlib.import_module(f".{_mod_name}", __name__)
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower():
+            _models[_name.replace("_", ".", 0)] = _obj
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Reference: model_zoo/vision get_model(name)."""
+    name = name.lower().replace(".", "_")
+    if name not in _models:
+        raise MXNetError(
+            f"unknown model {name!r}; available: {sorted(set(_models))}")
+    return _models[name](**kwargs)

@@ -1,0 +1,150 @@
+"""Inception v3 (reference: model_zoo/vision/inception.py)."""
+from __future__ import annotations
+
+from .... import numpy as _np
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _make_basic_conv(channels, **kwargs):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential()
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(3, 1, 1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(3, 2))
+    for setting in conv_settings:
+        channels, kernel, stride, padding = setting
+        kw = {"kernel_size": kernel}
+        if stride is not None:
+            kw["strides"] = stride
+        if padding is not None:
+            kw["padding"] = padding
+        out.add(_make_basic_conv(channels, **kw))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Parallel branches concatenated on channel axis (reference:
+    gluon/contrib/nn HybridConcurrent)."""
+
+    def __init__(self, axis=1):
+        super().__init__()
+        self._axis = axis
+        self._branches = []
+
+    def add(self, block):
+        self._branches.append(block)
+        self.register_child(block, str(len(self._branches) - 1))
+
+    def forward(self, x):
+        return _np.concatenate([b(x) for b in self._children.values()],
+                               axis=self._axis)
+
+
+def _make_A(pool_features):
+    out = _Concurrent()
+    out.add(_make_branch(None, (64, 1, None, None)))
+    out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, None, 1)))
+    out.add(_make_branch("avg", (pool_features, 1, None, None)))
+    return out
+
+
+def _make_B():
+    out = _Concurrent()
+    out.add(_make_branch(None, (384, 3, 2, None)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+def _make_C(channels_7x7):
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None)))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0))))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (192, (1, 7), None, (0, 3))))
+    out.add(_make_branch("avg", (192, 1, None, None)))
+    return out
+
+
+def _make_D():
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
+    out.add(_make_branch(None, (192, 1, None, None),
+                         (192, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0)), (192, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+class _InceptionE(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.branch1 = _make_branch(None, (320, 1, None, None))
+        self.branch2_stem = _make_branch(None, (384, 1, None, None))
+        self.branch2_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
+        self.branch2_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
+        self.branch3_stem = _make_branch(None, (448, 1, None, None),
+                                         (384, 3, None, 1))
+        self.branch3_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
+        self.branch3_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
+        self.branch4 = _make_branch("avg", (192, 1, None, None))
+
+    def forward(self, x):
+        b2 = self.branch2_stem(x)
+        b3 = self.branch3_stem(x)
+        return _np.concatenate([
+            self.branch1(x), self.branch2_a(b2), self.branch2_b(b2),
+            self.branch3_a(b3), self.branch3_b(b3), self.branch4(x)], axis=1)
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        self.features.add(_make_basic_conv(32, kernel_size=3, strides=2))
+        self.features.add(_make_basic_conv(32, kernel_size=3))
+        self.features.add(_make_basic_conv(64, kernel_size=3, padding=1))
+        self.features.add(nn.MaxPool2D(3, 2))
+        self.features.add(_make_basic_conv(80, kernel_size=1))
+        self.features.add(_make_basic_conv(192, kernel_size=3))
+        self.features.add(nn.MaxPool2D(3, 2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_InceptionE())
+        self.features.add(_InceptionE())
+        self.features.add(nn.AvgPool2D(8))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    return Inception3(**kwargs)

@@ -1,0 +1,280 @@
+"""Convolution and pooling layers.
+
+Reference parity: python/mxnet/gluon/nn/conv_layers.py (Conv1D/2D/3D,
+Conv{2,3}DTranspose, Max/Avg/GlobalMax/GlobalAvg pooling, ReflectionPad2D)
+over src/operator/nn/convolution.cc / pooling.cc (cuDNN paths).
+
+TPU-native: convs lower to lax.conv_general_dilated (MXU-tiled by XLA);
+pooling to lax.reduce_window. Default layout NCHW for reference parity — XLA
+handles the internal layout assignment for TPU.
+"""
+from __future__ import annotations
+
+from ... import numpy_extension as npx
+from ... import numpy as _np
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import Activation
+
+
+def _pair(x, n):
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="convolution", adj=None, dtype="float32"):
+        super().__init__()
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = _pair(strides, ndim)
+        self._padding = _pair(padding, ndim)
+        self._dilation = _pair(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._op_name = op_name
+        self._adj = adj
+        if op_name == "convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0) \
+                + kernel_size
+        else:  # deconvolution weight is (in, out//groups, *k)
+            wshape = (in_channels if in_channels else 0, channels // groups) \
+                + kernel_size
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(channels,), dtype=dtype,
+                               init=bias_initializer, allow_deferred_init=True)
+                     if use_bias else None)
+        self.act = Activation(activation) if activation else None
+
+    def forward(self, x):
+        if not self.weight._shape_known():
+            c_axis = self._layout.index("C")
+            in_ch = x.shape[c_axis]
+            if self._op_name == "convolution":
+                shape = (self._channels, in_ch // self._groups) + self._kernel
+            else:
+                shape = (in_ch, self._channels // self._groups) + self._kernel
+            self.weight._finish_deferred_init(shape)
+        if self.bias is not None and self.bias._data is None:
+            self.bias._finish_deferred_init()
+        b = self.bias.data() if self.bias is not None else None
+        if self._op_name == "convolution":
+            out = npx.convolution(x, self.weight.data(), b,
+                                  kernel=self._kernel, stride=self._strides,
+                                  dilate=self._dilation, pad=self._padding,
+                                  num_filter=self._channels,
+                                  num_group=self._groups,
+                                  no_bias=b is None, layout=self._layout)
+        else:
+            out = npx.deconvolution(x, self.weight.data(), b,
+                                    kernel=self._kernel, stride=self._strides,
+                                    dilate=self._dilation, pad=self._padding,
+                                    adj=self._adj, num_filter=self._channels,
+                                    num_group=self._groups,
+                                    no_bias=b is None, layout=self._layout)
+        return self.act(out) if self.act is not None else out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, stride={self._strides})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _pair(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _pair(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _pair(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _pair(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         op_name="deconvolution",
+                         adj=_pair(output_padding, 1))
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _pair(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         op_name="deconvolution",
+                         adj=_pair(output_padding, 2))
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _pair(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, in_channels, activation,
+                         use_bias, weight_initializer, bias_initializer,
+                         op_name="deconvolution",
+                         adj=_pair(output_padding, 3))
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode=False,
+                 global_pool=False, pool_type="max", layout="NCHW",
+                 count_include_pad=True):
+        super().__init__()
+        self._pool_size = pool_size
+        self._strides = strides if strides is not None else pool_size
+        self._padding = padding
+        self._global = global_pool
+        self._pool_type = pool_type
+        self._layout = layout
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return npx.pooling(
+            x, kernel=self._pool_size, stride=self._strides,
+            pad=self._padding, pool_type=self._pool_type,
+            global_pool=self._global, layout=self._layout,
+            count_include_pad=self._count_include_pad)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._pool_size}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_pair(pool_size, 1), _pair(strides or pool_size, 1),
+                         _pair(padding, 1), ceil_mode, False, "max", layout)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(_pair(pool_size, 2),
+                         _pair(strides if strides is not None else pool_size, 2),
+                         _pair(padding, 2), ceil_mode, False, "max", layout)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_pair(pool_size, 3),
+                         _pair(strides if strides is not None else pool_size, 3),
+                         _pair(padding, 3), ceil_mode, False, "max", layout)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_pair(pool_size, 1),
+                         _pair(strides if strides is not None else pool_size, 1),
+                         _pair(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_pair(pool_size, 2),
+                         _pair(strides if strides is not None else pool_size, 2),
+                         _pair(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_pair(pool_size, 3),
+                         _pair(strides if strides is not None else pool_size, 3),
+                         _pair(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), (1,), (0,), False, True, "max", layout)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), (1, 1), (0, 0), False, True, "max", layout)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), (1, 1, 1), (0, 0, 0), False, True, "max",
+                         layout)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), (1,), (0,), False, True, "avg", layout)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), (1, 1), (0, 0), False, True, "avg", layout)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), (1, 1, 1), (0, 0, 0), False, True, "avg",
+                         layout)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reference: conv_layers.py ReflectionPad2D (pad op, mode='reflect')."""
+
+    def __init__(self, padding=0):
+        super().__init__()
+        self._padding = _pair(padding, 4) if not isinstance(padding, int) \
+            else (padding,) * 4
+
+    def forward(self, x):
+        p = self._padding
+        pad_width = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])) \
+            if len(p) == 4 else ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+        return _np.pad(x, pad_width=pad_width, mode="reflect")

@@ -1,0 +1,221 @@
+"""gluon.Parameter.
+
+Reference parity: python/mxnet/gluon/parameter.py:47-570 (lazy/deferred
+initialization, per-context data/grad arrays, grad_req, constant params).
+
+TPU-native design: a Parameter owns one ndarray (whose jax.Array may be
+*sharded* across a device mesh — the analog of the reference's per-context
+copies list is a single sharded array; ``list_data()`` returns per-device
+views for KVStore compatibility). Shapes with 0 entries are deferred and
+completed at first forward from input shapes, exactly like the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..context import current_context
+from .. import initializer as init_mod
+from ..numpy.multiarray import ndarray, _wrap
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    """A trainable (or auxiliary) tensor of a Block."""
+
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype="float32", lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = np_dtype(dtype) or jnp.float32
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        self._data = None
+        self._deferred_init = None  # (initializer, ctx)
+        self._structure_name = None  # set by Block registration
+        self._sharding = None        # optional jax.sharding spec
+
+    # -- naming ------------------------------------------------------------
+    @property
+    def name(self):
+        return self._structure_name or self._name
+
+    @name.setter
+    def name(self, v):
+        self._name = v
+
+    # -- shape (with deferred unknown dims as 0/-1) ------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape) or any(
+                s not in (0, -1) and s != n for s, n in zip(self._shape, new_shape)):
+            raise MXNetError(
+                f"cannot update shape {self._shape} -> {tuple(new_shape)} for {self.name}")
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False, device=None):
+        """Reference: parameter.py Parameter.initialize (lazy when shape
+        unknown)."""
+        if self._data is not None and not force_reinit:
+            return
+        ctx = device if device is not None else ctx
+        initializer = init or self.init or default_init or init_mod.Uniform()
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        if not self._shape_known():
+            if not self.allow_deferred_init:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} has unknown shape {self._shape}; "
+                    "run a forward pass to infer it")
+            self._deferred_init = (initializer, ctx)
+            return
+        self._init_impl(initializer, ctx)
+
+    def _init_impl(self, initializer, ctx):
+        arr = _wrap(jnp.zeros(self._shape, self.dtype))
+        initializer(self.name, arr)
+        if ctx is not None:
+            arr = arr.as_in_ctx(ctx if not isinstance(ctx, (list, tuple)) else ctx[0])
+        self._data = arr
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self, inferred_shape=None):
+        if inferred_shape is not None:
+            self.shape = inferred_shape
+        if self._deferred_init is None:
+            if self._data is None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} not initialized; call "
+                    ".initialize() before forward")
+            return
+        initializer, ctx = self._deferred_init
+        self._init_impl(initializer, ctx)
+
+    # -- access ------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} pending deferred init; run a "
+                    "forward pass first")
+            raise MXNetError(
+                f"parameter {self.name} not initialized; call .initialize()")
+        return self._data
+
+    def list_data(self):
+        return [self._data]
+
+    def grad(self, ctx=None):
+        if self._data is None or self._data.grad is None:
+            raise MXNetError(f"parameter {self.name} has no gradient buffer "
+                             f"(grad_req={self._grad_req!r})")
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self._data.ctx] if self._data is not None else [current_context()]
+
+    def set_data(self, data):
+        if not isinstance(data, ndarray):
+            from ..numpy import array
+            data = array(data)
+        if self._data is None:
+            self.shape = data.shape
+            self._data = data.astype(self.dtype)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+        else:
+            self._data._rebind(data._data.astype(self.dtype))
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_ctx(ctx)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    reset_device = reset_ctx
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is not None:
+            self._data = self._data.astype(self.dtype)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    # -- sharding (TPU-native addition) ------------------------------------
+    def shard(self, sharding):
+        """Place this parameter with an explicit jax.sharding. With a mesh,
+        this is how tensor-parallel layouts are declared."""
+        import jax
+        self._sharding = sharding
+        if self._data is not None:
+            self._data._rebind(jax.device_put(self._data._data, sharding))
+
+    def var(self):
+        return self._data
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={jnp.dtype(self.dtype).name})")
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference: parameter.py
+    Constant)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, ndarray):
+            from ..numpy import array
+            value = array(value)
+        self._value = value
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Constant(0.0), differentiable=False)
+
+    def _init_impl(self, initializer, ctx):
+        self._data = self._value.copy()
+        self._deferred_init = None

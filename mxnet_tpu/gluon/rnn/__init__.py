@@ -1,0 +1,6 @@
+"""gluon.rnn (reference: python/mxnet/gluon/rnn/__init__.py)."""
+from .rnn_cell import (  # noqa: F401
+    RecurrentCell, RNNCell, LSTMCell, GRUCell, SequentialRNNCell, DropoutCell,
+    ResidualCell, ZoneoutCell, BidirectionalCell,
+)
+from .rnn_layer import RNN, LSTM, GRU  # noqa: F401

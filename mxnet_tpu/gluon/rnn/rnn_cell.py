@@ -1,0 +1,256 @@
+"""Recurrent cells.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_cell.py (RNNCell, LSTMCell,
+GRUCell, SequentialRNNCell, DropoutCell, BidirectionalCell, ResidualCell).
+Single-step math matches src/operator/rnn_impl.h; unroll is a python loop
+eagerly and a traced loop under hybridize.
+"""
+from __future__ import annotations
+
+from ... import numpy as _np
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=_np.zeros, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(info["shape"], **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Reference: rnn_cell.py BaseRNNCell.unroll."""
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            x = inputs[(slice(None),) * axis + (t,)]
+            out, states = self(x, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = _np.stack(outputs, axis=axis)
+        if valid_length is not None:
+            outputs = npx.sequence_mask(outputs, valid_length,
+                                        use_sequence_length=True,
+                                        axis=axis)
+        return outputs, states
+
+    def reset(self):
+        pass
+
+
+class _BaseCell(RecurrentCell):
+    def __init__(self, hidden_size, ngates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = ngates
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng * hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng * hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self._ng = ng
+
+    def _ensure(self, x):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight._finish_deferred_init(
+                (self._ng * self._hidden_size, x.shape[-1]))
+        for p in (self.h2h_weight, self.i2h_bias, self.h2h_bias):
+            if p._data is None:
+                p._finish_deferred_init()
+
+
+class RNNCell(_BaseCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._ensure(x)
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        out = _np.dot(x, self.i2h_weight.data().T) + self.i2h_bias.data() + \
+            _np.dot(h, self.h2h_weight.data().T) + self.h2h_bias.data()
+        out = npx.activation(out, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._ensure(x)
+        h, c = states
+        gates = _np.dot(x, self.i2h_weight.data().T) + self.i2h_bias.data() + \
+            _np.dot(h, self.h2h_weight.data().T) + self.h2h_bias.data()
+        i, f, g, o = _np.split(gates, 4, axis=-1)
+        i, f, o = npx.sigmoid(i), npx.sigmoid(f), npx.sigmoid(o)
+        c_new = f * c + i * _np.tanh(g)
+        h_new = o * _np.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._ensure(x)
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        i2h = _np.dot(x, self.i2h_weight.data().T) + self.i2h_bias.data()
+        h2h = _np.dot(h, self.h2h_weight.data().T) + self.h2h_bias.data()
+        i2h_r, i2h_z, i2h_n = _np.split(i2h, 3, axis=-1)
+        h2h_r, h2h_z, h2h_n = _np.split(h2h, 3, axis=-1)
+        r = npx.sigmoid(i2h_r + h2h_r)
+        z = npx.sigmoid(i2h_z + h2h_z)
+        n = _np.tanh(i2h_n + r * h2h_n)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self):
+        super().__init__()
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell, str(len(self._cells) - 1))
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size) for c in self._cells], [])
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            x, st = cell(x, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._cells)
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        return npx.dropout(x, p=self._rate, axes=self._axes), states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__()
+        self.base_cell = base_cell
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        from ... import autograd
+        if autograd.is_training():
+            if self._zo > 0:
+                mask = npx.dropout(_np.ones_like(out), p=self._zo) * (1 - self._zo)
+                out = mask * out + (1 - mask) * (
+                    self._prev if self._prev is not None else _np.zeros_like(out))
+            self._prev = out
+        return out, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True, valid_length)
+        rev = npx.sequence_reverse(inputs.swapaxes(0, axis) if axis else inputs,
+                                   valid_length, valid_length is not None)
+        if axis:
+            rev = rev.swapaxes(0, axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True, valid_length)
+        r_out = npx.sequence_reverse(r_out.swapaxes(0, axis) if axis else r_out,
+                                     valid_length, valid_length is not None)
+        if axis:
+            r_out = r_out.swapaxes(0, axis)
+        out = _np.concatenate([l_out, r_out], axis=-1)
+        return out, l_states + r_states
+
+    def forward(self, x, states):
+        raise NotImplementedError("BidirectionalCell supports unroll() only")

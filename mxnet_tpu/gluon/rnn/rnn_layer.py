@@ -1,0 +1,161 @@
+"""Fused RNN layers.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_layer.py:241 (RNN/LSTM/GRU
+dispatching to the fused npx.rnn op — the cuDNN path of
+src/operator/rnn-inl.h:601-699). Parameters are stored per layer/direction
+({l|r}{i}_{i2h,h2h}_{weight,bias}) like the reference, then packed into the
+flat cuDNN-layout vector npx.rnn expects; on TPU the fused op is a lax.scan
+the XLA compiler pipelines.
+"""
+from __future__ import annotations
+
+from ... import numpy as _np
+from ... import numpy_extension as npx
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", use_sequence_length=False, **kwargs):
+        super().__init__()
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        self._use_sequence_length = use_sequence_length
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, nh = self._gates, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                in_sz = input_size if i == 0 else hidden_size * self._dir
+                setattr(self, f"{j}{i}_i2h_weight",
+                        Parameter(f"{j}{i}_i2h_weight",
+                                  shape=(ng * nh, in_sz if in_sz else 0),
+                                  init=i2h_weight_initializer, dtype=dtype,
+                                  allow_deferred_init=True))
+                setattr(self, f"{j}{i}_h2h_weight",
+                        Parameter(f"{j}{i}_h2h_weight", shape=(ng * nh, nh),
+                                  init=h2h_weight_initializer, dtype=dtype,
+                                  allow_deferred_init=True))
+                setattr(self, f"{j}{i}_i2h_bias",
+                        Parameter(f"{j}{i}_i2h_bias", shape=(ng * nh,),
+                                  init=i2h_bias_initializer, dtype=dtype,
+                                  allow_deferred_init=True))
+                setattr(self, f"{j}{i}_h2h_bias",
+                        Parameter(f"{j}{i}_h2h_bias", shape=(ng * nh,),
+                                  init=h2h_bias_initializer, dtype=dtype,
+                                  allow_deferred_init=True))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=_np.zeros, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(info["shape"], **kwargs))
+        return states
+
+    def _ensure_params(self, x):
+        in_sz = x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                cur = in_sz if i == 0 else nh * self._dir
+                w = getattr(self, f"{j}{i}_i2h_weight")
+                if not w._shape_known():
+                    w._finish_deferred_init((ng * nh, cur))
+                for suffix in ("h2h_weight", "i2h_bias", "h2h_bias"):
+                    p = getattr(self, f"{j}{i}_{suffix}")
+                    if p._data is None:
+                        p._finish_deferred_init()
+
+    def _flat_params(self):
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(self, f"{j}{i}_i2h_weight").data().reshape(-1))
+                ws.append(getattr(self, f"{j}{i}_h2h_weight").data().reshape(-1))
+                bs.append(getattr(self, f"{j}{i}_i2h_bias").data())
+                bs.append(getattr(self, f"{j}{i}_h2h_bias").data())
+        return _np.concatenate(ws + bs, axis=0)
+
+    def forward(self, inputs, states=None, sequence_length=None):
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        self._ensure_params(inputs)
+        batch = inputs.shape[1]
+        skip_states = states is None
+        if states is None:
+            states = self.begin_state(batch, dtype=inputs.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        params = self._flat_params()
+        if self._mode == "lstm":
+            out, h, c = npx.rnn(inputs, params, states[0], states[1],
+                                mode=self._mode,
+                                state_size=self._hidden_size,
+                                num_layers=self._num_layers,
+                                bidirectional=self._dir == 2,
+                                p=self._dropout, state_outputs=True)
+            new_states = [h, c]
+        else:
+            out, h = npx.rnn(inputs, params, states[0], mode=self._mode,
+                             state_size=self._hidden_size,
+                             num_layers=self._num_layers,
+                             bidirectional=self._dir == 2,
+                             p=self._dropout, state_outputs=True)
+            new_states = [h]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        return out if skip_states else (out, new_states)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Reference: rnn_layer.py RNN (mode rnn_relu/rnn_tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers, layout,
+                         dropout, bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

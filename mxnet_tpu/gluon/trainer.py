@@ -1,0 +1,161 @@
+"""gluon.Trainer.
+
+Reference parity: python/mxnet/gluon/trainer.py:31-520 (optimizer + kvstore
+orchestration: _allreduce_grads pushes/pullpulls per-param with priority
+-param_index so first-needed params reduce first; _update applies fused
+optimizer ops per device).
+
+TPU-native design: gradients are jax Arrays; allreduce is the KVStore's
+device/mesh psum; compute/comm overlap comes from PJRT async dispatch — the
+python thread never blocks, matching the reference's engine overlap.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..kvstore import create as create_kvstore, KVStoreBase
+from .parameter import Parameter
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, dict):
+            self._param_names = list(params.keys())
+            params = list(params.values())
+        else:
+            self._param_names = [p.name for p in params]
+        if not params:
+            raise MXNetError("no parameters to optimize")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"expected Parameter, got {type(p)}")
+            self._param2idx[id(p)] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._params_to_init = []
+        self._contains_sparse_grad = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kvstore_type is None or self._kvstore_type == "":
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = (self._kvstore_type
+                  if isinstance(self._kvstore_type, KVStoreBase)
+                  else create_kvstore(self._kvstore_type))
+            self._kvstore = kv
+            if self._compression_params and hasattr(kv, "set_gradient_compression"):
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def allreduce_grads(self):
+        """Reduce gradients across devices/workers (reference:
+        trainer.py:363 _allreduce_grads)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None:
+                grads = p.list_grad()
+                if self._update_on_kvstore:
+                    # optimizer runs in the store; weights pulled in _update
+                    self._kvstore.push(i, grads, priority=-i)
+                else:
+                    self._kvstore.pushpull(i, grads, out=grads, priority=-i)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Reference: trainer.py:334."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply optimizer without allreduce (assumes grads already reduced;
+        reference: trainer.py update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if self._update_on_kvstore:
+                # weights were updated inside the store: pull them back
+                self._kvstore.pull(i, out=p.data(), priority=-i)
+            else:
+                updater(i, p.grad(), p.data())
+
+    def save_states(self, fname):
+        """Reference: trainer.py:482."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Reference: trainer.py:511."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._optimizer or self._optimizer
+        else:
+            with open(fname, "rb") as f:
+                self._updaters[0].set_states(f.read())
+            self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}

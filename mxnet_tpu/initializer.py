@@ -1,0 +1,207 @@
+"""mx.init — parameter initializers.
+
+Reference parity: python/mxnet/initializer.py (registry + Uniform/Normal/
+Xavier/MSRAPrelu/Orthogonal/Constant/One/Zero/Bilinear/LSTMBias). Samplers
+draw from the global threefry stream (mx.random), so seeding is reproducible.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError, _Registry
+from . import random as _random
+
+_registry = _Registry("initializer")
+register = _registry.register
+
+
+class Initializer:
+    """Base initializer (reference: initializer.py:45)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr=None):
+        # supports both init(desc, arr) legacy and init(arr) forms
+        if arr is None:
+            name, arr = "weight", name
+        self.init_weight(str(name), arr)
+
+    def init_weight(self, name, arr):
+        if name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith(("beta", "bias", "mean", "moving_mean")):
+            self._init_zero(arr)
+        elif "running_var" in name or "moving_var" in name:
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_zero(self, arr):
+        arr._rebind(jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, arr):
+        arr._rebind(jnp.ones(arr.shape, arr.dtype))
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register("zero")
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+@register("one")
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+@register()
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._rebind(jnp.full(arr.shape, self.value, arr.dtype))
+
+
+@register()
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._rebind(jax.random.uniform(_random._next_key(), arr.shape,
+                                       jnp.float32, -self.scale,
+                                       self.scale).astype(arr.dtype))
+
+
+@register()
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._rebind((jax.random.normal(_random._next_key(), arr.shape)
+                     * self.sigma).astype(arr.dtype))
+
+
+@register()
+class Xavier(Initializer):
+    """Reference: initializer.py Xavier (rnd_type/factor_type/magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2 param, got {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        key = _random._next_key()
+        if self.rnd_type == "uniform":
+            val = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            val = jax.random.normal(key, shape) * scale
+        arr._rebind(val.astype(arr.dtype))
+
+
+@register()
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register()
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        val = jax.random.orthogonal(_random._next_key(), max(nout, nin))
+        arr._rebind((self.scale * val[:nout, :nin]).reshape(arr.shape)
+                    .astype(arr.dtype))
+
+
+@register()
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, dtype=onp.float32)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        arr._rebind(jnp.asarray(b, arr.dtype))
+
+
+@register()
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype=onp.float32)
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._rebind(jnp.asarray(weight.reshape(shape), arr.dtype))
+
+
+class Mixed:
+    """Pattern-matched initializer dispatch (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name!r}")
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return _registry.get(name)(**kwargs)

@@ -1,0 +1,224 @@
+"""mx.io — legacy data iterators.
+
+Reference parity: python/mxnet/io/io.py (DataIter/DataBatch/NDArrayIter,
+MXDataIter wrapping the C++ threaded iterators of src/io/). The Gluon
+DataLoader is the modern path; these iterators exist for MXNet-1.x-style
+training loops (Module-era scripts and the estimator).
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as onp
+
+from .. import numpy as _np
+from ..base import MXNetError
+from ..numpy.multiarray import ndarray
+
+DataDesc = collections.namedtuple("DataDesc", ["name", "shape"])
+
+
+class DataBatch:
+    """Reference: io.py DataBatch."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Reference: io.py DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    __next__ = next
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """Reference: io.py NDArrayIter (dict/list/array data, shuffle,
+    last_batch_handle pad/discard/roll_over)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self.idx = onp.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]))
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]))
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for _, v in arrays:
+            lo = self.cursor
+            hi = min(self.cursor + self.batch_size, self.num_data)
+            sel = self.idx[lo:hi]
+            part = v[sel]
+            if hi - lo < self.batch_size and self.last_batch_handle == "pad":
+                extra = self.batch_size - (hi - lo)
+                pad_sel = self.idx[:extra]
+                part = onp.concatenate([part, v[pad_sel]])
+            out.append(_np.array(part))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data required")
+        return []
+    if isinstance(data, (onp.ndarray, ndarray)):
+        data = {default_name: data}
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}_{i}" if i else default_name: d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        arr = v.asnumpy() if isinstance(v, ndarray) else onp.asarray(v)
+        out.append((k, arr))
+    return out
+
+
+class ResizeIter(DataIter):
+    """Reference: io.py ResizeIter (epoch-resize wrapper)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()
+
+    __next__ = next
+
+
+class PrefetchingIter(DataIter):
+    """Reference: io.py PrefetchingIter (threaded prefetch)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+        self.iters = iters if isinstance(iters, list) else [iters]
+        super().__init__(self.iters[0].batch_size)
+        self._queue = queue.Queue(maxsize=2)
+        self._stop = False
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        import threading
+
+        def _worker():
+            try:
+                for batch in self.iters[0]:
+                    if self._stop:
+                        return
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(None)
+        self._thread = threading.Thread(target=_worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop = True
+        while not self._queue.empty():
+            self._queue.get()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._stop = False
+        for it in self.iters:
+            it.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    __next__ = next

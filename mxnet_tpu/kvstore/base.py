@@ -1,0 +1,80 @@
+"""KVStore base + plugin registry.
+
+Reference parity: python/mxnet/kvstore/base.py (KVStoreBase.register at :74,
+create at :432 — local/device/nccl/dist_sync/dist_device_sync/dist_async/
+horovod/byteps).
+
+TPU-native design: all backends resolve to XLA collectives. 'local'/'device'/
+'nccl' are the single-process store (reduction on device; the ICI analog of
+CommDevice/NCCL); 'dist_*' layer the same interface over a multi-host mesh
+(DCN axis) via jax.distributed + psum — see kvstore.py and
+mxnet_tpu.parallel.collectives.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+class KVStoreBase:
+    """Plugin interface (reference: kvstore/base.py:74-230)."""
+
+    kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    # interface
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def local_rank(self):
+        return 0
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+    OPTIMIZER = "optimizer"
+
+
+def create(name="local"):
+    """Factory (reference: kvstore/base.py:432 create)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    name = name.lower()
+    from .kvstore import KVStore
+    from .horovod import Horovod  # noqa: F401 (registers)
+    if name in ("local", "device", "nccl", "local_allreduce_device",
+                "local_allreduce_cpu"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        from .dist import DistKVStore
+        return DistKVStore(name)
+    if name in KVStoreBase.kv_registry:
+        return KVStoreBase.kv_registry[name]()
+    raise MXNetError(f"unknown KVStore type {name!r}")

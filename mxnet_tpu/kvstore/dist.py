@@ -1,0 +1,96 @@
+"""Distributed KVStore ('dist_sync'/'dist_device_sync'/'dist_async').
+
+Reference parity: src/kvstore/kvstore_dist.h + kvstore_dist_server.h (ps-lite
+worker/server/scheduler, ZPush/ZPull key slicing, sync/async modes) and
+python/mxnet/kvstore/kvstore_server.py.
+
+TPU-native design: there is no parameter server. Cross-host reduction is an
+XLA AllReduce over the DCN mesh axis; rendezvous is jax.distributed
+(PJRT coordination service replaces the ps-lite scheduler, SURVEY §5).
+Workers call pushpull -> psum over all processes. 'dist_async' has no XLA
+analog and is executed as sync (documented divergence; the reference itself
+only guarantees eventual consistency there). Optimizer-on-server
+(update_on_kvstore) runs the updater identically on every worker after the
+reduce — bitwise-identical state without a server round-trip.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, get_env
+from ..numpy.multiarray import ndarray, _wrap
+from .kvstore import KVStore
+
+
+def _ensure_distributed():
+    """Initialize jax.distributed from MXNet-style or native env vars."""
+    if jax.process_count() > 1:
+        return
+    coord = (os.environ.get("JAX_COORDINATOR_ADDRESS")
+             or os.environ.get("DMLC_PS_ROOT_URI"))
+    nproc = get_env("DMLC_NUM_WORKER", None, int) or get_env("JAX_NUM_PROCESSES", None, int)
+    pid = get_env("DMLC_WORKER_ID", None, int) or get_env("JAX_PROCESS_ID", None, int)
+    if coord and nproc and nproc > 1:
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "1234")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid or 0)
+
+
+class DistKVStore(KVStore):
+    """Multi-host KVStore over XLA collectives."""
+
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        _ensure_distributed()
+        self._nprocs = jax.process_count()
+        self._rank = jax.process_index()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nprocs
+
+    def _allreduce(self, merged):
+        """Cross-process sum. Single process: identity. Multi-process: a
+        tiny pjit'd psum over a global 1-d process mesh (DCN axis)."""
+        if self._nprocs == 1:
+            return merged
+        from ..parallel.collectives import allreduce_across_processes
+        return _wrap(allreduce_across_processes(merged._data))
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            merged = self._allreduce(self._reduce(vs))
+            if self._updater is not None:
+                self._updater(self._key_int(k), merged, self._store[k])
+            else:
+                self._store[k]._rebind(merged._data.astype(self._store[k].dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys, values = self._normalize(key, value)
+        merged_list = []
+        for k, vs in zip(keys, values):
+            merged = self._allreduce(self._reduce(vs))
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(self._key_int(k), merged, self._store[k])
+                merged = self._store[k]
+            merged_list.append(merged)
+        if out is None:
+            return
+        _, outs = self._normalize(key, out)
+        for merged, o in zip(merged_list, outs):
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._rebind(merged._data.astype(t.dtype))

@@ -1,0 +1,28 @@
+"""Horovod/BytePS-style plugin backends.
+
+Reference parity: python/mxnet/kvstore/horovod.py:27-132 and byteps.py:29 —
+MPI-launched allreduce plugins registered through KVStoreBase.register.
+
+TPU-native: collectives are native (XLA), so these plugins delegate to the
+same mesh-psum path; they exist to honor kv.create('horovod') call sites.
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase
+from .kvstore import KVStore
+
+
+@KVStoreBase.register
+class Horovod(KVStore):
+    def __init__(self):
+        super().__init__("horovod")
+
+    def broadcast_parameters(self, params, root_rank=0):
+        for k, v in params.items():
+            self.init(k, v)
+
+
+@KVStoreBase.register
+class BytePS(KVStore):
+    def __init__(self):
+        super().__init__("byteps")

@@ -1,0 +1,42 @@
+"""mx.library — runtime extension loading.
+
+Reference parity: python/mxnet/library.py + include/mxnet/lib_api.h (loading
+.so plugins that register custom operators/passes). TPU-native equivalent:
+extensions are python modules that register custom ops into the op registry
+(mxnet_tpu.ops.registry) — including Pallas kernels and XLA custom calls —
+plus native .so libraries loaded via ctypes for host-side components.
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib
+import os
+
+from .base import MXNetError
+
+_loaded = {}
+
+
+def load(path, verbose=True):
+    """Load an extension.
+
+    - a ``.py`` path or module name: imported; its ``register(registry)``
+      hook, if present, is called with the framework op registry.
+    - a ``.so`` path: loaded via ctypes for host-native components.
+    """
+    if path in _loaded:
+        return _loaded[path]
+    if path.endswith(".so"):
+        if not os.path.exists(path):
+            raise MXNetError(f"extension library not found: {path}")
+        lib = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+        _loaded[path] = lib
+        return lib
+    name = path[:-3].replace("/", ".") if path.endswith(".py") else path
+    mod = importlib.import_module(name)
+    hook = getattr(mod, "register", None)
+    if hook is not None:
+        from .ops import registry
+        hook(registry)
+    _loaded[path] = mod
+    return mod

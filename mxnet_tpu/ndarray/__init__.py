@@ -1,0 +1,41 @@
+"""mx.nd — legacy NDArray namespace.
+
+Reference parity: python/mxnet/ndarray/ (23.7k LoC of generated legacy op
+wrappers). The new framework is numpy-first (like MXNet 2.0 pushes mx.np);
+this module aliases the np implementation and adds the handful of
+legacy-named entry points (mx.nd.array, waitall, save/load, NDArray) so
+MXNet-1.x-style scripts run.
+"""
+from __future__ import annotations
+
+from ..numpy import *  # noqa: F401,F403
+from ..numpy import ndarray as NDArray, array, zeros, ones, full, arange  # noqa: F401
+from ..numpy.multiarray import _wrap, _invoke  # noqa: F401
+from ..numpy import random  # noqa: F401
+from .. import numpy as _np
+
+
+def waitall():
+    from .. import engine
+    engine.wait_all()
+
+
+def save(fname, data):
+    from .. import numpy_extension as npx
+    npx.save(fname, data)
+
+
+def load(fname):
+    from .. import numpy_extension as npx
+    return npx.load(fname)
+
+
+def __getattr__(name):
+    # legacy op names are the np names (plus CamelCase op aliases)
+    try:
+        return getattr(_np, name)
+    except AttributeError:
+        lowered = name.lower()
+        if lowered != name:
+            return getattr(_np, lowered)
+        raise

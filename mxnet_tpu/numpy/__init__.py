@@ -1,0 +1,148 @@
+"""mx.np — NumPy-compatible array namespace.
+
+Reference parity: python/mxnet/numpy/ (multiarray.py + generated op wrappers;
+the reference code-gens a python function per registered op at import via
+ndarray/register.py:115-277, and falls back to real NumPy for missing ops via
+numpy/fallback.py).
+
+TPU-native design: ops lower straight to jax.numpy. Named functions below are
+the explicitly-typed surface; any other NumPy function resolves lazily through
+module ``__getattr__`` to a wrapped ``jnp`` equivalent — the analog of both
+the generated wrappers and the fallback mechanism, with autograd recording and
+async dispatch handled by ``_invoke``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .multiarray import (  # noqa: F401
+    ndarray, array, zeros, ones, empty, full, arange, linspace, logspace, eye,
+    identity, zeros_like, ones_like, full_like, empty_like, fromnumpy,
+    from_dlpack, newaxis, pi, e, inf, nan, euler_gamma, _invoke, _wrap,
+    _wrap_out,
+)
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+
+# dtype objects for parity with `np.float32` style usage
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+bfloat16 = jnp.bfloat16
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+dtype = jnp.dtype
+
+_generated_cache = {}
+
+
+def _make_op(fn, name):
+    @functools.wraps(fn)
+    def op(*args, **kwargs):
+        kwargs.pop("ctx", None)
+        kwargs.pop("device", None)
+        kwargs.pop("out", None)
+        if "dtype" in kwargs:
+            kwargs["dtype"] = np_dtype(kwargs["dtype"])
+        return _invoke(fn, args, kwargs, name=name)
+    op.__name__ = name
+    return op
+
+
+def __getattr__(name):
+    """Lazy op generation (analog of ndarray/register.py _init_op_module +
+    numpy/fallback.py)."""
+    if name.startswith("__"):
+        raise AttributeError(name)
+    if name in _generated_cache:
+        return _generated_cache[name]
+    target = getattr(jnp, name, None)
+    if target is None:
+        target = getattr(jax.nn, name, None)
+    if target is None:
+        raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute {name!r}")
+    if callable(target) and not isinstance(target, type):
+        op = _make_op(target, name)
+        _generated_cache[name] = op
+        globals()[name] = op
+        return op
+    _generated_cache[name] = target
+    return target
+
+
+# -- a few ops whose reference signature differs from jnp -------------------
+
+def concatenate(seq, axis=0, out=None):
+    return _invoke(lambda *xs: jnp.concatenate(xs, axis=axis), tuple(seq),
+                   name="concatenate")
+
+
+concat = concatenate
+
+
+def stack(arrays, axis=0, out=None):
+    return _invoke(lambda *xs: jnp.stack(xs, axis=axis), tuple(arrays),
+                   name="stack")
+
+
+def vstack(arrays):
+    return _invoke(lambda *xs: jnp.vstack(xs), tuple(arrays), name="vstack")
+
+
+def hstack(arrays):
+    return _invoke(lambda *xs: jnp.hstack(xs), tuple(arrays), name="hstack")
+
+
+def dstack(arrays):
+    return _invoke(lambda *xs: jnp.dstack(xs), tuple(arrays), name="dstack")
+
+
+def column_stack(arrays):
+    return _invoke(lambda *xs: jnp.column_stack(xs), tuple(arrays),
+                   name="column_stack")
+
+
+def split(ary, indices_or_sections, axis=0):
+    return _invoke(lambda x: jnp.split(x, indices_or_sections, axis), (ary,),
+                   name="split")
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    return _invoke(lambda x: jnp.array_split(x, indices_or_sections, axis),
+                   (ary,), name="array_split")
+
+
+def meshgrid(*xi, **kwargs):
+    return _invoke(lambda *xs: jnp.meshgrid(*xs, **kwargs), xi, name="meshgrid")
+
+
+def einsum(subscripts, *operands, **kwargs):
+    return _invoke(lambda *xs: jnp.einsum(subscripts, *xs, **kwargs), operands,
+                   name="einsum")
+
+
+def may_share_memory(a, b):
+    return a is b
+
+
+def shares_memory(a, b):
+    return a is b
+
+
+def asarray(obj, dtype=None):
+    return array(obj, dtype=dtype)
+
+
+def asnumpy(a):
+    return a.asnumpy() if isinstance(a, ndarray) else a

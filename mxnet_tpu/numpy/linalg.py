@@ -1,0 +1,25 @@
+"""mx.np.linalg (reference: python/mxnet/numpy/linalg.py over _npi linalg ops).
+
+Lazily wraps jax.numpy.linalg; every function dispatches through _invoke so
+autograd recording and async dispatch apply.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def __getattr__(name):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    target = getattr(jnp.linalg, name, None)
+    if target is None:
+        raise AttributeError(f"linalg has no attribute {name!r}")
+    if callable(target):
+        from .multiarray import _invoke
+
+        def op(*args, **kwargs):
+            return _invoke(target, args, kwargs, name=f"linalg.{name}")
+        op.__name__ = name
+        globals()[name] = op
+        return op
+    return target

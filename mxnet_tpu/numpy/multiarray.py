@@ -1,0 +1,624 @@
+"""mx.np ndarray: the framework's tensor.
+
+Reference parity: python/mxnet/numpy/multiarray.py (class ndarray(NDArray) at
+:272) over include/mxnet/ndarray.h + src/ndarray/ndarray.cc.
+
+TPU-native design: an ndarray wraps a jax.Array. MXNet's Chunk (Storage handle
++ engine var + delayed alloc) maps onto the PJRT buffer a jax.Array owns;
+MXNet's per-array engine variable + version maps onto JAX's async futures —
+dispatch returns immediately, ``wait_to_read`` is ``block_until_ready``, and
+the ``_version`` counter preserves the reference's versioned-var semantics for
+in-place rebinding (``a[:] = ...`` swaps the underlying buffer, same wrapper).
+
+Every op goes through ``_invoke``: unwrap -> jnp/lax primitive -> wrap, and
+when ``autograd.record()`` is active and an input carries a tape entry, the
+op's VJP closure is captured via ``jax.vjp`` (the analog of
+Imperative::RecordOp, src/imperative/imperative.cc:235).
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from .. import engine
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "linspace", "logspace", "eye", "identity", "zeros_like",
+           "ones_like", "full_like", "empty_like", "fromnumpy", "from_dlpack",
+           "newaxis", "pi", "e", "inf", "nan", "euler_gamma"]
+
+newaxis = None
+pi = onp.pi
+e = onp.e
+inf = onp.inf
+nan = onp.nan
+euler_gamma = onp.euler_gamma
+
+
+def _is_inexact(x):
+    return jnp.issubdtype(x.dtype, jnp.inexact)
+
+
+def _wrap(raw, ctx=None):
+    """Wrap a raw jax array into an ndarray without copying."""
+    out = ndarray.__new__(ndarray)
+    out._data = raw
+    out._grad = None
+    out._grad_req = "null"
+    out._entry = None
+    out._version = 0
+    engine._track(raw)
+    return out
+
+
+def _wrap_out(out):
+    """Wrap an op result which may be an array or a pytree of arrays."""
+    if isinstance(out, (jnp.ndarray, jax.Array)):
+        return _wrap(out)
+    if isinstance(out, (tuple, list)):
+        return type(out)(_wrap_out(o) for o in out)
+    return out
+
+
+def _invoke(prim, args, kwargs=None, name=None):
+    """Dispatch one op: the eager hot path.
+
+    Reference analog: FFI glue -> Imperative::Invoke -> Engine::PushAsync
+    (src/imperative/imperative.cc:49-140). Here: jnp call (async PJRT
+    dispatch); under recording additionally capture the VJP with jax.vjp.
+    """
+    kwargs = kwargs or {}
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, ndarray))
+    # differentiable inputs: inexact-dtype ndarrays; others are unwrapped
+    # in place (bool masks / int indices stay concrete for eager indexing).
+    arr_pos, diff_arrays = [], []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, ndarray):
+            if _is_inexact(leaf):
+                arr_pos.append(i)
+                diff_arrays.append(leaf)
+            else:
+                leaves[i] = leaf._data
+
+    def fn(*xs):
+        ls = list(leaves)
+        for p, x in zip(arr_pos, xs):
+            ls[p] = x
+        a, kw = jax.tree_util.tree_unflatten(treedef, ls)
+        return prim(*a, **kw)
+
+    raws = [a._data for a in diff_arrays]
+    recording = (autograd.is_recording()
+                 and any(a._entry is not None for a in diff_arrays))
+    if recording:
+        try:
+            out, vjp_fn = jax.vjp(fn, *raws)
+        except (TypeError, jax.errors.TracerError,
+                jax.errors.ConcretizationTypeError):
+            recording = False
+            out = fn(*raws)
+    else:
+        out = fn(*raws)
+
+    wrapped = _wrap_out(out)
+    if recording:
+        out_leaves = [w for w in jax.tree_util.tree_leaves(
+            wrapped, is_leaf=lambda x: isinstance(x, ndarray))
+            if isinstance(w, ndarray)]
+        autograd._record_op(vjp_fn, diff_arrays, out_leaves,
+                            name or getattr(prim, "__name__", "op"))
+    return wrapped
+
+
+class ndarray:
+    """N-dimensional array on a device (reference: numpy/multiarray.py:272)."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_entry", "_version",
+                 "__weakref__")
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, ndarray):
+            raw = data._data
+        else:
+            raw = jnp.asarray(data, dtype=np_dtype(dtype))
+        if dtype is not None and raw.dtype != np_dtype(dtype):
+            raw = raw.astype(np_dtype(dtype))
+        if ctx is not None:
+            raw = jax.device_put(raw, Context(ctx).jax_device)
+        self._data = raw
+        self._grad = None
+        self._grad_req = "null"
+        self._entry = None
+        self._version = 0
+        engine._track(raw)
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def itemsize(self):
+        return self._data.dtype.itemsize
+
+    @property
+    def T(self):
+        return _invoke(jnp.transpose, (self,))
+
+    @property
+    def ctx(self):
+        """Context of this array (reference: NDArray.ctx)."""
+        dev = None
+        try:
+            dev = list(self._data.devices())[0]
+        except Exception:
+            pass
+        if dev is None or dev.platform == "cpu":
+            return Context("cpu", getattr(dev, "id", 0) or 0)
+        return Context("tpu", dev.id)
+
+    context = ctx
+    device = ctx
+
+    @property
+    def sharding(self):
+        return self._data.sharding
+
+    # -- engine / version semantics ---------------------------------------
+    @property
+    def version(self):
+        """Write-version counter (reference: NDArray::version, ndarray.h:413)."""
+        return self._version
+
+    def wait_to_read(self):
+        """Block until the value is computed (Engine::WaitForVar analog)."""
+        self._data.block_until_ready()
+        return self
+
+    def _rebind(self, raw):
+        """In-place value replacement: same wrapper, new buffer, version+1."""
+        self._data = raw
+        self._version += 1
+        engine._track(raw)
+
+    # -- conversion --------------------------------------------------------
+    def asnumpy(self):
+        return onp.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and self._data.dtype == dt:
+            return self
+        return _invoke(lambda x: x.astype(dt), (self,), name="astype")
+
+    def copy(self):
+        return _invoke(jnp.copy, (self,))
+
+    def copyto(self, other):
+        """Copy value into another array or context (reference:
+        NDArray.copyto / CopyFromTo src/ndarray/ndarray.cc)."""
+        if isinstance(other, ndarray):
+            if other.shape != self.shape:
+                raise MXNetError(f"copyto shape mismatch {self.shape} vs {other.shape}")
+            other._rebind(self._data.astype(other.dtype))
+            return other
+        if isinstance(other, Context):
+            return _wrap(jax.device_put(self._data, other.jax_device))
+        raise TypeError(type(other))
+
+    def as_in_ctx(self, ctx):
+        ctx = Context(ctx)
+        return _wrap(jax.device_put(self._data, ctx.jax_device))
+
+    as_in_context = as_in_ctx
+    to_device = as_in_ctx
+
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        return self
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, stream=None):
+        return self._data.__dlpack__(stream=stream)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write"):
+        """Allocate a gradient buffer and mark as a tape leaf
+        (reference: NDArray.attach_grad / mark_variables)."""
+        grad = _wrap(jnp.zeros(self.shape, self.dtype))
+        self._mark_variable(grad, grad_req)
+
+    def _mark_variable(self, grad, grad_req):
+        self._grad = grad
+        self._grad_req = grad_req
+        self._entry = autograd._Entry(None, 0, weakref.ref(self))
+
+    def _write_grad(self, raw_grad):
+        if self._grad_req == "null" or self._grad is None:
+            return
+        g = raw_grad.astype(self._grad.dtype)
+        if self._grad_req == "add":
+            self._grad._rebind(self._grad._data + g)
+        else:
+            self._grad._rebind(g)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._rebind(jnp.zeros_like(self._grad._data))
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def detach(self):
+        out = _wrap(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad], retain_graph, train_mode)
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, key):
+        key = _unwrap_key(key)
+        return _invoke(lambda x: x[key], (self,), name="getitem")
+
+    def __setitem__(self, key, value):
+        if isinstance(value, ndarray):
+            value = value._data
+        key = _unwrap_key(key)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            new = jnp.broadcast_to(jnp.asarray(value, self.dtype), self.shape)
+        else:
+            new = self._data.at[key].set(jnp.asarray(value).astype(self.dtype))
+        if autograd.is_recording() and self._entry is not None:
+            # functional set: records like any op, entry moves to new version
+            old = self
+            res = _invoke(lambda x, v: jnp.broadcast_to(v, x.shape) if key is Ellipsis
+                          else x.at[key].set(v.astype(x.dtype)),
+                          (self, _wrap(jnp.asarray(value))), name="setitem")
+            self._data = res._data
+            self._entry = res._entry
+            self._version += 1
+            return
+        self._rebind(new)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d array")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __contains__(self, x):
+        return bool((self._data == (x._data if isinstance(x, ndarray) else x)).any())
+
+    # -- python scalar protocol -------------------------------------------
+    def _scalar(self):
+        if self.size != 1:
+            raise TypeError(
+                f"only size-1 arrays convert to python scalars, got {self.shape}")
+        return jax.device_get(self._data).reshape(())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self._scalar())
+        return bool(self._data)  # raises the standard ambiguity error
+
+    def __float__(self):
+        return float(self._scalar())
+
+    def __int__(self):
+        return int(self._scalar())
+
+    def __index__(self):
+        return int(self._scalar())
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        try:
+            return f"array({onp.array2string(self.asnumpy(), separator=', ')}, dtype={self.dtype})"
+        except Exception:
+            return f"ndarray(shape={self.shape}, dtype={self.dtype})"
+
+    # -- arithmetic --------------------------------------------------------
+    def _binop(self, other, fn, reflexive=False):
+        if isinstance(other, (list, tuple, onp.ndarray)):
+            other = _wrap(jnp.asarray(other))
+        if reflexive:
+            return _invoke(fn, (other, self))
+        return _invoke(fn, (self, other))
+
+    def __add__(self, o): return self._binop(o, jnp.add)
+    def __radd__(self, o): return self._binop(o, jnp.add, True)
+    def __sub__(self, o): return self._binop(o, jnp.subtract)
+    def __rsub__(self, o): return self._binop(o, jnp.subtract, True)
+    def __mul__(self, o): return self._binop(o, jnp.multiply)
+    def __rmul__(self, o): return self._binop(o, jnp.multiply, True)
+    def __truediv__(self, o): return self._binop(o, jnp.true_divide)
+    def __rtruediv__(self, o): return self._binop(o, jnp.true_divide, True)
+    def __floordiv__(self, o): return self._binop(o, jnp.floor_divide)
+    def __rfloordiv__(self, o): return self._binop(o, jnp.floor_divide, True)
+    def __mod__(self, o): return self._binop(o, jnp.mod)
+    def __rmod__(self, o): return self._binop(o, jnp.mod, True)
+    def __pow__(self, o): return self._binop(o, jnp.power)
+    def __rpow__(self, o): return self._binop(o, jnp.power, True)
+    def __matmul__(self, o): return self._binop(o, jnp.matmul)
+    def __rmatmul__(self, o): return self._binop(o, jnp.matmul, True)
+    def __neg__(self): return _invoke(jnp.negative, (self,))
+    def __pos__(self): return self
+    def __abs__(self): return _invoke(jnp.abs, (self,))
+    def __invert__(self): return _invoke(jnp.invert, (self,))
+    def __and__(self, o): return self._binop(o, jnp.bitwise_and)
+    def __or__(self, o): return self._binop(o, jnp.bitwise_or)
+    def __xor__(self, o): return self._binop(o, jnp.bitwise_xor)
+    def __lshift__(self, o): return self._binop(o, jnp.left_shift)
+    def __rshift__(self, o): return self._binop(o, jnp.right_shift)
+    def __eq__(self, o): return self._binop(o, jnp.equal)
+    def __ne__(self, o): return self._binop(o, jnp.not_equal)
+    def __lt__(self, o): return self._binop(o, jnp.less)
+    def __le__(self, o): return self._binop(o, jnp.less_equal)
+    def __gt__(self, o): return self._binop(o, jnp.greater)
+    def __ge__(self, o): return self._binop(o, jnp.greater_equal)
+
+    # in-place: rebind the same wrapper (MXNet mutation semantics)
+    def _iop(self, other, fn):
+        res = self._binop(other, fn)
+        self._data = res._data.astype(self.dtype)
+        self._entry = res._entry
+        self._version += 1
+        return self
+
+    def __iadd__(self, o): return self._iop(o, jnp.add)
+    def __isub__(self, o): return self._iop(o, jnp.subtract)
+    def __imul__(self, o): return self._iop(o, jnp.multiply)
+    def __itruediv__(self, o): return self._iop(o, jnp.true_divide)
+    def __ifloordiv__(self, o): return self._iop(o, jnp.floor_divide)
+    def __imod__(self, o): return self._iop(o, jnp.mod)
+    def __ipow__(self, o): return self._iop(o, jnp.power)
+
+    # -- method forms of ops ----------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(-1 if s in (-1,) else int(s) for s in shape)
+        return _invoke(lambda x: jnp.reshape(x, shape), (self,), name="reshape")
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return _invoke(lambda x: jnp.transpose(x, axes), (self,), name="transpose")
+
+    def swapaxes(self, a1, a2):
+        return _invoke(lambda x: jnp.swapaxes(x, a1, a2), (self,))
+
+    def flatten(self):
+        return self.reshape(-1)
+
+    def squeeze(self, axis=None):
+        return _invoke(lambda x: jnp.squeeze(x, axis), (self,))
+
+    def expand_dims(self, axis):
+        return _invoke(lambda x: jnp.expand_dims(x, axis), (self,))
+
+    def repeat(self, repeats, axis=None):
+        return _invoke(lambda x: jnp.repeat(x, repeats, axis), (self,))
+
+    def tile(self, reps):
+        return _invoke(lambda x: jnp.tile(x, reps), (self,))
+
+    def broadcast_to(self, shape):
+        return _invoke(lambda x: jnp.broadcast_to(x, shape), (self,))
+
+    def split(self, indices_or_sections, axis=0):
+        return _invoke(lambda x: jnp.split(x, indices_or_sections, axis), (self,))
+
+    def take(self, indices, axis=None, mode="clip"):
+        idx = indices._data if isinstance(indices, ndarray) else indices
+        return _invoke(lambda x: jnp.take(x, idx, axis, mode=mode), (self,))
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke(lambda x: jnp.clip(x, a_min, a_max), (self,))
+
+    def round(self, decimals=0):
+        return _invoke(lambda x: jnp.round(x, decimals), (self,))
+
+    def _reduce(self, fn, axis=None, keepdims=False, **kw):
+        return _invoke(lambda x: fn(x, axis=axis, keepdims=keepdims, **kw), (self,),
+                       name=fn.__name__)
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return self._reduce(jnp.sum, axis, keepdims, dtype=np_dtype(dtype))
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return self._reduce(jnp.mean, axis, keepdims, dtype=np_dtype(dtype))
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce(jnp.prod, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce(jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce(jnp.min, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False, ddof=0):
+        return self._reduce(jnp.std, axis, keepdims, ddof=ddof)
+
+    def var(self, axis=None, keepdims=False, ddof=0):
+        return self._reduce(jnp.var, axis, keepdims, ddof=ddof)
+
+    def argmax(self, axis=None):
+        return _invoke(lambda x: jnp.argmax(x, axis), (self,))
+
+    def argmin(self, axis=None):
+        return _invoke(lambda x: jnp.argmin(x, axis), (self,))
+
+    def argsort(self, axis=-1):
+        return _invoke(lambda x: jnp.argsort(x, axis), (self,))
+
+    def sort(self, axis=-1):
+        return _invoke(lambda x: jnp.sort(x, axis), (self,))
+
+    def cumsum(self, axis=None, dtype=None):
+        return _invoke(lambda x: jnp.cumsum(x, axis, dtype=np_dtype(dtype)), (self,))
+
+    def dot(self, other):
+        return self._binop(other, jnp.dot)
+
+    def abs(self): return _invoke(jnp.abs, (self,))
+    def exp(self): return _invoke(jnp.exp, (self,))
+    def log(self): return _invoke(jnp.log, (self,))
+    def sqrt(self): return _invoke(jnp.sqrt, (self,))
+    def square(self): return _invoke(jnp.square, (self,))
+    def sigmoid(self): return _invoke(jax.nn.sigmoid, (self,))
+    def tanh(self): return _invoke(jnp.tanh, (self,))
+    def relu(self): return _invoke(jax.nn.relu, (self,))
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage types are emulated as dense on TPU")
+        return self
+
+    @property
+    def stype(self):
+        return "default"
+
+
+def _unwrap_key(key):
+    if isinstance(key, ndarray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(_unwrap_key(k) for k in key)
+    if isinstance(key, list):
+        return onp.asarray(key)
+    return key
+
+
+# ---------------------------------------------------------------------------
+# creation functions (reference: numpy/multiarray.py zeros/ones/... wrappers)
+# ---------------------------------------------------------------------------
+
+def _place(raw, ctx, device):
+    ctx = device if device is not None else ctx
+    if ctx is not None:
+        raw = jax.device_put(raw, Context(ctx).jax_device)
+    return _wrap(raw)
+
+
+def array(obj, dtype=None, ctx=None, device=None):
+    if isinstance(obj, ndarray):
+        obj = obj._data
+    raw = jnp.asarray(obj, dtype=np_dtype(dtype))
+    return _place(raw, ctx, device)
+
+
+def fromnumpy(a):
+    return array(a)
+
+
+def from_dlpack(x):
+    return _wrap(jnp.from_dlpack(x))
+
+
+def empty(shape, dtype=None, ctx=None, device=None, order="C"):
+    return zeros(shape, dtype, ctx, device)
+
+
+def zeros(shape, dtype=None, ctx=None, device=None, order="C"):
+    return _place(jnp.zeros(shape, np_dtype(dtype) or jnp.float32), ctx, device)
+
+
+def ones(shape, dtype=None, ctx=None, device=None, order="C"):
+    return _place(jnp.ones(shape, np_dtype(dtype) or jnp.float32), ctx, device)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None, order="C"):
+    if isinstance(fill_value, ndarray):
+        fill_value = fill_value._data
+    return _place(jnp.full(shape, fill_value, np_dtype(dtype)), ctx, device)
+
+
+def zeros_like(a, dtype=None, ctx=None, device=None):
+    return _invoke(lambda x: jnp.zeros_like(x, np_dtype(dtype)), (a,))
+
+
+def ones_like(a, dtype=None, ctx=None, device=None):
+    return _invoke(lambda x: jnp.ones_like(x, np_dtype(dtype)), (a,))
+
+
+def full_like(a, fill_value, dtype=None, ctx=None, device=None):
+    return _invoke(lambda x: jnp.full_like(x, fill_value, np_dtype(dtype)), (a,))
+
+
+def empty_like(a, dtype=None, ctx=None, device=None):
+    return zeros_like(a, dtype, ctx, device)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return _place(jnp.arange(start, stop, step, np_dtype(dtype)), ctx, device)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    out = jnp.linspace(start, stop, num, endpoint, retstep, np_dtype(dtype), axis)
+    if retstep:
+        return _place(out[0], ctx, device), out[1]
+    return _place(out, ctx, device)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    return _place(jnp.logspace(start, stop, num, endpoint, base,
+                               np_dtype(dtype), axis), ctx, device)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return _place(jnp.eye(N, M, k, np_dtype(dtype) or jnp.float32), ctx, device)
+
+
+def identity(n, dtype=None, ctx=None, device=None):
+    return eye(n, dtype=dtype, ctx=ctx, device=device)

@@ -1,0 +1,177 @@
+"""mx.np.random — global-seed RNG facade over JAX splittable keys.
+
+Reference parity: python/mxnet/numpy/random.py backed by per-device parallel
+RNG resources (src/common/random_generator.h, resource kRandom/kParallelRandom).
+
+TPU-native design: a process-global threefry key (mxnet_tpu.random holds it);
+every sampler splits off a fresh subkey — the analog of the reference's
+resource-managed generator streams, but functional and reproducible under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .multiarray import _wrap, ndarray
+
+
+def _key():
+    from .. import random as _r
+    return _r._next_key()
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _fdt(dtype):
+    return np_dtype(dtype) or jnp.float32
+
+
+def seed(s):
+    from .. import random as _r
+    _r.seed(s)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    low = low._data if isinstance(low, ndarray) else low
+    high = high._data if isinstance(high, ndarray) else high
+    return _wrap(jax.random.uniform(_key(), _shape(size), _fdt(dtype), low, high))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    loc = loc._data if isinstance(loc, ndarray) else loc
+    scale = scale._data if isinstance(scale, ndarray) else scale
+    return _wrap(jax.random.normal(_key(), _shape(size), _fdt(dtype)) * scale + loc)
+
+
+randn_shape = None
+
+
+def randn(*size, dtype=None):
+    return normal(size=size, dtype=dtype)
+
+
+def rand(*size, dtype=None):
+    return uniform(size=size, dtype=dtype)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None, out=None):
+    if high is None:
+        low, high = 0, low
+    return _wrap(jax.random.randint(_key(), _shape(size), low, high,
+                                    np_dtype(dtype) or jnp.int32))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None, out=None):
+    if isinstance(a, ndarray):
+        a = a._data
+    elif isinstance(a, int):
+        a = jnp.arange(a)
+    if p is not None and isinstance(p, ndarray):
+        p = p._data
+    return _wrap(jax.random.choice(_key(), a, _shape(size), replace, p))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference: np.random.shuffle)."""
+    perm = jax.random.permutation(_key(), x.shape[0])
+    x._rebind(x._data[perm])
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return _wrap(jax.random.permutation(_key(), x))
+    return _wrap(jax.random.permutation(_key(), x._data))
+
+
+def multinomial(n, pvals, size=None):
+    if isinstance(pvals, ndarray):
+        pvals = pvals._data
+    pvals = jnp.asarray(pvals)
+    shape = _shape(size)
+    counts = jax.random.multinomial(_key(), n, pvals, shape=shape + pvals.shape if shape else None)
+    return _wrap(counts.astype(jnp.int64) if False else counts)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None):
+    if prob is not None:
+        p = prob._data if isinstance(prob, ndarray) else prob
+    else:
+        lg = logit._data if isinstance(logit, ndarray) else logit
+        p = jax.nn.sigmoid(lg)
+    shape = _shape(size) if size is not None else jnp.shape(p)
+    return _wrap(jax.random.bernoulli(_key(), p, shape).astype(_fdt(dtype)))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    a = shape._data if isinstance(shape, ndarray) else shape
+    sc = scale._data if isinstance(scale, ndarray) else scale
+    sz = _shape(size) if size is not None else jnp.shape(a)
+    return _wrap(jax.random.gamma(_key(), a, sz, _fdt(dtype)) * sc)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    a = a._data if isinstance(a, ndarray) else a
+    b = b._data if isinstance(b, ndarray) else b
+    return _wrap(jax.random.beta(_key(), a, b, _shape(size) or None))
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    sc = scale._data if isinstance(scale, ndarray) else scale
+    return _wrap(jax.random.exponential(_key(), _shape(size), _fdt(dtype)) * sc)
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    lam = lam._data if isinstance(lam, ndarray) else lam
+    return _wrap(jax.random.poisson(_key(), lam, _shape(size) or None))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    return _wrap(jax.random.laplace(_key(), _shape(size), _fdt(dtype))
+                 * (scale._data if isinstance(scale, ndarray) else scale)
+                 + (loc._data if isinstance(loc, ndarray) else loc))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    return _wrap(jax.random.gumbel(_key(), _shape(size), _fdt(dtype))
+                 * (scale._data if isinstance(scale, ndarray) else scale)
+                 + (loc._data if isinstance(loc, ndarray) else loc))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    return _wrap(jnp.exp(jax.random.normal(_key(), _shape(size), _fdt(dtype))
+                         * (sigma._data if isinstance(sigma, ndarray) else sigma)
+                         + (mean._data if isinstance(mean, ndarray) else mean)))
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, device=None):
+    df = df._data if isinstance(df, ndarray) else df
+    return _wrap(jax.random.chisquare(_key(), df, shape=_shape(size) or None))
+
+
+def rayleigh(scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    sc = scale._data if isinstance(scale, ndarray) else scale
+    u = jax.random.uniform(_key(), _shape(size), _fdt(dtype), 1e-7, 1.0)
+    return _wrap(sc * jnp.sqrt(-2.0 * jnp.log(u)))
+
+
+def weibull(a, size=None, ctx=None, device=None, out=None):
+    a = a._data if isinstance(a, ndarray) else a
+    return _wrap(jax.random.weibull_min(_key(), 1.0, a, _shape(size) or None))
+
+
+def pareto(a, size=None, ctx=None, device=None, out=None):
+    a = a._data if isinstance(a, ndarray) else a
+    return _wrap(jax.random.pareto(_key(), a, shape=_shape(size) or None) - 1.0)
+
+
+def power(a, size=None, ctx=None, device=None, out=None):
+    a = a._data if isinstance(a, ndarray) else a
+    u = jax.random.uniform(_key(), _shape(size) or jnp.shape(a))
+    return _wrap(u ** (1.0 / a))

@@ -1,0 +1,9 @@
+"""mxnet_tpu.ops — op registry + TPU kernels.
+
+The analog of src/operator/'s registration layer (NNVM_REGISTER_OP +
+op_attr_types.h attributes): ops register metadata (name, impl, optional
+Pallas kernel) and become visible to mx.np/mx.npx dispatch. Pallas kernels
+live in ops/pallas/ with jnp fallbacks for CPU tests.
+"""
+from . import registry  # noqa: F401
+from . import attention  # noqa: F401

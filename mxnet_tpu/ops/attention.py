@@ -1,0 +1,66 @@
+"""Fused attention dispatch.
+
+Reference parity: src/operator/contrib/transformer.cc:675-828 (interleaved
+matmul attention ops, the reference's fastest attention path).
+
+TPU-native design: a single multi_head_attention entry that routes to the
+Pallas flash-attention kernel on TPU (ops/pallas/flash_attention.py) and to
+an XLA dot_general composition elsewhere — the composition alone already
+fuses well (softmax rides the MXU output), flash-attention additionally
+avoids materializing the (seq, seq) scores in HBM for long sequences.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..numpy.multiarray import _invoke
+
+
+def _reference_attention(q, k, v, heads, mask=None, causal=False, scale=None):
+    """(batch, seq, heads*dim) XLA composition."""
+    b, sq, hd = q.shape
+    sk = k.shape[1]
+    d = hd // heads
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    qh = q.reshape(b, sq, heads, d).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, -jnp.inf)
+    att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", att, vh)
+    return out.transpose(0, 2, 1, 3).reshape(b, sq, heads * d)
+
+
+def _use_pallas():
+    devs = jax.devices()
+    return devs and devs[0].platform in ("tpu", "axon")
+
+
+def multi_head_attention(query, key, value, heads, mask=None, dropout_p=0.0,
+                         causal=False):
+    """Fused MHA on (batch, seq, heads*dim) ndarrays."""
+    use_flash = _use_pallas() and mask is None and dropout_p == 0.0
+
+    def fn(q, k, v):
+        if use_flash:
+            try:
+                from .pallas.flash_attention import flash_attention
+                b, sq, hd = q.shape
+                d = hd // heads
+                qh = q.reshape(b, sq, heads, d).transpose(0, 2, 1, 3)
+                kh = k.reshape(b, k.shape[1], heads, d).transpose(0, 2, 1, 3)
+                vh = v.reshape(b, v.shape[1], heads, d).transpose(0, 2, 1, 3)
+                out = flash_attention(qh, kh, vh, causal=causal)
+                return out.transpose(0, 2, 1, 3).reshape(b, sq, heads * d)
+            except Exception:  # pallas unavailable/shape-unsupported
+                pass
+        m = mask._data if hasattr(mask, "_data") else mask
+        return _reference_attention(q, k, v, heads, m, causal)
+
+    return _invoke(fn, (query, key, value), name="multi_head_attention")

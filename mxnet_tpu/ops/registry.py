@@ -1,0 +1,58 @@
+"""Operator registry.
+
+Reference parity: NNVM_REGISTER_OP + include/mxnet/op_attr_types.h:217-315
+(FCompute/FInferShape/FInferType/FGradient attributes). TPU-native: an op is
+a jnp/lax/Pallas callable; shape/dtype inference is jax.eval_shape (no
+hand-written inference rules needed), gradients come from jax AD. The
+registry exists for: op listing/introspection (mx.np coverage reports),
+custom-op registration (mx.library extensions), and kernel substitution
+(e.g. swapping a Pallas flash-attention in for the jnp composition).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..base import MXNetError
+
+
+class OpInfo:
+    __slots__ = ("name", "fn", "backward_fn", "doc", "source")
+
+    def __init__(self, name, fn, backward_fn=None, doc="", source="builtin"):
+        self.name = name
+        self.fn = fn
+        self.backward_fn = backward_fn
+        self.doc = doc
+        self.source = source
+
+
+_ops = {}
+
+
+def register(name, fn=None, backward_fn=None, doc="", source="custom"):
+    """Register an operator; usable as decorator or call."""
+    def _do(f):
+        _ops[name] = OpInfo(name, f, backward_fn, doc or f.__doc__ or "",
+                            source)
+        return f
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get(name):
+    if name not in _ops:
+        raise MXNetError(f"op {name!r} not registered")
+    return _ops[name]
+
+
+def list_ops():
+    return sorted(_ops)
+
+
+def infer_shape(name, *avals, **kwargs):
+    """Shape/dtype inference via abstract evaluation (replaces the
+    reference's per-op FInferShape/FInferType)."""
+    op = get(name)
+    out = jax.eval_shape(lambda *a: op.fn(*a, **kwargs), *avals)
+    return jax.tree_util.tree_map(lambda s: (s.shape, s.dtype), out)

@@ -1,0 +1,13 @@
+"""mx.optimizer (reference: python/mxnet/optimizer/).
+
+Optimizer registry + the reference's optimizer set. Each update rule is a
+pure jitted function (weight, grad, states, scalar hypers) -> (new weight,
+new states) — the analog of the fused update ops in
+src/operator/optimizer_op.cc (sgd_update, adam_update, lamb_update_phase1/2),
+with XLA doing the fusion that the reference hand-writes in CUDA.
+"""
+from .optimizer import (  # noqa: F401
+    Optimizer, register, create, Updater, get_updater, Test,
+    SGD, SGLD, Signum, NAG, Adam, AdamW, AdaBelief, AdaGrad, AdaDelta,
+    RMSProp, Ftrl, LAMB, LARS, LANS, Nadam, DCASGD,
+)

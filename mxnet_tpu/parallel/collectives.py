@@ -1,0 +1,80 @@
+"""XLA collectives layer.
+
+Reference parity: src/kvstore/comm.h (CommCPU/CommDevice reduce+broadcast),
+comm_tree.h (topology-aware tree allreduce), kvstore_nccl.h, and ps-lite's
+cross-host path — all collapsed into XLA AllReduce/AllGather/ReduceScatter/
+CollectivePermute over mesh axes: ICI within a slice, DCN across slices.
+Topology solving (gpu_topology.h) is the ICI fabric's job; nothing to port.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def allreduce(x, mesh, axis="dp", op="sum"):
+    """AllReduce x (replicated per-device values as a leading-axis stack or a
+    sharded array) over a mesh axis via psum inside shard_map."""
+    reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+               "min": jax.lax.pmin, "mean": jax.lax.pmean}[op]
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis),
+                       out_specs=P(axis))
+    def _ar(v):
+        return reducer(v, axis)
+    return _ar(x)
+
+
+def allgather(x, mesh, axis="dp", tiled=True):
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def _ag(v):
+        return jax.lax.all_gather(v, axis, tiled=tiled)
+    return _ag(x)
+
+
+def reduce_scatter(x, mesh, axis="dp"):
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(axis))
+    def _rs(v):
+        return jax.lax.psum_scatter(v, axis, tiled=True)
+    return _rs(x)
+
+
+def ppermute(x, mesh, axis, perm):
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis),
+                       out_specs=P(axis))
+    def _pp(v):
+        return jax.lax.ppermute(v, axis, perm)
+    return _pp(x)
+
+
+def allreduce_across_processes(x):
+    """Cross-host sum of per-process values (the DCN path of KVStoreDist;
+    jax.distributed replaces the ps-lite scheduler rendezvous).
+
+    Each process contributes its local x; result is the sum over processes,
+    replicated. Implementation: every local device holds x / local_device_count
+    as one shard of a global (n_devices, *shape) array sharded over a 1-d
+    global mesh; a shard_map psum over that axis rides DCN between hosts and
+    ICI within a host.
+    """
+    import numpy as onp
+    devs = jax.devices()
+    n = len(devs)
+    if n == 1 and jax.process_count() == 1:
+        return x
+    mesh = Mesh(onp.array(devs), ("dcn",))
+    local = jax.local_devices()
+    contrib = (x / len(local))[None]
+    shards = [jax.device_put(contrib, d) for d in local]
+    global_arr = jax.make_array_from_single_device_arrays(
+        (n,) + tuple(x.shape), NamedSharding(mesh, P("dcn")), shards)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("dcn"), out_specs=P())
+    def _ar(v):
+        return jax.lax.psum(v, "dcn")
+
+    return _ar(global_arr)[0]

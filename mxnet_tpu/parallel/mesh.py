@@ -1,0 +1,64 @@
+"""Device-mesh helpers.
+
+Reference parity: none — the reference scales via KVStore/ps-lite (SURVEY
+§2.3); on TPU the mesh + GSPMD sharding is the native replacement and also
+unlocks TP/PP/SP the reference lacks.
+
+Axis convention (scaling-book style): 'dp' (data, across ICI or DCN), 'tp'
+(tensor/model), 'pp' (pipeline stages), 'sp' (sequence/context), 'ep'
+(experts). Helpers build meshes over any subset.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+_current = None
+
+
+def make_mesh(axes, devices=None):
+    """Create a Mesh from {'dp': 4, 'tp': 2, ...} (row-major layout so the
+    innermost axis maps to neighboring devices — keeps tp on the fastest ICI
+    links)."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = tuple(axes.keys())
+    sizes = tuple(int(v) for v in axes.values())
+    total = int(onp.prod(sizes))
+    if total > len(devices):
+        raise MXNetError(f"mesh {axes} needs {total} devices, "
+                         f"have {len(devices)}")
+    arr = onp.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def data_parallel_mesh(n=None):
+    devs = jax.devices()
+    n = n or len(devs)
+    return make_mesh({"dp": n}, devs)
+
+
+def set_mesh(mesh):
+    global _current
+    _current = mesh
+    return mesh
+
+
+def current_mesh():
+    return _current
+
+
+def shard(array, mesh, spec):
+    """Place an ndarray/jax array with a PartitionSpec on a mesh."""
+    from ..numpy.multiarray import ndarray, _wrap
+    sharding = NamedSharding(mesh, spec if isinstance(spec, P) else P(*spec))
+    raw = array._data if isinstance(array, ndarray) else array
+    out = jax.device_put(raw, sharding)
+    return _wrap(out) if isinstance(array, ndarray) else out
+
+
+def replicate(array, mesh):
+    return shard(array, mesh, P())

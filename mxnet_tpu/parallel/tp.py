@@ -1,0 +1,58 @@
+"""Tensor-parallel layer sharding rules.
+
+Reference parity: none (the reference has no TP — SURVEY §2.3 marks it a
+build goal since GSPMD gives it nearly free). Megatron-style: column-parallel
+Dense (shard units), row-parallel Dense (shard in_units, psum output) — on a
+mesh, expressed purely as PartitionSpecs on the weight Parameters; XLA
+inserts the all-reduces over ICI.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..gluon.nn import Dense
+from ..gluon.block import HybridBlock
+
+
+def shard_dense_column(dense: Dense, mesh, axis="tp"):
+    """Shard a Dense's units dim over `axis` (weight is (units, in))."""
+    dense.weight.shard(NamedSharding(mesh, P(axis, None)))
+    if dense.bias is not None:
+        dense.bias.shard(NamedSharding(mesh, P(axis)))
+    return dense
+
+
+def shard_dense_row(dense: Dense, mesh, axis="tp"):
+    """Shard a Dense's in_units dim; XLA psums the partial matmul outputs."""
+    dense.weight.shard(NamedSharding(mesh, P(None, axis)))
+    if dense.bias is not None:
+        dense.bias.shard(NamedSharding(mesh, P()))
+    return dense
+
+
+def shard_mlp(proj_in: Dense, proj_out: Dense, mesh, axis="tp"):
+    """Standard Megatron MLP sharding: in=column, out=row → one allreduce."""
+    shard_dense_column(proj_in, mesh, axis)
+    shard_dense_row(proj_out, mesh, axis)
+
+
+def auto_shard_block(block: HybridBlock, mesh, dp_axis="dp", tp_axis=None):
+    """Annotate every initialized Parameter of a block:
+    - replicate small params
+    - if tp_axis given, shard the largest matmul dims Megatron-style
+    (heuristic: alternate column/row over Dense layers in traversal order).
+    """
+    col = True
+    for name, p in block.collect_params().items():
+        if p._data is None:
+            continue
+        if tp_axis and p.shape is not None and len(p.shape) == 2 \
+                and min(p.shape) >= mesh.shape.get(tp_axis, 1) \
+                and max(p.shape) >= 128:
+            spec = P(tp_axis, None) if col else P(None, tp_axis)
+            col = not col
+        else:
+            spec = P()
+        p.shard(NamedSharding(mesh, spec))
+    return block

@@ -1,0 +1,175 @@
+"""mx.profiler.
+
+Reference parity: python/mxnet/profiler.py (:30-360 — set_config/set_state/
+dump, Domain/Task/Counter/Marker/Frame objects) over src/profiler/profiler.h
+(engine-integrated per-op spans, chrome://tracing JSON dump).
+
+TPU-native design: two layers —
+1. Device profiling: jax.profiler start/stop trace (Xprof/libtpu; the
+   TensorBoard-compatible trace the TPU stack provides natively).
+2. Host-side op spans: the eager dispatcher and cached-graph calls can be
+   timed here; dump() writes chrome://tracing JSON like the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+
+from .base import MXNetError
+
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": False, "profile_api": False,
+           "aggregate_stats": False}
+_state = {"running": False, "device_trace_dir": None}
+_events = []
+_lock = threading.Lock()
+
+
+def set_config(**kwargs):
+    """Reference: profiler.py set_config (filename, profile_all, ...)."""
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    """'run' | 'stop' (reference: profiler.py set_state)."""
+    if state == "run":
+        _state["running"] = True
+        tracedir = _config.get("tensorboard_dir")
+        if tracedir:
+            jax.profiler.start_trace(tracedir)
+            _state["device_trace_dir"] = tracedir
+    elif state == "stop":
+        if _state.get("device_trace_dir"):
+            jax.profiler.stop_trace()
+            _state["device_trace_dir"] = None
+        _state["running"] = False
+    else:
+        raise MXNetError(f"unknown profiler state {state!r}")
+
+
+def is_running():
+    return _state["running"]
+
+
+def record_event(name, category, start_us, dur_us, args=None):
+    """Internal hook used by dispatch layers."""
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": start_us, "dur": dur_us, "pid": os.getpid(),
+                        "tid": threading.get_ident(), "args": args or {}})
+
+
+class _Span:
+    def __init__(self, name, category="op"):
+        self.name, self.category = name, category
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        self._jax = jax.profiler.TraceAnnotation(self.name)
+        self._jax.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._jax.__exit__(*exc)
+        if _state["running"]:
+            t1 = time.perf_counter_ns()
+            record_event(self.name, self.category, self._t0 // 1000,
+                         (t1 - self._t0) // 1000)
+
+
+def span(name, category="op"):
+    return _Span(name, category)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (reference: profiler.py dump /
+    Profiler::DumpProfile profiler.h:304)."""
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _config["filename"]
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noqa: A002
+    """Aggregate text stats (reference: profiler.py dumps)."""
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    agg = {}
+    for e in events:
+        a = agg.setdefault(e["name"], [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += e["dur"] / 1000.0
+        a[2] = max(a[2], e["dur"] / 1000.0)
+    lines = [f"{'Name':40s} {'Calls':>8s} {'Total(ms)':>12s} {'Max(ms)':>10s}"]
+    for name, (calls, total, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:40.40s} {calls:8d} {total:12.3f} {mx:10.3f}")
+    return "\n".join(lines)
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+# -- structured objects (reference: profiler.py Domain/Task/Counter/Marker) --
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.domain, self.name = domain, name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter_ns()
+
+    def stop(self):
+        if self._t0 is not None:
+            record_event(self.name, f"task:{self.domain.name}",
+                         self._t0 // 1000,
+                         (time.perf_counter_ns() - self._t0) // 1000)
+
+
+Frame = Task
+
+
+class Counter:
+    def __init__(self, domain, name, value=0):
+        self.domain, self.name, self.value = domain, name, value
+
+    def set_value(self, value):
+        self.value = value
+        record_event(self.name, f"counter:{self.domain.name}",
+                     time.perf_counter_ns() // 1000, 0,
+                     {"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain, self.name = domain, name
+
+    def mark(self, scope="process"):
+        record_event(self.name, f"marker:{self.domain.name}",
+                     time.perf_counter_ns() // 1000, 0)

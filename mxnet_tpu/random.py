@@ -1,0 +1,74 @@
+"""mx.random — global seed facade over JAX splittable keys.
+
+Reference parity: python/mxnet/random.py (mx.random.seed seeds per-device
+kRandom/kParallelRandom resources, src/resource.cc). TPU-native design: one
+process-global threefry key; `_next_key()` splits a fresh subkey per sampler
+call. Seeding is therefore exactly reproducible, like the reference's
+seed_state, while staying functional underneath.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import get_env
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(get_env("MXNET_SEED", 0, int))
+_trace = threading.local()
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (reference: random.py seed(seed_state, ctx))."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+
+
+def _next_key():
+    # Inside a hybridized trace, keys split from the traced per-call key so
+    # each compiled invocation gets fresh randomness (dropout etc.).
+    stack = getattr(_trace, "stack", None)
+    if stack:
+        cur = stack[-1]
+        nxt, sub = jax.random.split(cur)
+        stack[-1] = nxt
+        return sub
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+class trace_key_scope:
+    """Scope installing a (possibly traced) base key for _next_key splits.
+    Used by the hybridize cache so compiled programs take randomness as an
+    input instead of baking a constant key into the executable."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        if not hasattr(_trace, "stack"):
+            _trace.stack = []
+        _trace.stack.append(self._key)
+        return self
+
+    def __exit__(self, *exc):
+        _trace.stack.pop()
+
+
+def key(n=None):
+    """Expose raw JAX keys for native-jax interop."""
+    if n is None:
+        return _next_key()
+    return jax.random.split(_next_key(), n)
+
+
+# legacy mx.random.* samplers alias the np.random implementations
+def __getattr__(name):
+    from .numpy import random as npr
+    if hasattr(npr, name):
+        return getattr(npr, name)
+    raise AttributeError(name)

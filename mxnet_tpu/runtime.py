@@ -1,0 +1,58 @@
+"""mx.runtime — feature detection.
+
+Reference parity: python/mxnet/runtime.py over src/libinfo.cc:37-90 (compiled
+feature flags like CUDA/CUDNN/MKLDNN/DIST_KVSTORE surfaced at runtime). Here
+the features describe the JAX/XLA backend actually present in the process.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self._enabled = enabled
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self._enabled else '✖'} {self.name}]"
+
+
+def feature_list():
+    devs = jax.devices()
+    accel = bool(devs) and devs[0].platform != "cpu"
+    feats = {
+        "TPU": accel and devs[0].platform in ("tpu", "axon"),
+        "XLA": True,
+        "PALLAS": accel,
+        "CPU": True,
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "MKLDNN": False,
+        "OPENMP": False,
+        "DIST_KVSTORE": True,        # mesh collectives over ICI/DCN
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": False,
+        "F16C": True,
+        "BF16": True,
+    }
+    return [Feature(k, v) for k, v in feats.items()]
+
+
+class Features(dict):
+    instance = None
+
+    def __init__(self):
+        super().__init__([(f.name, f) for f in feature_list()])
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def libinfo_features():
+    return feature_list()

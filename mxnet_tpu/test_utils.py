@@ -1,0 +1,178 @@
+"""mx.test_utils.
+
+Reference parity: python/mxnet/test_utils.py — assert_almost_equal (:656,
+dtype-aware tolerances), check_numeric_gradient (:1044 finite differences),
+check_consistency (:1491 cross-device oracle), environment helpers. These
+are the kernel-correctness oracles the whole reference test suite leans on
+(SURVEY §4); the TPU analog of check_consistency runs the same function on
+cpu and the accelerator backend.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as onp
+
+from .base import MXNetError
+from .numpy.multiarray import ndarray
+
+_DTYPE_TOL = {
+    onp.dtype("float16"): (1e-2, 1e-2),
+    onp.dtype("float32"): (1e-4, 1e-5),
+    onp.dtype("float64"): (1e-6, 1e-8),
+}
+
+
+def default_rtol_atol(*arrays):
+    rtol, atol = 1e-5, 1e-7
+    for a in arrays:
+        dt = onp.dtype(str(a.dtype)) if str(a.dtype) != "bfloat16" else None
+        if dt is None:
+            return (1e-2, 1e-2)
+        if dt in _DTYPE_TOL:
+            r, t = _DTYPE_TOL[dt]
+            rtol, atol = max(rtol, r), max(atol, t)
+    return rtol, atol
+
+
+def _to_np(a):
+    if isinstance(a, ndarray):
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Reference: test_utils.py:656."""
+    a_np, b_np = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        r, t = default_rtol_atol(a_np if not hasattr(a, "dtype") else a,
+                                 b_np if not hasattr(b, "dtype") else b)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    onp.testing.assert_allclose(a_np.astype(onp.float64),
+                                b_np.astype(onp.float64),
+                                rtol=rtol, atol=atol, equal_nan=equal_nan,
+                                err_msg=f"{names[0]} vs {names[1]}")
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def check_numeric_gradient(f, inputs, grads=None, eps=1e-3, rtol=1e-2,
+                           atol=1e-4):
+    """Finite-difference gradient check (reference: test_utils.py:1044).
+
+    f: callable(list of ndarrays) -> scalar ndarray. inputs: list of
+    ndarrays with attach_grad() to compare against; if grads is given, it is
+    the list of analytic grads instead.
+    """
+    from . import autograd
+    from .numpy import array
+
+    if grads is None:
+        for x in inputs:
+            x.attach_grad()
+        with autograd.record():
+            out = f(inputs)
+        out.backward()
+        grads = [x.grad.asnumpy() for x in inputs]
+
+    for xi, x in enumerate(inputs):
+        base = x.asnumpy().astype(onp.float64)
+        num_grad = onp.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            xs = list(inputs)
+            xs[xi] = array(base.reshape(x.shape).astype(onp.float32))
+            fp = float(f(xs).asnumpy().sum())
+            flat[i] = orig - eps
+            xs[xi] = array(base.reshape(x.shape).astype(onp.float32))
+            fm = float(f(xs).asnumpy().sum())
+            flat[i] = orig
+            ng_flat[i] = (fp - fm) / (2 * eps)
+        onp.testing.assert_allclose(grads[xi], num_grad, rtol=rtol, atol=atol,
+                                    err_msg=f"input {xi} gradient mismatch")
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
+    """Run fn on several backends and compare (reference: test_utils.py:1491
+    — the cross-device kernel oracle). ctx_list defaults to [cpu, default]."""
+    import jax
+    from .numpy import array
+    results = []
+    platforms = ["cpu"]
+    if jax.devices()[0].platform != "cpu":
+        platforms.append(jax.devices()[0].platform)
+    for plat in platforms:
+        dev = jax.devices(plat)[0] if plat != "axon" else jax.devices()[0]
+        placed = [array(x.asnumpy() if isinstance(x, ndarray) else x)
+                  for x in inputs]
+        with jax.default_device(dev):
+            results.append(fn(placed))
+    for r in results[1:]:
+        assert_almost_equal(results[0], r, rtol, atol)
+    return results
+
+
+@contextlib.contextmanager
+def environment(*args):
+    """Scoped env vars (reference: test_utils.py environment)."""
+    if len(args) == 2:
+        updates = {args[0]: args[1]}
+    else:
+        updates = args[0]
+    old = {k: os.environ.get(k) for k in updates}
+    try:
+        for k, v in updates.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def rand_ndarray(shape, dtype="float32", scale=1.0):
+    from .numpy import random as npr
+    return npr.uniform(-scale, scale, size=shape, dtype=dtype)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def same(a, b):
+    return onp.array_equal(_to_np(a), _to_np(b))
+
+
+def effective_dtype(x):
+    return x.dtype
+
+
+def default_context():
+    from .context import current_context
+    return current_context()
+
+
+def set_default_context(ctx):
+    ctx.__enter__()
+
+
+def list_gpus():
+    from .context import num_gpus
+    return list(range(num_gpus()))

@@ -1,0 +1,85 @@
+"""mx.util — numpy-mode scopes and misc helpers.
+
+Reference parity: python/mxnet/util.py (np_shape/np_array scopes, use_np
+decorators, getenv wrappers). The new framework always has numpy semantics,
+so the scopes are identity context managers kept for API compatibility.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+from .base import get_env  # noqa: F401
+
+
+def is_np_shape():
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def is_np_default_dtype():
+    return False
+
+
+@contextlib.contextmanager
+def np_shape(active=True):
+    yield active
+
+
+@contextlib.contextmanager
+def np_array(active=True):
+    yield active
+
+
+def use_np_shape(func):
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    return func
+
+
+def use_np_default_dtype(func):
+    return func
+
+
+def set_np(shape=True, array=True, dtype=False):
+    pass
+
+
+def reset_np():
+    pass
+
+
+def wrap_np_unary_func(func):
+    return func
+
+
+def wrap_np_binary_func(func):
+    return func
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .numpy import array
+    return array(source_array, dtype=dtype, ctx=ctx)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    import jax
+    try:
+        stats = jax.devices()[gpu_dev_id].memory_stats()
+        return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
+    except Exception:
+        return (0, 0)

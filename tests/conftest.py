@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Mirrors the reference's tests/python/unittest/conftest.py (seed control +
+repro logging) plus the TPU-CI trick from SURVEY §4: tests run on a virtual
+8-device CPU mesh (xla_force_host_platform_device_count) so sharding/
+collective paths are exercised without TPU hardware.
+"""
+import os
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything(request):
+    seed = int(os.environ.get("MXNET_TEST_SEED", 17))
+    onp.random.seed(seed)
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+    yield

