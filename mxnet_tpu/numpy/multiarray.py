@@ -354,6 +354,12 @@ class ndarray:
     def __hash__(self):
         return id(self)
 
+    def __reduce__(self):
+        # pickle as host numpy (DataLoader workers, Trainer state dumps);
+        # the reference pickles NDArrays via shared memory (dataloader.py:28)
+        # — device buffers always round-trip through host here
+        return (_from_numpy_reduce, (self.asnumpy(),))
+
     def __repr__(self):
         try:
             return f"array({onp.array2string(self.asnumpy(), separator=', ')}, dtype={self.dtype})"
@@ -526,6 +532,10 @@ class ndarray:
     @property
     def stype(self):
         return "default"
+
+
+def _from_numpy_reduce(arr):
+    return _wrap(jnp.asarray(arr))
 
 
 def _unwrap_key(key):

@@ -263,11 +263,13 @@ def deconvolution(data=None, weight=None, bias=None, kernel=None, stride=None,
                                         (layout, "IO" + spatial, layout))
         k = [(w.shape[2 + i] - 1) * dilate[i] + 1 for i in range(nd)]
         padding = [(k[i] - 1 - pad[i], k[i] - 1 - pad[i]) for i in range(nd)]
+        # transposed conv = fractionally-strided conv with spatially-flipped
+        # kernel read as (I, O, spatial)
+        w_flipped = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
         out = lax.conv_general_dilated(
-            x, w, window_strides=(1,) * nd, padding=padding,
+            x, w_flipped, window_strides=(1,) * nd, padding=padding,
             lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
-            feature_group_count=num_group,
-            transpose_kernel=True)
+            feature_group_count=num_group)
         if b is not None:
             shape = [1] * out.ndim
             shape[layout.index("C")] = b.shape[0]

@@ -584,14 +584,18 @@ class Updater:
                                               self.states[index])
 
     def get_states(self, dump_optimizer=False):
+        import copy
         import pickle
         serial = {}
         for k, s in self.states.items():
             serial[k] = jax.tree_util.tree_map(
                 lambda a: a.asnumpy() if isinstance(a, ndarray) else a, s,
                 is_leaf=lambda a: isinstance(a, ndarray))
-        return pickle.dumps((serial, self.optimizer) if dump_optimizer
-                            else serial)
+        if dump_optimizer:
+            opt_copy = copy.copy(self.optimizer)
+            opt_copy.param_dict = {}  # live Parameters aren't serialized
+            return pickle.dumps((serial, opt_copy))
+        return pickle.dumps(serial)
 
     def set_states(self, states):
         import pickle

@@ -7,8 +7,11 @@ collective paths are exercised without TPU hardware.
 """
 import os
 
-# must be set before jax import
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# must be set before jax import. MXNET_TEST_DEVICE=tpu opts into running the
+# suite on real hardware (the reference's test_operator_gpu.py pattern);
+# default is the 8-virtual-device CPU mesh for determinism + sharding tests.
+if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
@@ -16,6 +19,15 @@ if "host_platform_device_count" not in flags:
 
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
+
+import jax  # noqa: E402
+
+if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
+    # the axon TPU plugin pins JAX_PLATFORMS=axon in the kernel env; the
+    # config update (pre-backend-init) reliably forces the CPU mesh
+    jax.config.update("jax_platforms", "cpu")
+# numpy-oracle tests need true-f32 matmuls (TPU MXU defaults to bf16 passes)
+jax.config.update("jax_default_matmul_precision", "float32")
 
 
 @pytest.fixture(autouse=True)

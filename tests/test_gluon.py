@@ -1,0 +1,293 @@
+"""Gluon Block/HybridBlock/Parameter (reference: tests/python/unittest/
+test_gluon.py — incl. the implicit eager-vs-hybridized equivalence checks)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = np.random.uniform(size=(2, 3))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert_almost_equal(out, x.asnumpy() @ w.T + b, rtol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(4)
+    layer.initialize()
+    x = np.random.uniform(size=(5, 7))
+    out = layer(x)
+    assert out.shape == (5, 4)
+    assert layer.weight.shape == (4, 7)
+
+
+def test_collect_params_names():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    params = net.collect_params()
+    assert set(params) == {"0.weight", "0.bias", "1.weight", "1.bias"}
+    sel = net.collect_params(".*weight")
+    assert set(sel) == {"0.weight", "1.weight"}
+
+
+def test_sequential_forward():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=4), nn.Dense(3, in_units=8))
+    net.initialize()
+    x = np.random.uniform(size=(2, 4))
+    assert net(x).shape == (2, 3)
+
+
+def test_hybridize_equivalence():
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="tanh", in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    x = np.random.uniform(size=(3, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the executable cache
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(hybrid, hybrid2)
+
+
+def test_hybridize_grad():
+    net = nn.Dense(1, in_units=3)
+    net.initialize()
+    x = np.array([[1.0, 2.0, 3.0]])
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    g_eager = net.weight.grad().asnumpy()
+
+    net.hybridize()
+    net.zero_grad()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    g_hybrid = net.weight.grad().asnumpy()
+    assert_almost_equal(g_eager, g_hybrid, rtol=1e-5)
+    assert_almost_equal(g_eager, onp.tile(x.asnumpy(), (1, 1)), rtol=1e-5)
+
+
+def test_conv2d():
+    layer = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    layer.initialize()
+    x = np.random.uniform(size=(2, 3, 16, 16))
+    out = layer(x)
+    assert out.shape == (2, 8, 16, 16)
+    layer_s = nn.Conv2D(4, kernel_size=3, strides=2)
+    layer_s.initialize()
+    assert layer_s(x).shape == (2, 4, 7, 7)
+
+
+def test_conv_grouped_dilated():
+    layer = nn.Conv2D(6, kernel_size=3, groups=3, dilation=2, in_channels=3)
+    layer.initialize()
+    x = np.random.uniform(size=(1, 3, 12, 12))
+    assert layer(x).shape == (1, 6, 8, 8)
+
+
+def test_conv_transpose():
+    layer = nn.Conv2DTranspose(4, kernel_size=2, strides=2, in_channels=3)
+    layer.initialize()
+    x = np.random.uniform(size=(1, 3, 8, 8))
+    assert layer(x).shape == (1, 4, 16, 16)
+
+
+def test_pooling():
+    x = np.random.uniform(size=(1, 2, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (1, 2, 1, 1)
+    mp = nn.MaxPool2D(3, 2, 1)(x)
+    assert mp.shape == (1, 2, 4, 4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = np.random.uniform(1, 3, size=(8, 4, 5, 5))
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        out = bn(x)
+    # training: batch stats used, running stats updated
+    assert not onp.allclose(bn.running_mean.data().asnumpy(), rm0)
+    assert abs(float(out.mean())) < 0.2
+    # eval mode: running stats used
+    out_eval = bn(x)
+    assert out_eval.shape == x.shape
+
+
+def test_batchnorm_hybrid_aux_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    bn.hybridize()
+    x = np.random.uniform(1, 2, size=(4, 3, 2, 2))
+    _ = bn(x)  # first (eager path for deferred init)
+    rm_before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        _ = bn(x)
+    rm_after = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(rm_before, rm_after)
+
+
+def test_layernorm_groupnorm():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = np.random.uniform(size=(4, 6))
+    out = ln(x)
+    assert_almost_equal(out.asnumpy().mean(axis=-1), onp.zeros(4), atol=1e-5)
+    gn = nn.GroupNorm(num_groups=2, in_channels=4)
+    gn.initialize()
+    y = np.random.uniform(size=(2, 4, 3, 3))
+    assert gn(y).shape == (2, 4, 3, 3)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = np.array([[1, 2], [3, 4]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+
+
+def test_dropout():
+    do = nn.Dropout(0.5)
+    x = np.ones((100, 100))
+    out_eval = do(x)
+    assert_almost_equal(out_eval, x)  # identity outside training
+    with autograd.record():
+        out_train = do(x)
+    frac_zero = float((out_train == 0).sum()) / out_train.size
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_activation_blocks():
+    x = np.array([-1.0, 0.0, 1.0])
+    assert_almost_equal(nn.Activation("relu")(x), onp.array([0, 0, 1.0]))
+    assert nn.LeakyReLU(0.1)(x).asnumpy()[0] == pytest.approx(-0.1)
+    assert nn.ELU()(x).shape == (3,)
+    assert nn.SELU()(x).shape == (3,)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    assert prelu(x).shape == (3,)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    path = str(tmp_path / "net.params")
+    net.save_parameters(path)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(path)
+    x = np.random.uniform(size=(2, 3))
+    assert_almost_equal(net(x), net2(x))
+
+
+def test_share_parameters():
+    a = nn.Dense(4, in_units=3)
+    b = nn.Dense(4, in_units=3)
+    a.initialize()
+    b.share_parameters(a.collect_params())
+    x = np.random.uniform(size=(1, 3))
+    assert_almost_equal(a(x), b(x))
+
+
+def test_cast():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == onp.float16
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+    pred = np.array([[1.0, 2.0], [3.0, 4.0]])
+    label = np.array([[1.5, 2.5], [2.0, 3.0]])
+    l2 = gloss.L2Loss()(pred, label)
+    assert_almost_equal(l2, onp.array([0.125, 0.5]))
+    l1 = gloss.L1Loss()(pred, label)
+    assert_almost_equal(l1, onp.array([0.5, 1.0]))
+
+    logits = np.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+    lbl = np.array([0, 1])
+    ce = gloss.SoftmaxCrossEntropyLoss()(logits, lbl)
+    assert float(ce.sum()) < 0.01
+    h = gloss.HuberLoss()(pred, label)
+    assert h.shape == (2,)
+    sbce = gloss.SigmoidBinaryCrossEntropyLoss()(pred, np.ones((2, 2)))
+    assert sbce.shape == (2,)
+
+
+def test_loss_backward():
+    from mxnet_tpu.gluon import loss as gloss
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = np.random.uniform(size=(5, 4))
+    y = np.array([0, 1, 2, 0, 1])
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        l = lossfn(net(x), y).mean()
+    l.backward()
+    g = net.weight.grad().asnumpy()
+    assert g.shape == (3, 4)
+    assert onp.abs(g).sum() > 0
+
+
+def test_metrics():
+    from mxnet_tpu.gluon import metric
+    acc = metric.Accuracy()
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = np.array([1, 0, 0])
+    acc.update([label], [pred])
+    assert acc.get()[1] == pytest.approx(2.0 / 3.0)
+    mse = metric.MSE()
+    mse.update([np.zeros(4)], [np.ones(4)])
+    assert mse.get()[1] == pytest.approx(1.0)
+    comp = metric.CompositeEvalMetric([metric.Accuracy(), metric.MSE()])
+    assert len(comp.get()[0]) == 2
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary()
+    out = capsys.readouterr().out
+    assert "Total params" in out
+
+
+class _ExportNet(gluon.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Dense(4, in_units=3)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def test_hybrid_export_import(tmp_path):
+    net = _ExportNet()
+    net.initialize()
+    net.hybridize()
+    x = np.ones((1, 3))
+    y0 = net(x)
+    sym_file, param_file = net.export(str(tmp_path / "model"))
+    net2 = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    assert_almost_equal(y0, net2(x))

@@ -1,0 +1,207 @@
+"""Optimizers + Trainer + KVStore (reference: tests/python/unittest/
+test_optimizer.py, test_kvstore.py, gluon Trainer tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np, optimizer as opt
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _quadratic_min(optimizer, steps=150, **kwargs):
+    """Minimize ||x - t||^2; returns final distance."""
+    target = onp.array([1.0, -2.0, 3.0], dtype=onp.float32)
+    x = np.array([0.0, 0.0, 0.0])
+    x.attach_grad()
+    o = optimizer
+    state = o.create_state(0, x)
+    for _ in range(steps):
+        with autograd.record():
+            loss = ((x - np.array(target)) ** 2).sum()
+        loss.backward()
+        o.update(0, x, x.grad, state)
+    return float(onp.abs(x.asnumpy() - target).max())
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.2}),
+    ("adamw", {"learning_rate": 0.2}),
+    ("adabelief", {"learning_rate": 0.2}),
+    ("nadam", {"learning_rate": 0.2}),
+    ("adagrad", {"learning_rate": 0.5}),
+    ("adadelta", {"learning_rate": 1.0, "rho": 0.9}),
+    ("rmsprop", {"learning_rate": 0.05}),
+    ("ftrl", {"learning_rate": 0.5}),
+    # lamb/lans step magnitude is lr*||w|| (trust ratio): small lr to settle
+    ("lamb", {"learning_rate": 0.02}),
+    ("lans", {"learning_rate": 0.02}),
+    # lars scales steps by eta*||w||/||g||: toy problem needs a big lr/eta
+    ("lars", {"learning_rate": 1.0, "momentum": 0.5, "eta": 0.1}),
+    ("signum", {"learning_rate": 0.01}),
+])
+def test_optimizer_converges(name, kwargs):
+    o = opt.create(name, **kwargs)
+    # adadelta's effective lr ramps from ~0 (accumulator warmup): more steps
+    steps = {"adadelta": 800, "lamb": 500, "lans": 500,
+             "signum": 600}.get(name, 150)
+    final = _quadratic_min(o, steps=steps)
+    assert final < 0.25, f"{name} did not converge: {final}"
+
+
+def test_sgd_matches_reference_formula():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    w = np.array([1.0])
+    g = np.array([0.5])
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    # mom = 0.9*0 - 0.1*(0.5 + 0.01*1); w += mom
+    expected = 1.0 - 0.1 * (0.5 + 0.01)
+    assert float(w) == pytest.approx(expected, rel=1e-5)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, CosineScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(25) == pytest.approx(0.25)
+    c = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert c(0) == pytest.approx(1.0)
+    assert c(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_multi_precision():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = np.array([1.0, 2.0], dtype="float16")
+    g = np.array([0.1, 0.1], dtype="float16")
+    state = o.create_state_multi_precision(0, w)
+    master, _ = state
+    assert master.dtype == onp.float32
+    o.update_multi_precision(0, w, g, state)
+    assert w.dtype == onp.float16
+
+
+def test_trainer_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    trainer.step(batch_size=2)
+    w_after = net.weight.data().asnumpy()
+    expected = w_before - 0.1 * x.asnumpy().sum(axis=0) / 2
+    assert_almost_equal(w_after, expected, rtol=1e-4)
+
+
+def test_trainer_lr():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.1)
+    assert trainer.learning_rate == 0.1
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = np.ones((1, 2))
+    with autograd.record():
+        net(x).sum().backward()
+    trainer.step(1)
+    path = str(tmp_path / "trainer.states")
+    trainer.save_states(path)
+    trainer.load_states(path)
+
+
+def test_kvstore_basic():
+    kv = mx.kv.create("local")
+    kv.init("w", np.ones((2, 2)))
+    out = np.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, onp.ones((2, 2)))
+    kv.push("w", [np.ones((2, 2)), np.ones((2, 2))])
+    kv.pull("w", out=out)
+    assert_almost_equal(out, onp.full((2, 2), 2.0))
+
+
+def test_kvstore_pushpull():
+    kv = mx.kv.create("device")
+    kv.init(3, np.zeros(4))
+    vals = [np.ones(4) * i for i in range(1, 4)]
+    out = np.zeros(4)
+    kv.pushpull(3, vals, out=out)
+    assert_almost_equal(out, onp.full(4, 6.0))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.init(0, np.zeros(2))
+
+    def updater(key, grad, weight):
+        weight._rebind(weight._data + 2 * grad._data)
+    kv.set_updater(updater)
+    kv.push(0, [np.ones(2)])
+    out = np.zeros(2)
+    kv.pull(0, out=out)
+    assert_almost_equal(out, onp.full(2, 2.0))
+
+
+def test_kvstore_optimizer_on_store():
+    kv = mx.kv.create("device")
+    kv.init(0, np.ones(3))
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    kv.push(0, [np.ones(3)])
+    out = np.zeros(3)
+    kv.pull(0, out=out)
+    assert_almost_equal(out, onp.full(3, 0.9), rtol=1e-5)
+
+
+def test_kvstore_str_and_list_keys():
+    kv = mx.kv.create("local")
+    kv.init(["a", "b"], [np.ones(2), np.zeros(2)])
+    outs = [np.zeros(2), np.ones(2)]
+    kv.pull(["a", "b"], out=outs)
+    assert_almost_equal(outs[0], onp.ones(2))
+    assert_almost_equal(outs[1], onp.zeros(2))
+
+
+def test_kvstore_broadcast():
+    kv = mx.kv.create("device")
+    outs = [np.zeros(3), np.zeros(3)]
+    kv.broadcast("p", np.full(3, 5.0), out=outs)
+    for o in outs:
+        assert_almost_equal(o, onp.full(3, 5.0))
+
+
+def test_trainer_update_on_kvstore():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1},
+                            update_on_kvstore=True)
+    x = np.ones((2, 2))
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        net(x).sum().backward()
+    trainer.step(2)
+    assert not onp.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_dist_kvstore_single_process():
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 1
+    kv.init(0, np.ones(2))
+    out = np.zeros(2)
+    kv.pushpull(0, [np.ones(2)], out=out)
+    assert_almost_equal(out, onp.ones(2))

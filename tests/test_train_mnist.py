@@ -1,0 +1,70 @@
+"""End-to-end convergence smoke test — the SURVEY §7 stage-4 milestone:
+Gluon LeNet on (synthetic) MNIST, eager + hybridized, DataLoader + Trainer.
+Reference: tests/python/train/test_autograd.py (trains MNIST MLP, asserts
+accuracy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(8, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(2, 2),
+        nn.Conv2D(16, kernel_size=3, activation="relu"),
+        nn.MaxPool2D(2, 2),
+        nn.Flatten(),
+        nn.Dense(64, activation="relu"),
+        nn.Dense(10),
+    )
+    return net
+
+
+def _train(hybridize, epochs=3, n=1024):
+    mx.random.seed(0)
+    onp.random.seed(0)
+    dataset = gluon.data.vision.MNIST(train=True).take(n)
+    transform = transforms.Compose([transforms.ToTensor()])
+    dataset = dataset.transform_first(lambda x: transform(x))
+    loader = gluon.data.DataLoader(dataset, batch_size=64, shuffle=True)
+
+    net = _lenet()
+    net.initialize(mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+    for _ in range(epochs):
+        metric.reset()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+    return metric.get()[1], net
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_lenet_mnist_converges(hybridize):
+    acc, _ = _train(hybridize)
+    assert acc > 0.75, f"accuracy too low: {acc}"
+
+
+def test_eager_hybrid_same_predictions():
+    mx.random.seed(3)
+    net = _lenet()
+    net.initialize()
+    x = np.random.uniform(size=(4, 1, 28, 28))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
