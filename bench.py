@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 training throughput on one chip.
+
+Matches the reference's headline row (BASELINE.md: ResNet-50 training,
+bs=32, V100 = 298.51 img/s, from docs/.../perf.md:243-254). Full training
+step — forward, backward, SGD-momentum update, BatchNorm stat threading —
+as one donated jitted XLA program.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMG_S = 298.51  # reference V100 bs=32 training (BASELINE.md)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import functional
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    platform = jax.devices()[0].platform
+    bs = 32 if platform != "cpu" else 8
+    size = 224 if platform != "cpu" else 64
+    nclass = 1000
+
+    net = resnet50_v1(classes=nclass)
+    net.initialize()
+    net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
+    trainable, aux = functional.split_params(net)
+    momenta = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+    lr, mom = 0.05, 0.9
+
+    def train_step(trainable, aux, momenta, x, y):
+        def loss_fn(tr):
+            logits, mutated = functional.functional_call(
+                net, {**tr, **aux}, x, train=True)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+            return loss, mutated
+        (loss, mutated), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        momenta = jax.tree_util.tree_map(
+            lambda m, g: mom * m + g, momenta, grads)
+        trainable = jax.tree_util.tree_map(
+            lambda w, m: w - lr * m, trainable, momenta)
+        return trainable, {**aux, **mutated}, momenta, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (bs, 3, size, size), jnp.float32)
+    y = jax.random.randint(key, (bs,), 0, nclass)
+
+    # warmup (compile)
+    for _ in range(3):
+        trainable, aux, momenta, loss = step(trainable, aux, momenta, x, y)
+    loss.block_until_ready()
+
+    iters = 20 if platform != "cpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        trainable, aux, momenta, loss = step(trainable, aux, momenta, x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = bs * iters / dt
+    print(json.dumps({
+        "metric": f"resnet50_train_img_per_sec_bs{bs}_{platform}",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Functional (pure) execution of Gluon blocks.
+
+Reference parity: this is the TPU-native replacement for binding an NNVM
+graph's inputs to NDArrays before CachedOp execution
+(src/imperative/cached_op.cc:384-445 StaticAllocMemory binds the memory
+plan; python/mxnet/gluon/block.py:1223 _call_cached_op passes params as
+inputs). In JAX terms: a Block's forward becomes a pure function of
+``(param dict, inputs)`` so it can be jit/pjit/grad-transformed — the basis
+for `__graft_entry__`, the sharded training step in
+``mxnet_tpu.parallel.train``, and AOT export.
+"""
+from __future__ import annotations
+
+import jax
+
+from . import autograd
+from . import random as _random
+from .numpy.multiarray import ndarray, _wrap
+
+
+def _raw(x):
+    return x._data if isinstance(x, ndarray) else x
+
+
+def param_arrays(block, trainable_only=False):
+    """dict structural-name -> raw jax.Array for all initialized params."""
+    out = {}
+    for name, p in block.collect_params().items():
+        if p._data is None:
+            continue
+        if trainable_only and p.grad_req == "null":
+            continue
+        out[name] = p.data()._data
+    return out
+
+
+def split_params(block):
+    """(trainable, aux) raw-array dicts. aux = grad_req=='null' state such
+    as BatchNorm running mean/var (the reference's aux_params split,
+    gluon/block.py export writes arg/aux separately)."""
+    trainable, aux = {}, {}
+    for name, p in block.collect_params().items():
+        if p._data is None:
+            continue
+        (aux if p.grad_req == "null" else trainable)[name] = p.data()._data
+    return trainable, aux
+
+
+def functional_call(block, params, *args, train=False, rng_key=None):
+    """Run ``block.forward`` as a pure function.
+
+    params: dict structural-name -> raw jax.Array (or mx ndarray).
+    args: inputs (raw arrays or mx ndarrays).
+    Returns ``(outputs, mutated)`` where outputs is the forward result with
+    raw jax.Arrays as leaves and mutated is a dict of aux-state values the
+    forward updated (BatchNorm running stats) — the caller threads them to
+    the next step, the analog of CachedOp mutable inputs.
+
+    Safe to call inside jit/grad traces: Parameter storage is swapped in
+    and restored around the forward.
+    """
+    block_params = block.collect_params()
+    saved = {}
+    if rng_key is None:
+        rng_key = _random._next_key()
+    try:
+        for n, v in params.items():
+            p = block_params[n]
+            if p._data is None:
+                raise ValueError(f"parameter {n} not initialized")
+            saved[n] = p._data._data
+            p._data._data = _raw(v)
+        markers = {n: block_params[n]._data._data for n in params}
+        nd_args = tuple(a if isinstance(a, ndarray) else _wrap(a)
+                        for a in args)
+        with autograd._RecordingStateScope(False, train), \
+                _random.trace_key_scope(rng_key):
+            out = block.forward(*nd_args)
+        out = jax.tree_util.tree_map(
+            _raw, out, is_leaf=lambda x: isinstance(x, ndarray))
+        mutated = {n: block_params[n]._data._data for n in params
+                   if block_params[n]._data._data is not markers[n]}
+        return out, mutated
+    finally:
+        for n, raw in saved.items():
+            block_params[n]._data._data = raw

@@ -266,6 +266,15 @@ def deconvolution(data=None, weight=None, bias=None, kernel=None, stride=None,
         # transposed conv = fractionally-strided conv with spatially-flipped
         # kernel read as (I, O, spatial)
         w_flipped = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        if num_group > 1:
+            # weight is (C_in, C_out/g, *k); lax wants I=C_in/g with O=C_out
+            # blocked by group: regroup (g, C_in/g, C_out/g) -> (C_in/g, g*C_out/g)
+            cin, cog = w.shape[0], w.shape[1]
+            ksp = w.shape[2:]
+            w_flipped = (w_flipped
+                         .reshape((num_group, cin // num_group, cog) + ksp)
+                         .transpose((1, 0, 2) + tuple(range(3, 3 + nd)))
+                         .reshape((cin // num_group, num_group * cog) + ksp))
         out = lax.conv_general_dilated(
             x, w_flipped, window_strides=(1,) * nd, padding=padding,
             lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,

@@ -21,9 +21,13 @@ _trace = threading.local()
 
 def seed(seed_state, ctx="all"):
     """Seed the global generator (reference: random.py seed(seed_state, ctx))."""
-    global _key
+    global _key, _fallback_n
     with _lock:
         _key = jax.random.PRNGKey(int(seed_state))
+        _fallback_n = 0
+
+
+_fallback_n = 0
 
 
 def _next_key():
@@ -35,9 +39,19 @@ def _next_key():
         nxt, sub = jax.random.split(cur)
         stack[-1] = nxt
         return sub
-    global _key
+    global _key, _fallback_n
     with _lock:
-        _key, sub = jax.random.split(_key)
+        nxt, sub = jax.random.split(_key)
+        if isinstance(nxt, jax.core.Tracer):
+            # Called under an external jit trace without a trace_key_scope:
+            # never leak a tracer into the process-global key. Derive a unique
+            # constant key instead (randomness is then baked per-trace; pass
+            # an explicit key for per-step randomness under jit).
+            _fallback_n += 1
+            # tag keeps this stream disjoint from any seeded eager stream
+            return jax.random.fold_in(
+                jax.random.PRNGKey(0x7A17BA5E), _fallback_n)
+        _key = nxt
     return sub
 
 

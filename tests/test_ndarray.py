@@ -173,3 +173,51 @@ def test_dlpack_numpy_interop():
     assert n.shape == (2, 2)
     t = np.array(onp.arange(4).reshape(2, 2))
     assert t.shape == (2, 2)
+
+
+def test_grouped_deconvolution_vs_torch():
+    """Grouped transposed conv vs torch oracle (reference:
+    src/operator/nn/deconvolution.cc supports num_group)."""
+    import torch
+    from mxnet_tpu import npx
+    for g, cin, cout, stride, pad in [(1, 4, 6, 2, 1), (2, 4, 6, 2, 1),
+                                      (4, 8, 8, 3, 2)]:
+        x = onp.random.randn(2, cin, 9, 9).astype("float32")
+        w = onp.random.randn(cin, cout // g, 3, 3).astype("float32")
+        b = onp.random.randn(cout).astype("float32")
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b),
+            stride=stride, padding=pad, groups=g).numpy()
+        out = npx.deconvolution(
+            np.array(x), np.array(w), np.array(b), kernel=(3, 3),
+            stride=(stride, stride), pad=(pad, pad), num_filter=cout,
+            num_group=g, no_bias=False).asnumpy()
+        assert out.shape == ref.shape
+        assert_almost_equal(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_rng_key_survives_external_jit():
+    """Drawing keys inside an external jit trace must not clobber the
+    process-global key (regression: tracer leak) and the fallback stream
+    must not collide with the seeded eager stream."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import random as r
+
+    mx.random.seed(1)
+    eager_key = onp.asarray(r._next_key())
+
+    @jax.jit
+    def f(x):
+        return x * jax.random.uniform(r._next_key(), x.shape)
+
+    f(jnp.ones((4,)))
+    # global key still concrete and usable
+    a = np.random.uniform(size=(8,)).asnumpy()
+    b = np.random.uniform(size=(8,)).asnumpy()
+    assert (a != b).any()
+    # fallback stream disjoint from eager stream
+    fb = onp.asarray(jax.random.fold_in(jax.random.PRNGKey(0x7A17BA5E), 1))
+    assert not onp.array_equal(fb, eager_key)
+    mx.random.seed(0)
+    assert r._fallback_n == 0
