@@ -1,2 +1,3 @@
 """gluon.model_zoo (reference: python/mxnet/gluon/model_zoo/)."""
 from . import vision  # noqa: F401
+from . import bert  # noqa: F401

@@ -1,0 +1,108 @@
+"""BERT model family.
+
+Reference parity: BASELINE config #3 "BERT-base pretraining (gluon-nlp)".
+The reference repo ships the attention ops (src/operator/contrib/
+transformer.cc) while the model lived in gluon-nlp (model/bert.py:
+BERTEncoder/BERTModel, bert_12_768_12 / bert_24_1024_16). This is that
+model, TPU-native: attention via the Pallas flash kernel, everything else
+XLA-fused; shard with mxnet_tpu.parallel for tp/sp.
+"""
+from __future__ import annotations
+
+from ... import numpy as np
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+from ..nn.transformer import TransformerEncoder, valid_length_mask
+
+__all__ = ["BERTModel", "BERTForPretraining", "bert_12_768_12",
+           "bert_24_1024_16", "bert_base", "bert_large"]
+
+
+class BERTModel(HybridBlock):
+    """BERT encoder with pooler (gluon-nlp BERTModel layout).
+
+    forward(inputs, token_types, valid_length) ->
+        (sequence_output (b, s, units), pooled_output (b, units))
+    """
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_type_vocab_size=2, dropout=0.1, embed_dropout=0.1):
+        super().__init__()
+        self._units = units
+        self.word_embed = Embedding(vocab_size, units)
+        self.token_type_embed = Embedding(token_type_vocab_size, units)
+        self.position_embed = Embedding(max_length, units)
+        self.embed_ln = LayerNorm(epsilon=1e-12)
+        self.embed_dropout = Dropout(embed_dropout) if embed_dropout else None
+        self.encoder = TransformerEncoder(
+            num_layers, units, hidden_size, num_heads, dropout=dropout,
+            attention_dropout=dropout, activation="gelu", pre_norm=False)
+        self.pooler = Dense(units, activation="tanh", flatten=False)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        b, s = inputs.shape
+        if token_types is None:
+            token_types = np.zeros((b, s), dtype="int32")
+        pos = np.arange(s, dtype="int32").reshape(1, s)
+        x = (self.word_embed(inputs) + self.token_type_embed(token_types)
+             + self.position_embed(pos))
+        x = self.embed_ln(x)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        mask = None
+        if valid_length is not None:
+            mask = valid_length_mask(valid_length, s)
+        seq = self.encoder(x, mask=mask)
+        pooled = self.pooler(seq[:, 0, :])
+        return seq, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads on BERTModel (gluon-nlp BERTForPretraining).
+
+    forward -> (mlm_scores (b, s, vocab), nsp_scores (b, 2))
+    """
+
+    def __init__(self, backbone=None, **kwargs):
+        super().__init__()
+        self.backbone = backbone if backbone is not None \
+            else BERTModel(**kwargs)
+        units = self.backbone._units
+        self.mlm_dense = Dense(units, activation=None, flatten=False)
+        self.mlm_ln = LayerNorm(epsilon=1e-12)
+        # decoder projection: weight tied to word_embed in forward, with its
+        # own per-vocab bias (reference: gluon-nlp tied Dense(vocab) + bias)
+        from ..parameter import Parameter
+        vocab = self.backbone.word_embed._input_dim
+        self.mlm_bias = Parameter("mlm_bias", shape=(vocab,), init="zeros")
+        self.nsp_classifier = Dense(2, flatten=False)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        from ... import numpy_extension as npx
+        seq, pooled = self.backbone(inputs, token_types, valid_length)
+        h = npx.leaky_relu(self.mlm_dense(seq), act_type="gelu")
+        h = self.mlm_ln(h)
+        # tied decoder: logits = h @ word_embed.weight.T + bias
+        if self.mlm_bias._data is None:
+            self.mlm_bias._finish_deferred_init()
+        w = self.backbone.word_embed.weight.data()
+        mlm_scores = np.dot(h, w.T) + self.mlm_bias.data()
+        nsp_scores = self.nsp_classifier(pooled)
+        return mlm_scores, nsp_scores
+
+
+def bert_12_768_12(vocab_size=30522, **kwargs):
+    """BERT-base (gluon-nlp model name)."""
+    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, **kwargs)
+
+
+def bert_24_1024_16(vocab_size=30522, **kwargs):
+    """BERT-large (gluon-nlp model name)."""
+    return BERTModel(vocab_size=vocab_size, units=1024, hidden_size=4096,
+                     num_layers=24, num_heads=16, **kwargs)
+
+
+bert_base = bert_12_768_12
+bert_large = bert_24_1024_16

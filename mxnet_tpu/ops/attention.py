@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ..numpy.multiarray import _invoke
 
 
-def _reference_attention(q, k, v, heads, mask=None, causal=False, scale=None):
+def _reference_attention(q, k, v, heads, mask=None, causal=False, scale=None,
+                         dropout_p=0.0):
     """(batch, seq, heads*dim) XLA composition."""
     b, sq, hd = q.shape
     sk = k.shape[1]
@@ -29,10 +30,15 @@ def _reference_attention(q, k, v, heads, mask=None, causal=False, scale=None):
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
     if causal:
         cm = jnp.tril(jnp.ones((sq, sk), dtype=bool))
-        scores = jnp.where(cm, scores, -jnp.inf)
+        scores = jnp.where(cm, scores, -1e30)
     if mask is not None:
-        scores = jnp.where(mask.astype(bool), scores, -jnp.inf)
+        scores = jnp.where(mask.astype(bool), scores, -1e30)
     att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p:
+        from .. import random as _random
+        keep = 1.0 - dropout_p
+        att = att * jax.random.bernoulli(
+            _random._next_key(), keep, att.shape).astype(att.dtype) / keep
     out = jnp.einsum("bhqk,bhkd->bhqd", att, vh)
     return out.transpose(0, 2, 1, 3).reshape(b, sq, heads * d)
 
@@ -44,7 +50,12 @@ def _use_pallas():
 
 def multi_head_attention(query, key, value, heads, mask=None, dropout_p=0.0,
                          causal=False):
-    """Fused MHA on (batch, seq, heads*dim) ndarrays."""
+    """Fused MHA on (batch, seq, heads*dim) ndarrays. Attention-prob dropout
+    (applied only in training mode, reference: transformer attention cells)
+    forces the XLA path; the flash kernel handles the pure case."""
+    from .. import autograd
+    if not autograd.is_training():
+        dropout_p = 0.0
     use_flash = _use_pallas() and mask is None and dropout_p == 0.0
 
     def fn(q, k, v):
@@ -61,6 +72,7 @@ def multi_head_attention(query, key, value, heads, mask=None, dropout_p=0.0,
             except Exception:  # pallas unavailable/shape-unsupported
                 pass
         m = mask._data if hasattr(mask, "_data") else mask
-        return _reference_attention(q, k, v, heads, m, causal)
+        return _reference_attention(q, k, v, heads, m, causal, None,
+                                    dropout_p)
 
     return _invoke(fn, (query, key, value), name="multi_head_attention")

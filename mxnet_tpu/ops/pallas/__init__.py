@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the hot-op layer.
+
+Reference parity: the roles of src/operator/contrib/transformer.cc (fused
+attention), src/operator/fusion/ (RTC pointwise fusion) and the fused
+optimizer kernels (src/operator/optimizer_op.cc) — everywhere the reference
+hand-writes CUDA because compiler fusion isn't enough, we hand-write Pallas.
+Everything else rides XLA fusion.
+"""

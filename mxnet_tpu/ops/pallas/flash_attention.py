@@ -1,0 +1,171 @@
+"""Flash attention Pallas kernel (TPU).
+
+Reference parity: src/operator/contrib/transformer.cc:675-828 — MXNet's
+fastest attention path is interleaved cuBLAS batched matmuls that still
+materialize the (seq, seq) score matrix in HBM. TPU-native design: one
+Pallas kernel per (batch*head, q-block) grid cell streams K/V blocks through
+VMEM with an online-softmax accumulator, so scores never hit HBM and the
+matmuls stay on the MXU. Backward is a recompute VJP (flash-style: saves
+only out + logsumexp residuals, rebuilds P per block).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
+                causal, scale, block_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    bq, d = q.shape
+    nk = pl.cdiv(seq_k, block_k)
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        # dynamic-slice loads clamp at the array end, so a partial final
+        # block would re-read earlier keys — mask beyond seq_k explicitly
+        s = jnp.where(k_pos < seq_k, s, _NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = corr * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # only blocks with k_start <= q_end contribute
+        nk_eff = jnp.minimum(nk, (qi + 1) * block_q // block_k
+                             + (1 if block_q % block_k else 0) + 1)
+        nk_eff = jnp.minimum(nk_eff, nk)
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # pad to block multiples: in-kernel pl.ds loads clamp at the array end,
+    # which would silently misalign a partial final block; padded keys are
+    # masked out via seq_k inside the kernel, padded queries sliced off below
+    sq_pad = -sq % block_q
+    sk_pad = -sk % block_k
+    if sq_pad:
+        q = jnp.pad(q, ((0, 0), (0, sq_pad), (0, 0)))
+    if sk_pad:
+        k = jnp.pad(k, ((0, 0), (0, sk_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_pad), (0, 0)))
+    sq_full, sk_full = sq + sq_pad, sk + sk_pad
+    grid = (bh, pl.cdiv(sq_full, block_q))
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, seq_k=sk, causal=causal, scale=scale,
+        block_q=block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk_full, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk_full, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_full, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_full, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    if sq_pad:
+        out = out[:, :sq]
+        lse = lse[:, :sq]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    """Recompute backward (flash-style residuals: out + logsumexp).
+
+    dS = P * (dP - rowsum(dO * O)); XLA fuses the rebuild — the (s, s)
+    matrices live only inside the fused loop nest, per (batch*head).
+    """
+    q, k, v, out, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        # top-left alignment (absolute positions), matching the fwd kernel
+        sq, sk = s.shape[1], s.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jnp.exp(s - lse)                                   # (bh, sq, sk)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf).astype(q.dtype)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf).astype(k.dtype)
+    return dq, dk, dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=256, interpret=False):
+    """Multi-head attention, scores never materialized in HBM.
+
+    q: (batch, heads, seq_q, head_dim); k/v: (batch, heads, seq_k, head_dim).
+    Returns (batch, heads, seq_q, head_dim).
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    out = _flash(qr, kr, vr, causal, scale, block_q, block_k, interpret)
+    return out.reshape(b, h, sq, d)

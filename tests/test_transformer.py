@@ -1,0 +1,218 @@
+"""Transformer / attention / BERT tests.
+
+Mirrors the reference test strategy (SURVEY §4): numpy-oracle checks for the
+attention op (reference op: src/operator/contrib/transformer.cc
+interleaved_matmul_selfatt), eager-vs-hybrid equivalence, grad flow, and a
+tiny convergence smoke test.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu import numpy as np
+
+
+def _np_attention(q, k, v, heads, mask=None, causal=False):
+    b, sq, hd = q.shape
+    sk = k.shape[1]
+    d = hd // heads
+    qh = q.reshape(b, sq, heads, d).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+    s = onp.einsum("bhqd,bhkd->bhqk", qh, kh) / onp.sqrt(d)
+    if causal:
+        cm = onp.tril(onp.ones((sq, sk), bool))
+        s = onp.where(cm, s, -1e30)
+    if mask is not None:
+        s = onp.where(mask, s, -1e30)
+    e = onp.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    out = onp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return out.transpose(0, 2, 1, 3).reshape(b, sq, hd)
+
+
+def test_multi_head_attention_oracle():
+    from mxnet_tpu.ops.attention import multi_head_attention
+    onp.random.seed(0)
+    q = onp.random.randn(2, 8, 32).astype("float32")
+    k = onp.random.randn(2, 12, 32).astype("float32")
+    v = onp.random.randn(2, 12, 32).astype("float32")
+    out = multi_head_attention(np.array(q), np.array(k), np.array(v), 4)
+    ref = _np_attention(q, k, v, 4)
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_multi_head_attention_causal_and_mask():
+    from mxnet_tpu.ops.attention import multi_head_attention
+    onp.random.seed(1)
+    q = onp.random.randn(2, 8, 32).astype("float32")
+    out = multi_head_attention(np.array(q), np.array(q), np.array(q), 4,
+                               causal=True)
+    ref = _np_attention(q, q, q, 4, causal=True)
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+
+    mask = onp.zeros((2, 1, 1, 8), bool)
+    mask[0, ..., :5] = True
+    mask[1, ..., :8] = True
+    out = multi_head_attention(np.array(q), np.array(q), np.array(q), 4,
+                               mask=np.array(mask))
+    ref = _np_attention(q, q, q, 4, mask=mask)
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+
+
+def test_flash_attention_interpret_matches_reference():
+    """Pallas kernel (interpret mode on CPU) vs composition, fwd + grads."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    onp.random.seed(2)
+    b, h, s, d = 1, 2, 128, 32
+    q = jnp.asarray(onp.random.randn(b, h, s, d).astype("float32"))
+    k = jnp.asarray(onp.random.randn(b, h, s, d).astype("float32"))
+    v = jnp.asarray(onp.random.randn(b, h, s, d).astype("float32"))
+
+    def ref(q, k, v, causal):
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (d ** 0.5)
+        if causal:
+            m = jnp.tril(jnp.ones((s, s), bool))
+            s_ = jnp.where(m, s_, -1e30)
+        p = jax.nn.softmax(s_, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, interpret=True,
+                              block_q=64, block_k=64)
+        r = ref(q, k, v, causal)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(r),
+                                    atol=2e-5)
+        gq, gk, gv = jax.grad(
+            lambda *a: flash_attention(*a, causal=causal, interpret=True,
+                                       block_q=64, block_k=64).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        rq, rk, rv = jax.grad(lambda *a: ref(*a, causal).sum(),
+                              argnums=(0, 1, 2))(q, k, v)
+        onp.testing.assert_allclose(onp.asarray(gq), onp.asarray(rq),
+                                    atol=2e-4)
+        onp.testing.assert_allclose(onp.asarray(gk), onp.asarray(rk),
+                                    atol=2e-4)
+        onp.testing.assert_allclose(onp.asarray(gv), onp.asarray(rv),
+                                    atol=2e-4)
+
+
+def test_encoder_eager_vs_hybrid():
+    from mxnet_tpu.gluon.nn.transformer import (TransformerEncoder,
+                                                valid_length_mask)
+    enc = TransformerEncoder(2, 32, 64, 4)
+    enc.initialize()
+    x = np.array(onp.random.randn(2, 10, 32).astype("float32"))
+    mask = valid_length_mask(np.array(onp.array([10, 6])), 10)
+    y = enc(x, mask=mask)
+    enc.hybridize()
+    y2 = enc(x, mask=mask)
+    onp.testing.assert_allclose(y.asnumpy(), y2.asnumpy(), atol=1e-5)
+
+
+def test_encoder_masked_positions_do_not_affect_valid():
+    """Changing tokens beyond valid_length must not change valid outputs."""
+    from mxnet_tpu.gluon.nn.transformer import (TransformerEncoder,
+                                                valid_length_mask)
+    enc = TransformerEncoder(1, 32, 64, 4)
+    enc.initialize()
+    x = onp.random.randn(1, 10, 32).astype("float32")
+    x2 = x.copy()
+    x2[0, 6:] = 123.0
+    mask = valid_length_mask(np.array(onp.array([6])), 10)
+    y1 = enc(np.array(x), mask=mask).asnumpy()
+    y2 = enc(np.array(x2), mask=mask).asnumpy()
+    onp.testing.assert_allclose(y1[0, :6], y2[0, :6], atol=1e-5)
+
+
+def test_bert_shapes_and_grad():
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining
+    from mxnet_tpu import numpy_extension as npx
+    net = BERTForPretraining(vocab_size=100, units=32, hidden_size=64,
+                             num_layers=2, num_heads=4, max_length=32,
+                             dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    ids = np.array(onp.random.randint(0, 100, (2, 12)).astype("int32"))
+    vl = np.array(onp.array([12, 8]))
+    mlm, nsp = net(ids, None, vl)
+    assert mlm.shape == (2, 12, 100)
+    assert nsp.shape == (2, 2)
+    net.hybridize()
+    mlm2, _ = net(ids, None, vl)
+    onp.testing.assert_allclose(mlm.asnumpy(), mlm2.asnumpy(), atol=1e-4)
+
+    with autograd.record():
+        mlm3, nsp3 = net(ids, None, vl)
+        lbl = np.array(onp.random.randint(0, 100, (2, 12)).astype("int32"))
+        loss = -(npx.pick(npx.log_softmax(mlm3, axis=-1), lbl)).mean()
+    loss.backward()
+    g = net.backbone.word_embed.weight.grad()
+    assert float(abs(g.asnumpy()).sum()) > 0
+
+
+def test_bert_tiny_convergence():
+    """A tiny MLM task must overfit in a few steps (reference pattern:
+    tests/python/train convergence smoke tests)."""
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu import numpy_extension as npx
+
+    mx.random.seed(0)
+    onp.random.seed(0)
+    net = BERTForPretraining(vocab_size=50, units=32, hidden_size=64,
+                             num_layers=1, num_heads=4, max_length=16,
+                             dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 1e-2})
+    ids = np.array(onp.random.randint(0, 50, (4, 8)).astype("int32"))
+    first = None
+    for i in range(30):
+        with autograd.record():
+            mlm, _ = net(ids)
+            loss = -(npx.pick(npx.log_softmax(mlm, axis=-1), ids)).mean()
+        loss.backward()
+        trainer.step(4)
+        lv = float(loss.asnumpy())
+        if first is None:
+            first = lv
+    assert lv < first * 0.5, f"no convergence: {first} -> {lv}"
+
+
+@pytest.mark.parametrize("sq,sk,causal", [(300, 300, False), (8, 16, True),
+                                          (100, 36, False), (129, 257, False)])
+def test_flash_attention_ragged_shapes(sq, sk, causal):
+    """Non-block-multiple seq lengths and sq != sk causal (regressions:
+    clamped-pl.ds misalignment; bwd mask alignment)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    onp.random.seed(3)
+    d = 32
+    q = jnp.asarray(onp.random.randn(1, 2, sq, d).astype("float32"))
+    k = jnp.asarray(onp.random.randn(1, 2, sk, d).astype("float32"))
+    v = jnp.asarray(onp.random.randn(1, 2, sk, d).astype("float32"))
+
+    def ref(q, k, v):
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (d ** 0.5)
+        if causal:
+            m = jnp.tril(jnp.ones((sq, sk), bool))
+            s_ = jnp.where(m, s_, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s_, -1), v)
+
+    out = flash_attention(q, k, v, causal=causal, interpret=True,
+                          block_q=64, block_k=64)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref(q, k, v)),
+                                atol=1e-4)
+    g = jax.grad(lambda *a: flash_attention(
+        *a, causal=causal, interpret=True, block_q=64, block_k=64).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    r = jax.grad(lambda *a: ref(*a).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, r):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b), atol=1e-3)
