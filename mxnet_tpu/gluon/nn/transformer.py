@@ -93,15 +93,19 @@ class TransformerEncoderCell(HybridBlock):
         self.dropout = Dropout(dropout) if dropout else None
 
     def forward(self, x, mask=None):
+        from ...parallel.mesh import constrain
         if self._pre_norm:
             h = self.attention(self.attn_ln(x), mask=mask)
-            x = x + (self.dropout(h) if self.dropout else h)
+            x = constrain(x + (self.dropout(h) if self.dropout else h),
+                          "residual")
             h = self.ffn(self.ffn_ln(x))
-            return x + h
+            return constrain(x + h, "residual")
         h = self.attention(x, mask=mask)
-        x = self.attn_ln(x + (self.dropout(h) if self.dropout else h))
+        x = constrain(
+            self.attn_ln(x + (self.dropout(h) if self.dropout else h)),
+            "residual")
         h = self.ffn(x)
-        return self.ffn_ln(x + h)
+        return constrain(self.ffn_ln(x + h), "residual")
 
 
 class TransformerDecoderCell(HybridBlock):

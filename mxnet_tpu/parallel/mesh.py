@@ -62,3 +62,54 @@ def shard(array, mesh, spec):
 
 def replicate(array, mesh):
     return shard(array, mesh, P())
+
+
+# -- activation sharding scope (sequence parallelism hook) ------------------
+# Megatron-SP style: layers consult these rules to constrain their
+# activations (residual stream sharded over ('dp', 'sp', None)); XLA then
+# inserts the gather/scatter collectives around attention automatically.
+_act_rules = None
+
+
+class activation_sharding:
+    """Scope installing activation PartitionSpec rules consulted by layers.
+
+    with parallel.activation_sharding(mesh, residual=P('dp', 'sp', None)):
+        out = net(x)            # or ShardedTrainStep built inside the scope
+    """
+
+    def __init__(self, mesh, **rules):
+        self.mesh = mesh
+        self.rules = rules
+        self._prev = None
+
+    def __enter__(self):
+        global _act_rules
+        self._prev = _act_rules
+        _act_rules = (self.mesh, self.rules)
+        return self
+
+    def __exit__(self, *exc):
+        global _act_rules
+        _act_rules = self._prev
+
+
+def constrain(x, kind):
+    """Apply the active activation-sharding rule `kind` to x (ndarray or raw
+    jax array); identity when no scope is active or rule missing."""
+    if _act_rules is None:
+        return x
+    mesh, rules = _act_rules
+    spec = rules.get(kind)
+    if spec is None:
+        return x
+    from ..numpy.multiarray import ndarray, _wrap
+    raw = x._data if isinstance(x, ndarray) else x
+    if raw.ndim < len(spec):
+        return x
+    try:
+        out = jax.lax.with_sharding_constraint(
+            raw, NamedSharding(mesh, spec))
+    except ValueError:
+        return x
+    return _wrap(out) if isinstance(x, ndarray) else out

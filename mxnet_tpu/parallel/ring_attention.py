@@ -87,6 +87,11 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None):
         m0 = jnp.full((b, h, sq, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
         acc0 = jnp.zeros(qb.shape, jnp.float32)
+        # constants start unvarying over the mesh axis; the loop makes them
+        # varying — cast up front so the scan carry types match
+        if hasattr(jax.lax, "pcast"):
+            m0, l0, acc0 = (jax.lax.pcast(t, (axis,), to="varying")
+                            for t in (m0, l0, acc0))
         _, _, m, l, acc = jax.lax.fori_loop(
             0, n, step, (kb, vb, m0, l0, acc0))
         return (acc / jnp.maximum(l, 1e-20)).astype(qb.dtype)

@@ -1,0 +1,195 @@
+"""Sharded training step — the whole Trainer.step path as one XLA program.
+
+Reference parity: python/mxnet/gluon/trainer.py:334 (step = backward grads →
+kvstore pushpull allreduce → optimizer update, overlapped by the dependency
+engine) and the KVStore reduce machinery (src/kvstore/comm.h). TPU-native:
+forward + backward + gradient allreduce + optimizer update compile into ONE
+jit program over a jax.sharding.Mesh — XLA inserts the collectives from the
+shardings (data-parallel psum over 'dp', Megatron tensor-parallel
+allreduces over 'tp', sequence sharding over 'sp') and its latency-hiding
+scheduler overlaps comm with compute, which is the engine's compute/comm
+overlap re-created at compile time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import functional
+from ..numpy.multiarray import ndarray, _wrap
+
+# name-pattern Megatron rules for the transformer family
+# (column-parallel: shard Dense units; row-parallel: shard in_units, psum)
+_COLUMN_SUFFIXES = ("query_proj.weight", "key_proj.weight",
+                    "value_proj.weight", "ffn_1.weight")
+_ROW_SUFFIXES = ("out_proj.weight", "ffn_2.weight")
+_COLUMN_BIAS = ("query_proj.bias", "key_proj.bias", "value_proj.bias",
+                "ffn_1.bias")
+
+
+def megatron_specs(param_shapes, tp_axis="tp"):
+    """PartitionSpecs for transformer params by structural-name pattern."""
+    specs = {}
+    for name, shape in param_shapes.items():
+        if any(name.endswith(s) for s in _COLUMN_SUFFIXES) and len(shape) == 2:
+            specs[name] = P(tp_axis, None)
+        elif any(name.endswith(s) for s in _ROW_SUFFIXES) and len(shape) == 2:
+            specs[name] = P(None, tp_axis)
+        elif any(name.endswith(s) for s in _COLUMN_BIAS):
+            specs[name] = P(tp_axis)
+        else:
+            specs[name] = P()
+    return specs
+
+
+class FunctionalOptimizer:
+    """Pure-functional adapter over a mxnet_tpu Optimizer instance so its
+    update rule can run inside a jit/pjit trace (the analog of the fused
+    multi-tensor update ops, src/operator/optimizer_op.cc:352)."""
+
+    def __init__(self, optimizer):
+        self.opt = optimizer
+
+    def init(self, raw_params):
+        states = {}
+        for i, name in enumerate(sorted(raw_params)):
+            s = self.opt.create_state(i, _wrap(raw_params[name]))
+            states[name] = jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, ndarray) else x, s,
+                is_leaf=lambda x: isinstance(x, ndarray))
+        return states
+
+    def update(self, raw_params, raw_grads, states, lr=None):
+        new_p, new_s = {}, {}
+        for i, name in enumerate(sorted(raw_params)):
+            if name not in raw_grads:
+                new_p[name] = raw_params[name]
+                new_s[name] = states[name]
+                continue
+            wd = self.opt._get_wd(i)
+            lr_i = lr if lr is not None else self.opt._get_lr(i)
+            wrapped = jax.tree_util.tree_map(
+                _wrap, states[name],
+                is_leaf=lambda x: x is None)
+            w, s = self.opt._update_impl(
+                raw_params[name], raw_grads[name], wrapped, lr_i, wd)
+            new_p[name] = w.astype(raw_params[name].dtype)
+            new_s[name] = jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, ndarray) else x, s,
+                is_leaf=lambda x: isinstance(x, ndarray))
+        return new_p, new_s
+
+
+class ShardedTrainStep:
+    """Compiled data/tensor/sequence-parallel training step for a Block.
+
+    block: initialized (Hybrid)Block.
+    loss_fn(outputs, *labels) -> scalar (raw jax values).
+    optimizer: mxnet_tpu Optimizer instance (or name via opt.create).
+    mesh: jax.sharding.Mesh; dp_axis must exist; tp/sp optional.
+    batch_specs: PartitionSpec per batch arg (inputs then labels),
+        e.g. (P('dp', 'sp'), P('dp',)).
+    param_specs: dict name -> PartitionSpec; defaults to megatron_specs
+        when the mesh has a tp axis else fully replicated.
+    """
+
+    def __init__(self, block, loss_fn, optimizer, mesh, batch_specs,
+                 n_labels=1, param_specs=None, donate=True):
+        from ..optimizer import optimizer as opt_mod
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer)
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.n_labels = n_labels
+        trainable, aux = functional.split_params(block)
+        shapes = {n: v.shape for n, v in trainable.items()}
+        shapes.update({n: v.shape for n, v in aux.items()})
+        if param_specs is None:
+            if "tp" in mesh.shape:
+                param_specs = megatron_specs(shapes)
+            else:
+                param_specs = {n: P() for n in shapes}
+        self.param_specs = param_specs
+        self.fopt = FunctionalOptimizer(optimizer)
+
+        def sh(spec):
+            return NamedSharding(mesh, spec)
+
+        self.trainable = {
+            n: jax.device_put(v, sh(param_specs.get(n, P())))
+            for n, v in trainable.items()}
+        self.aux = {
+            n: jax.device_put(v, sh(param_specs.get(n, P())))
+            for n, v in aux.items()}
+        states = self.fopt.init(self.trainable)
+        # optimizer state shards like its weight
+        self.states = jax.tree_util.tree_map(
+            lambda x: x, states)
+        self.states = {
+            n: jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh(param_specs.get(n, P())))
+                if x is not None else None, s, is_leaf=lambda x: x is None)
+            for n, s in states.items()}
+        self.batch_shardings = tuple(sh(s) for s in batch_specs)
+
+        param_sh = {n: sh(param_specs.get(n, P())) for n in trainable}
+        aux_sh = {n: sh(param_specs.get(n, P())) for n in aux}
+        state_sh = {
+            n: jax.tree_util.tree_map(
+                lambda x: sh(param_specs.get(n, P())), self.states[n],
+                is_leaf=lambda x: x is None)
+            for n in self.states}
+        # None states have no sharding
+        state_sh = {
+            n: jax.tree_util.tree_map(
+                lambda x, s: None if x is None else s,
+                self.states[n], state_sh[n], is_leaf=lambda x: x is None)
+            for n in self.states}
+
+        def step(trainable, aux, states, rng, lr, *batch):
+            inputs = batch[:len(batch) - self.n_labels]
+            labels = batch[len(batch) - self.n_labels:]
+
+            def lossf(tr):
+                out, mutated = functional.functional_call(
+                    self.block, {**tr, **aux}, *inputs, train=True,
+                    rng_key=rng)
+                return self.loss_fn(out, *labels), mutated
+
+            (loss, mutated), grads = jax.value_and_grad(
+                lossf, has_aux=True)(trainable)
+            new_tr, new_states = self.fopt.update(trainable, grads, states,
+                                                  lr=lr)
+            return new_tr, {**aux, **mutated}, new_states, loss
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        self._step = jax.jit(
+            step,
+            in_shardings=(param_sh, aux_sh, state_sh, sh(P()), sh(P()))
+            + self.batch_shardings,
+            out_shardings=(param_sh, aux_sh, state_sh, sh(P())),
+            donate_argnums=donate_argnums)
+        self._n_step = 0
+
+    def __call__(self, *batch):
+        """Run one step; returns the (replicated) scalar loss as ndarray."""
+        from .. import random as _random
+        raws = [b._data if isinstance(b, ndarray) else jnp.asarray(b)
+                for b in batch]
+        raws = [jax.device_put(r, s)
+                for r, s in zip(raws, self.batch_shardings)]
+        rng = _random._next_key()
+        lr = jnp.asarray(self.fopt.opt.learning_rate, jnp.float32)
+        self.trainable, self.aux, self.states, loss = self._step(
+            self.trainable, self.aux, self.states, rng, lr, *raws)
+        self._n_step += 1
+        return _wrap(loss)
+
+    def sync_to_block(self):
+        """Write current sharded weights back into the Block's Parameters
+        (for save_parameters / eager eval after training)."""
+        params = self.block.collect_params()
+        for n, v in {**self.trainable, **self.aux}.items():
+            params[n]._data._rebind(v)
