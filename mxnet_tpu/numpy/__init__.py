@@ -146,3 +146,14 @@ def asarray(obj, dtype=None):
 
 def asnumpy(a):
     return a.asnumpy() if isinstance(a, ndarray) else a
+
+
+def fill_diagonal(a, val, wrap=False):
+    """Functional fill_diagonal (JAX arrays are immutable; returns a copy,
+    unlike numpy's in-place reference semantics)."""
+    return _invoke(lambda x: jnp.fill_diagonal(x, val, wrap=wrap,
+                                               inplace=False),
+                   (a,), name="fill_diagonal")
+
+
+row_stack = vstack

@@ -497,6 +497,12 @@ class ndarray:
     def var(self, axis=None, keepdims=False, ddof=0):
         return self._reduce(jnp.var, axis, keepdims, ddof=ddof)
 
+    def all(self, axis=None, keepdims=False):
+        return self._reduce(jnp.all, axis, keepdims)
+
+    def any(self, axis=None, keepdims=False):
+        return self._reduce(jnp.any, axis, keepdims)
+
     def argmax(self, axis=None):
         return _invoke(lambda x: jnp.argmax(x, axis), (self,))
 

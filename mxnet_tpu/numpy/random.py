@@ -175,3 +175,29 @@ def power(a, size=None, ctx=None, device=None, out=None):
     a = a._data if isinstance(a, ndarray) else a
     u = jax.random.uniform(_key(), _shape(size) or jnp.shape(a))
     return _wrap(u ** (1.0 / a))
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None,
+             device=None, out=None):
+    """Reference: _npi_logistic (src/operator/numpy/random/np_location_scale_op.cc)."""
+    loc_ = loc._data if isinstance(loc, ndarray) else loc
+    sc = scale._data if isinstance(scale, ndarray) else scale
+    return _wrap(jax.random.logistic(_key(), _shape(size), _fdt(dtype))
+                 * sc + loc_)
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None, out=None):
+    """F-distribution via two chi-square draws (reference: np_random f)."""
+    dfnum = dfnum._data if isinstance(dfnum, ndarray) else dfnum
+    dfden = dfden._data if isinstance(dfden, ndarray) else dfden
+    c1 = jax.random.chisquare(_key(), dfnum, shape=_shape(size) or None)
+    c2 = jax.random.chisquare(_key(), dfden, shape=_shape(size) or None)
+    return _wrap((c1 / dfnum) / (c2 / dfden))
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    """Reference: numpy/random.py multivariate_normal."""
+    mean = mean._data if isinstance(mean, ndarray) else jnp.asarray(mean)
+    cov = cov._data if isinstance(cov, ndarray) else jnp.asarray(cov)
+    return _wrap(jax.random.multivariate_normal(
+        _key(), mean, cov, shape=_shape(size) or None))

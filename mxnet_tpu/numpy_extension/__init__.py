@@ -922,3 +922,81 @@ def smooth_l1(data, scalar=1.0):
         return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x,
                          jnp.abs(x) - 0.5 / s2)
     return _invoke(fn, (data,), name="smooth_l1")
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False,
+              forward_stype=None):
+    """Reference: src/operator/tensor/dot.cc batch_dot — (b, m, k) x
+    (b, k, n) batched matmul, the building block the reference's attention
+    ops are made of; lowers to one MXU dot_general."""
+    def fn(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return _invoke(fn, (lhs, rhs), name="batch_dot")
+
+
+def reshape(data, newshape, reverse=False, order="C"):
+    """Reference: _npx_reshape (src/operator/numpy/np_matrix_op.cc) with
+    MXNet's special codes: -1 infer, -2 copy remaining dims, -3 merge two
+    consecutive dims, -4 split a dim (followed by the two factors), 0 keep."""
+    def fn(x):
+        shape = list(newshape) if isinstance(newshape, (list, tuple)) \
+            else [newshape]
+        src = list(x.shape)
+        out, si, i = [], 0, 0
+        while i < len(shape):
+            s = shape[i]
+            if s == 0:
+                out.append(src[si]); si += 1
+            elif s == -1:
+                out.append(-1); si += 1
+            elif s == -2:
+                out.extend(src[si:]); si = len(src)
+            elif s == -3:
+                out.append(src[si] * src[si + 1]); si += 2
+            elif s == -4:
+                f1, f2 = shape[i + 1], shape[i + 2]
+                d = src[si]
+                if f1 == -1:
+                    f1 = d // f2
+                if f2 == -1:
+                    f2 = d // f1
+                out.extend([f1, f2]); si += 1; i += 2
+            else:
+                out.append(s); si += 1
+            i += 1
+        return jnp.reshape(x, tuple(out))
+    return _invoke(fn, (data,), name="npx_reshape")
+
+
+def constraint_check(data, msg="Constraint violated!"):
+    """Reference: _npx_constraint_check (src/operator/numpy/
+    np_constraint_check.cc): all(data) must hold; used by
+    gluon.probability distributions. Functional form: returns True and
+    raises at sync time via checkify-style where supported; eager path
+    checks immediately."""
+    def fn(x):
+        return jnp.all(x)
+    out = _invoke(fn, (data,), name="constraint_check")
+    try:
+        ok = bool(out.asnumpy())
+        if not ok:
+            raise ValueError(msg)
+    except (ValueError, TypeError) as e:
+        if isinstance(e, ValueError) and str(e) == msg:
+            raise
+        # traced (inside jit): defer — return the boolean for lax.cond use
+    return out
+
+
+def nonzero(data):
+    """Reference: _npx_nonzero — returns (N, ndim) int64 indices (unlike
+    np.nonzero's tuple). Eager-only (data-dependent shape)."""
+    import numpy as onp
+    arr = data.asnumpy() if hasattr(data, "asnumpy") else onp.asarray(data)
+    idx = onp.argwhere(arr)
+    from ..numpy.multiarray import array as _array
+    return _array(idx.astype("int64"))

@@ -1,0 +1,140 @@
+"""Operator-coverage parity locks + oracles for npx extras.
+
+Locks in the op-surface parity measured against the reference
+(python/mxnet/numpy/multiarray.py public functions, the _npi/_npx
+MXNET_REGISTER_API lists from src/api/, numpy/random.py, numpy/linalg.py)
+so regressions in the lazy wrapper generation are caught.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as np
+
+# public functions of the reference numpy frontend that must exist
+REF_NP = [
+    "abs", "absolute", "add", "all", "amax", "amin", "any", "append",
+    "arange", "arccos", "arccosh", "arcsin", "arcsinh", "arctan", "arctan2",
+    "arctanh", "argmax", "argmin", "argsort", "around", "array",
+    "array_split", "atleast_1d", "atleast_2d", "atleast_3d", "average",
+    "bincount", "bitwise_and", "bitwise_invert", "bitwise_not", "bitwise_or",
+    "bitwise_xor", "blackman", "broadcast_to", "cbrt", "ceil", "clip",
+    "column_stack", "concatenate", "copysign", "cos", "cosh", "cross",
+    "cumsum", "deg2rad", "degrees", "delete", "diag", "diagflat", "diagonal",
+    "diff", "divide", "dot", "dsplit", "dstack", "ediff1d", "einsum",
+    "empty", "empty_like", "equal", "exp", "expand_dims", "expm1", "eye",
+    "fabs", "fill_diagonal", "fix", "flatnonzero", "flip", "fliplr",
+    "flipud", "floor", "fmax", "fmin", "fmod", "full", "full_like", "gcd",
+    "greater", "greater_equal", "hamming", "hanning", "histogram", "hsplit",
+    "hstack", "hypot", "identity", "indices", "inner", "insert", "interp",
+    "invert", "isfinite", "isinf", "isnan", "isneginf", "isposinf", "kron",
+    "lcm", "ldexp", "less", "less_equal", "linspace", "log", "log10",
+    "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logspace", "matmul", "max", "maximum", "mean", "median",
+    "min", "minimum", "mod", "moveaxis", "multiply", "nan_to_num",
+    "nanprod", "nansum", "negative", "nonzero", "not_equal", "ones",
+    "ones_like", "outer", "pad", "percentile", "polyval", "power", "prod",
+    "quantile", "rad2deg", "radians", "ravel", "reciprocal", "remainder",
+    "repeat", "reshape", "resize", "rint", "roll", "rollaxis", "rot90",
+    "round", "row_stack", "sign", "sin", "sinh", "sort", "split", "sqrt",
+    "square", "squeeze", "stack", "std", "subtract", "sum", "swapaxes",
+    "take", "tan", "tanh", "tensordot", "tile", "trace", "transpose", "tri",
+    "tril", "tril_indices", "triu", "triu_indices", "true_divide", "trunc",
+    "unique", "unravel_index", "var", "vdot", "vsplit", "vstack", "where",
+    "zeros", "zeros_like",
+]
+
+REF_NPX = [
+    "activation", "arange_like", "batch_dot", "batch_norm", "broadcast_like",
+    "cond", "convolution", "deconvolution", "dropout", "embedding",
+    "foreach", "fully_connected", "group_norm", "layer_norm", "leaky_relu",
+    "log_softmax", "masked_log_softmax", "masked_softmax", "one_hot",
+    "pick", "pooling", "rnn", "softmax", "topk", "while_loop", "reshape",
+    "constraint_check", "nonzero", "gamma", "sequence_mask",
+]
+
+REF_RANDOM = [
+    "beta", "chisquare", "choice", "exponential", "f", "gamma", "gumbel",
+    "logistic", "lognormal", "multinomial", "multivariate_normal", "normal",
+    "pareto", "power", "randint", "rayleigh", "shuffle", "uniform",
+    "weibull", "rand",
+]
+
+REF_LINALG = [
+    "cholesky", "det", "eig", "eigh", "eigvals", "eigvalsh", "inv",
+    "lstsq", "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv",
+    "qr", "slogdet", "solve", "svd", "tensorinv", "tensorsolve",
+]
+
+
+def test_np_surface_parity():
+    missing = [f for f in REF_NP if not hasattr(mx.np, f)]
+    assert not missing, f"mx.np missing: {missing}"
+
+
+def test_npx_surface_parity():
+    missing = [f for f in REF_NPX if not hasattr(mx.npx, f)]
+    assert not missing, f"mx.npx missing: {missing}"
+
+
+def test_random_surface_parity():
+    missing = [f for f in REF_RANDOM if not hasattr(mx.np.random, f)]
+    assert not missing, f"mx.np.random missing: {missing}"
+
+
+def test_linalg_surface_parity():
+    missing = [f for f in REF_LINALG if not hasattr(mx.np.linalg, f)]
+    assert not missing, f"mx.np.linalg missing: {missing}"
+
+
+def test_batch_dot_oracle():
+    a = onp.random.randn(2, 3, 4).astype("float32")
+    b = onp.random.randn(2, 4, 5).astype("float32")
+    r = mx.npx.batch_dot(np.array(a), np.array(b))
+    onp.testing.assert_allclose(r.asnumpy(), onp.matmul(a, b), rtol=1e-5)
+    bt = onp.random.randn(2, 5, 4).astype("float32")
+    r = mx.npx.batch_dot(np.array(a), np.array(bt), transpose_b=True)
+    onp.testing.assert_allclose(r.asnumpy(),
+                                onp.matmul(a, bt.transpose(0, 2, 1)),
+                                rtol=1e-5)
+
+
+def test_npx_reshape_special_codes():
+    x = np.zeros((2, 3, 4, 5))
+    assert mx.npx.reshape(x, (-2,)).shape == (2, 3, 4, 5)
+    assert mx.npx.reshape(x, (0, -3, 0)).shape == (2, 12, 5)
+    assert mx.npx.reshape(x, (0, 0, -4, 2, 2, 0)).shape == (2, 3, 2, 2, 5)
+    assert mx.npx.reshape(x, (-1, 5)).shape == (24, 5)
+    assert mx.npx.reshape(x, (0, 0, -4, -1, 2, 0)).shape == (2, 3, 2, 2, 5)
+
+
+def test_constraint_check():
+    assert bool(mx.npx.constraint_check(
+        np.array([1, 1], dtype="int32")).asnumpy())
+    with pytest.raises(ValueError, match="nope"):
+        mx.npx.constraint_check(np.array([1, 0], dtype="int32"), "nope")
+
+
+def test_npx_nonzero_indices():
+    idx = mx.npx.nonzero(np.array([[1, 0], [0, 2]]))
+    assert idx.asnumpy().tolist() == [[0, 0], [1, 1]]
+
+
+def test_new_random_samplers():
+    mx.random.seed(0)
+    assert mx.np.random.logistic(size=(100,)).shape == (100,)
+    assert mx.np.random.f(2.0, 3.0, size=(10,)).shape == (10,)
+    mvn = mx.np.random.multivariate_normal(onp.zeros(2), onp.eye(2),
+                                           size=(50,))
+    assert mvn.shape == (50, 2)
+
+
+def test_fill_diagonal_functional():
+    out = mx.np.fill_diagonal(np.zeros((3, 3)), 5.0)
+    onp.testing.assert_allclose(onp.diagonal(out.asnumpy()), [5, 5, 5])
+
+
+def test_ndarray_any_all_methods():
+    a = np.array([[True, False]])
+    assert bool(a.any().asnumpy())
+    assert not bool(a.all().asnumpy())
