@@ -115,15 +115,31 @@ class ArrayDataset(Dataset):
 
 class RecordFileDataset(Dataset):
     """RecordIO-backed dataset (reference: dataset.py RecordFileDataset over
-    src/io/dataset.cc:61; indexed .rec/.idx pair)."""
+    src/io/dataset.cc:61).
+
+    Uses the native mmap reader (native/mxtpu_io.cc) when the toolchain is
+    available — no .idx sidecar needed, zero-copy reads, native threaded
+    prefetch via ``prefetch_iter`` — falling back to the pure-python
+    IndexedRecordIO (.rec/.idx pair) otherwise.
+    """
 
     def __init__(self, filename):
-        from ...recordio import IndexedRecordIO
-        idx_file = os.path.splitext(filename)[0] + ".idx"
-        self._record = IndexedRecordIO(idx_file, filename, "r")
+        self._native = None
+        self._record = None
+        try:
+            from ...native import NativeRecordFile
+            self._native = NativeRecordFile(filename)
+        except (RuntimeError, OSError, FileNotFoundError):
+            from ...recordio import IndexedRecordIO
+            idx_file = os.path.splitext(filename)[0] + ".idx"
+            self._record = IndexedRecordIO(idx_file, filename, "r")
 
     def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native.read(idx)
         return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):
+        if self._native is not None:
+            return len(self._native)
         return len(self._record.keys)

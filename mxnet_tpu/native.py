@@ -1,0 +1,152 @@
+"""Native (C++) runtime loader.
+
+Reference parity: the reference ships libmxnet.so found via
+python/mxnet/libinfo.py find_lib_path; here the native pieces are small
+per-subsystem shared objects built from native/*.cc on first use with the
+system toolchain (g++), cached next to the sources. ctypes-based — no
+pybind11 dependency (see also src/lib_api.cc for the reference's
+ABI-stable plugin approach).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_libs = {}
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC_DIR = os.path.join(_ROOT, "native")
+_BUILD_DIR = os.environ.get("MXNET_TPU_NATIVE_BUILD",
+                            os.path.join(_SRC_DIR, "build"))
+
+
+def _build(name):
+    src = os.path.join(_SRC_DIR, f"{name}.cc")
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    if not os.path.exists(src):
+        raise FileNotFoundError(src)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           src, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")
+    return out
+
+
+def load(name):
+    """Load (building if needed) a native library; returns ctypes CDLL or
+    None when the toolchain/source is unavailable (callers fall back to
+    pure python)."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        try:
+            lib = ctypes.CDLL(_build(name))
+        except (OSError, RuntimeError, FileNotFoundError):
+            lib = None
+        _libs[name] = lib
+        return lib
+
+
+def io_lib():
+    lib = load("mxtpu_io")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        lib.mxtpu_rio_open.restype = ctypes.c_void_p
+        lib.mxtpu_rio_open.argtypes = [ctypes.c_char_p]
+        lib.mxtpu_rio_count.restype = ctypes.c_int64
+        lib.mxtpu_rio_count.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_rio_get.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.mxtpu_rio_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_uint64)]
+        lib.mxtpu_rio_offset.restype = ctypes.c_int64
+        lib.mxtpu_rio_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.mxtpu_rio_close.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_prefetch_create.restype = ctypes.c_void_p
+        lib.mxtpu_prefetch_create.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.mxtpu_prefetch_next_len.restype = ctypes.c_int64
+        lib.mxtpu_prefetch_next_len.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.mxtpu_prefetch_pop.restype = ctypes.c_int64
+        lib.mxtpu_prefetch_pop.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+        lib.mxtpu_prefetch_destroy.argtypes = [ctypes.c_void_p]
+        lib._sigs_set = True
+    return lib
+
+
+class NativeRecordFile:
+    """mmap-backed RecordIO reader with a full in-memory index (no .idx
+    sidecar needed — the native scan builds it)."""
+
+    def __init__(self, path):
+        lib = io_lib()
+        if lib is None:
+            raise RuntimeError("native io library unavailable")
+        self._lib = lib
+        self._handle = lib.mxtpu_rio_open(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot open record file {path}")
+        self._n = lib.mxtpu_rio_count(self._handle)
+
+    def __len__(self):
+        return self._n
+
+    def read(self, i):
+        """Record i's payload as bytes (copied out of the mmap)."""
+        ln = ctypes.c_uint64()
+        ptr = self._lib.mxtpu_rio_get(self._handle, i, ctypes.byref(ln))
+        if not ptr:
+            raise IndexError(i)
+        return ctypes.string_at(ptr, ln.value)
+
+    def offset(self, i):
+        return self._lib.mxtpu_rio_offset(self._handle, i)
+
+    def close(self):
+        if self._handle:
+            self._lib.mxtpu_rio_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def prefetch_iter(self, order=None, capacity=64, workers=2):
+        """Iterate (record_id, payload bytes) with native readahead
+        (reference: src/io/iter_prefetcher.h)."""
+        import numpy as onp
+        if order is None:
+            order = onp.arange(self._n, dtype=onp.int64)
+        order = onp.ascontiguousarray(onp.asarray(order, dtype=onp.int64))
+        n = len(order)
+        pf = self._lib.mxtpu_prefetch_create(
+            self._handle,
+            order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, capacity, workers)
+        try:
+            buf = (ctypes.c_uint8 * 0)()
+            buf_len = 0
+            for _ in range(n):
+                ln = ctypes.c_uint64()
+                rec = self._lib.mxtpu_prefetch_next_len(pf, ctypes.byref(ln))
+                if rec < 0:
+                    break
+                if ln.value > buf_len:
+                    buf_len = max(int(ln.value), 2 * buf_len)
+                    buf = (ctypes.c_uint8 * buf_len)()
+                rec = self._lib.mxtpu_prefetch_pop(pf, buf, buf_len)
+                if rec < 0:
+                    break
+                yield rec, ctypes.string_at(buf, ln.value)
+        finally:
+            self._lib.mxtpu_prefetch_destroy(pf)
