@@ -1,0 +1,16 @@
+"""gluon.probability — distributions, transformations, stochastic blocks.
+
+Reference parity: python/mxnet/gluon/probability/ (6.5k LoC: ~30
+distributions in distributions/, transformations in transformation/,
+StochasticBlock in block/). TPU-native: densities/samplers are jnp +
+jax.random compositions (fully jittable, explicit PRNG keys via the global
+mx.random facade), so everything traces into hybridized blocks.
+"""
+from .distributions import *  # noqa: F401,F403
+from .distributions import kl_divergence, register_kl  # noqa: F401
+from .transformation import (  # noqa: F401
+    Transformation, ExpTransform, AffineTransform, SigmoidTransform,
+    LogTransform, AbsTransform, PowerTransform, ComposeTransform,
+    TransformedDistribution,
+)
+from .stochastic_block import StochasticBlock, StochasticBlockGrad  # noqa: F401

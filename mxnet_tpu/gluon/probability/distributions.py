@@ -1,0 +1,1003 @@
+"""Distributions.
+
+Reference parity: python/mxnet/gluon/probability/distributions/*.py
+(Distribution base distribution.py, ~25 concrete families, divergence.py KL
+registry). Densities use jnp/jax.scipy; samplers use jax.random with keys
+from the mx.random facade so mx.random.seed reproduces runs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import random as _random
+from ...numpy.multiarray import ndarray, _wrap
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Bernoulli", "Categorical",
+    "OneHotCategorical", "Uniform", "Exponential", "Gamma", "Beta",
+    "Dirichlet", "Laplace", "Cauchy", "HalfCauchy", "HalfNormal", "Chi2",
+    "Poisson", "Geometric", "Binomial", "Multinomial", "NegativeBinomial",
+    "MultivariateNormal", "Gumbel", "Pareto", "StudentT", "FisherSnedecor",
+    "Independent", "RelaxedBernoulli", "RelaxedOneHotCategorical",
+    "kl_divergence", "register_kl",
+]
+
+
+def _raw(x):
+    return x._data if isinstance(x, ndarray) else jnp.asarray(x)
+
+
+def _shape(size, base=()):
+    if size is None:
+        return tuple(base)
+    if isinstance(size, int):
+        return (size,) + tuple(base)
+    return tuple(size) + tuple(base)
+
+
+class Distribution:
+    """Base distribution (reference: distributions/distribution.py).
+
+    has_grad: samples are reparameterized (pathwise gradients flow).
+    """
+
+    has_grad = False
+    support = None
+    arg_constraints = {}
+
+    def __init__(self, F=None, event_dim=0, validate_args=None):
+        self.F = F
+        self.event_dim = event_dim
+
+    # subclasses implement _sample(key, shape) and log_prob on raw arrays
+    def sample(self, size=None):
+        return _wrap(self._sample(_random._next_key(), _shape(
+            size, self._batch_shape())))
+
+    def sample_n(self, n=None):
+        size = (n,) if isinstance(n, int) else tuple(n or ())
+        return _wrap(self._sample(_random._next_key(),
+                                  size + tuple(self._batch_shape())))
+
+    def rsample(self, size=None):
+        if not self.has_grad:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no reparameterized sampler")
+        return self.sample(size)
+
+    def log_prob(self, value):
+        return _wrap(self._log_prob(_raw(value)))
+
+    def prob(self, value):
+        return _wrap(jnp.exp(self._log_prob(_raw(value))))
+
+    def cdf(self, value):
+        return _wrap(self._cdf(_raw(value)))
+
+    def icdf(self, value):
+        return _wrap(self._icdf(_raw(value)))
+
+    @property
+    def mean(self):
+        return _wrap(self._mean())
+
+    @property
+    def variance(self):
+        return _wrap(self._variance())
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(self._variance()))
+
+    def entropy(self):
+        return _wrap(self._entropy())
+
+    def perplexity(self):
+        return _wrap(jnp.exp(self._entropy()))
+
+    def _batch_shape(self):
+        return ()
+
+    def _cdf(self, value):
+        raise NotImplementedError
+
+    def _icdf(self, value):
+        raise NotImplementedError
+
+    def _entropy(self):
+        raise NotImplementedError
+
+    def _mean(self):
+        raise NotImplementedError
+
+    def _variance(self):
+        raise NotImplementedError
+
+    def broadcast_to(self, batch_shape):
+        return self
+
+
+class ExponentialFamily(Distribution):
+    """Reference: distributions/exp_family.py."""
+
+
+class Normal(ExponentialFamily):
+    """Reference: distributions/normal.py."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return self.loc + self.scale * jax.random.normal(key, shape)
+
+    def _log_prob(self, x):
+        var = self.scale ** 2
+        return (-((x - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def _cdf(self, x):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (x - self.loc) / (self.scale * math.sqrt(2.0))))
+
+    def _icdf(self, q):
+        return self.loc + self.scale * math.sqrt(2.0) * \
+            jax.scipy.special.erfinv(2 * q - 1)
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch_shape())
+
+    def _variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self._batch_shape())
+
+    def _entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            jnp.broadcast_to(self.scale, self._batch_shape()))
+
+
+class Laplace(Distribution):
+    """Reference: distributions/laplace.py."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return self.loc + self.scale * jax.random.laplace(key, shape)
+
+    def _log_prob(self, x):
+        return -jnp.abs(x - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    def _cdf(self, x):
+        z = (x - self.loc) / self.scale
+        return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+    def _mean(self):
+        return jnp.broadcast_to(self.loc, self._batch_shape())
+
+    def _variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self._batch_shape())
+
+    def _entropy(self):
+        return 1 + jnp.log(2 * jnp.broadcast_to(self.scale,
+                                                self._batch_shape()))
+
+
+class Cauchy(Distribution):
+    """Reference: distributions/cauchy.py."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return self.loc + self.scale * jax.random.cauchy(key, shape)
+
+    def _log_prob(self, x):
+        z = (x - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def _cdf(self, x):
+        return jnp.arctan((x - self.loc) / self.scale) / math.pi + 0.5
+
+    def _icdf(self, q):
+        return self.loc + self.scale * jnp.tan(math.pi * (q - 0.5))
+
+    def _entropy(self):
+        return jnp.log(4 * math.pi * jnp.broadcast_to(
+            self.scale, self._batch_shape()))
+
+
+class HalfCauchy(Distribution):
+    """Reference: distributions/half_cauchy.py."""
+
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.shape(self.scale)
+
+    def _sample(self, key, shape):
+        return jnp.abs(self.scale * jax.random.cauchy(key, shape))
+
+    def _log_prob(self, x):
+        z = x / self.scale
+        lp = math.log(2 / math.pi) - jnp.log(self.scale) - jnp.log1p(z ** 2)
+        return jnp.where(x >= 0, lp, -jnp.inf)
+
+    def _cdf(self, x):
+        return 2 * jnp.arctan(x / self.scale) / math.pi
+
+    def _icdf(self, q):
+        return self.scale * jnp.tan(math.pi * q / 2)
+
+
+class HalfNormal(Distribution):
+    """Reference: distributions/half_normal.py."""
+
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.shape(self.scale)
+
+    def _sample(self, key, shape):
+        return jnp.abs(self.scale * jax.random.normal(key, shape))
+
+    def _log_prob(self, x):
+        lp = (0.5 * math.log(2 / math.pi) - jnp.log(self.scale)
+              - x ** 2 / (2 * self.scale ** 2))
+        return jnp.where(x >= 0, lp, -jnp.inf)
+
+    def _cdf(self, x):
+        return jax.scipy.special.erf(x / (self.scale * math.sqrt(2.0)))
+
+    def _mean(self):
+        return self.scale * math.sqrt(2 / math.pi)
+
+    def _variance(self):
+        return self.scale ** 2 * (1 - 2 / math.pi)
+
+
+class Uniform(Distribution):
+    """Reference: distributions/uniform.py."""
+
+    has_grad = True
+
+    def __init__(self, low=0.0, high=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.low = _raw(low)
+        self.high = _raw(high)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.low),
+                                    jnp.shape(self.high))
+
+    def _sample(self, key, shape):
+        return jax.random.uniform(key, shape) * (self.high - self.low) \
+            + self.low
+
+    def _log_prob(self, x):
+        inside = (x >= self.low) & (x <= self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def _cdf(self, x):
+        return jnp.clip((x - self.low) / (self.high - self.low), 0.0, 1.0)
+
+    def _icdf(self, q):
+        return self.low + q * (self.high - self.low)
+
+    def _mean(self):
+        return (self.low + self.high) / 2
+
+    def _variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def _entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Exponential(ExponentialFamily):
+    """Reference: distributions/exponential.py."""
+
+    has_grad = True
+
+    def __init__(self, rate=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.rate = _raw(rate)
+
+    def _batch_shape(self):
+        return jnp.shape(self.rate)
+
+    def _sample(self, key, shape):
+        return jax.random.exponential(key, shape) / self.rate
+
+    def _log_prob(self, x):
+        return jnp.log(self.rate) - self.rate * x
+
+    def _cdf(self, x):
+        return -jnp.expm1(-self.rate * x)
+
+    def _icdf(self, q):
+        return -jnp.log1p(-q) / self.rate
+
+    def _mean(self):
+        return 1.0 / self.rate
+
+    def _variance(self):
+        return self.rate ** -2
+
+    def _entropy(self):
+        return 1.0 - jnp.log(self.rate)
+
+
+class Gamma(ExponentialFamily):
+    """Reference: distributions/gamma.py (shape/rate parameterization)."""
+
+    has_grad = True
+
+    def __init__(self, shape=1.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.shape_p = _raw(shape)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.shape_p),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return jax.random.gamma(key, self.shape_p, shape) * self.scale
+
+    def _log_prob(self, x):
+        a = self.shape_p
+        return ((a - 1) * jnp.log(x) - x / self.scale
+                - jax.scipy.special.gammaln(a) - a * jnp.log(self.scale))
+
+    def _mean(self):
+        return self.shape_p * self.scale
+
+    def _variance(self):
+        return self.shape_p * self.scale ** 2
+
+    def _entropy(self):
+        a = self.shape_p
+        return (a + jnp.log(self.scale) + jax.scipy.special.gammaln(a)
+                + (1 - a) * jax.scipy.special.digamma(a))
+
+
+class Chi2(Gamma):
+    """Reference: distributions/chi2.py."""
+
+    def __init__(self, df, **kwargs):
+        super().__init__(shape=_raw(df) / 2.0, scale=2.0, **kwargs)
+        self.df = _raw(df)
+
+
+class Beta(ExponentialFamily):
+    """Reference: distributions/beta.py."""
+
+    has_grad = True
+
+    def __init__(self, alpha=1.0, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = _raw(alpha)
+        self.beta = _raw(beta)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.alpha),
+                                    jnp.shape(self.beta))
+
+    def _sample(self, key, shape):
+        return jax.random.beta(key, self.alpha, self.beta, shape)
+
+    def _log_prob(self, x):
+        a, b = self.alpha, self.beta
+        return ((a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x)
+                - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b)))
+
+    def _mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def _variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+
+class Dirichlet(ExponentialFamily):
+    """Reference: distributions/dirichlet.py."""
+
+    has_grad = True
+
+    def __init__(self, alpha, **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        self.alpha = _raw(alpha)
+
+    def _batch_shape(self):
+        return jnp.shape(self.alpha)[:-1]
+
+    def _sample(self, key, shape):
+        return jax.random.dirichlet(key, self.alpha, shape or None)
+
+    def _log_prob(self, x):
+        a = self.alpha
+        norm = jnp.sum(jax.scipy.special.gammaln(a), -1) \
+            - jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return jnp.sum((a - 1) * jnp.log(x), -1) - norm
+
+    def _mean(self):
+        return self.alpha / jnp.sum(self.alpha, -1, keepdims=True)
+
+
+class Gumbel(Distribution):
+    """Reference: distributions/gumbel.py."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return self.loc + self.scale * jax.random.gumbel(key, shape)
+
+    def _log_prob(self, x):
+        z = (x - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def _cdf(self, x):
+        return jnp.exp(-jnp.exp(-(x - self.loc) / self.scale))
+
+    def _mean(self):
+        return self.loc + self.scale * 0.57721566490153286
+
+    def _variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+
+class Pareto(Distribution):
+    """Reference: distributions/pareto.py."""
+
+    has_grad = True
+
+    def __init__(self, alpha, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = _raw(alpha)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.alpha),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return self.scale * jax.random.pareto(key, self.alpha, shape)
+
+    def _log_prob(self, x):
+        lp = (jnp.log(self.alpha) + self.alpha * jnp.log(self.scale)
+              - (self.alpha + 1) * jnp.log(x))
+        return jnp.where(x >= self.scale, lp, -jnp.inf)
+
+    def _cdf(self, x):
+        return 1 - (self.scale / x) ** self.alpha
+
+
+class StudentT(Distribution):
+    """Reference: distributions/studentT.py."""
+
+    has_grad = True
+
+    def __init__(self, df, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.df = _raw(df)
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.df), jnp.shape(self.loc),
+                                    jnp.shape(self.scale))
+
+    def _sample(self, key, shape):
+        return self.loc + self.scale * jax.random.t(key, self.df, shape)
+
+    def _log_prob(self, x):
+        v = self.df
+        z = (x - self.loc) / self.scale
+        return (jax.scipy.special.gammaln((v + 1) / 2)
+                - jax.scipy.special.gammaln(v / 2)
+                - 0.5 * jnp.log(v * math.pi) - jnp.log(self.scale)
+                - (v + 1) / 2 * jnp.log1p(z ** 2 / v))
+
+
+class FisherSnedecor(Distribution):
+    """Reference: distributions/fishersnedecor.py (F distribution)."""
+
+    def __init__(self, df1, df2, **kwargs):
+        super().__init__(**kwargs)
+        self.df1 = _raw(df1)
+        self.df2 = _raw(df2)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.df1),
+                                    jnp.shape(self.df2))
+
+    def _sample(self, key, shape):
+        k1, k2 = jax.random.split(key)
+        c1 = jax.random.chisquare(k1, self.df1, shape)
+        c2 = jax.random.chisquare(k2, self.df2, shape)
+        return (c1 / self.df1) / (c2 / self.df2)
+
+    def _log_prob(self, x):
+        d1, d2 = self.df1, self.df2
+        lb = (jax.scipy.special.gammaln(d1 / 2)
+              + jax.scipy.special.gammaln(d2 / 2)
+              - jax.scipy.special.gammaln((d1 + d2) / 2))
+        return (d1 / 2 * jnp.log(d1 / d2) + (d1 / 2 - 1) * jnp.log(x)
+                - (d1 + d2) / 2 * jnp.log1p(d1 * x / d2) - lb)
+
+
+class Poisson(ExponentialFamily):
+    """Reference: distributions/poisson.py."""
+
+    def __init__(self, rate=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.rate = _raw(rate)
+
+    def _batch_shape(self):
+        return jnp.shape(self.rate)
+
+    def _sample(self, key, shape):
+        return jax.random.poisson(key, self.rate, shape).astype(jnp.float32)
+
+    def _log_prob(self, x):
+        return (x * jnp.log(self.rate) - self.rate
+                - jax.scipy.special.gammaln(x + 1))
+
+    def _mean(self):
+        return self.rate
+
+    def _variance(self):
+        return self.rate
+
+
+class Geometric(Distribution):
+    """Reference: distributions/geometric.py (#failures before success)."""
+
+    def __init__(self, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self.prob = _logit_or_prob(prob, logit)
+
+    def _batch_shape(self):
+        return jnp.shape(self.prob)
+
+    def _sample(self, key, shape):
+        u = jax.random.uniform(key, shape, minval=1e-7)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.prob))
+
+    def _log_prob(self, x):
+        return x * jnp.log1p(-self.prob) + jnp.log(self.prob)
+
+    def _mean(self):
+        return (1 - self.prob) / self.prob
+
+    def _variance(self):
+        return (1 - self.prob) / self.prob ** 2
+
+
+def _logit_or_prob(prob, logit):
+    if (prob is None) == (logit is None):
+        raise ValueError("pass exactly one of prob / logit")
+    return jax.nn.sigmoid(_raw(logit)) if prob is None else _raw(prob)
+
+
+class Bernoulli(ExponentialFamily):
+    """Reference: distributions/bernoulli.py."""
+
+    def __init__(self, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self.prob = _logit_or_prob(prob, logit)
+
+    @property
+    def logit(self):
+        return _wrap(jnp.log(self.prob) - jnp.log1p(-self.prob))
+
+    def _batch_shape(self):
+        return jnp.shape(self.prob)
+
+    def _sample(self, key, shape):
+        return jax.random.bernoulli(key, self.prob, shape).astype(
+            jnp.float32)
+
+    def _log_prob(self, x):
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        return x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+
+    def _mean(self):
+        return self.prob
+
+    def _variance(self):
+        return self.prob * (1 - self.prob)
+
+    def _entropy(self):
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Binomial(Distribution):
+    """Reference: distributions/binomial.py."""
+
+    def __init__(self, n=1, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self.n = _raw(n)
+        self.prob = _logit_or_prob(prob, logit)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.n), jnp.shape(self.prob))
+
+    def _sample(self, key, shape):
+        return jax.random.binomial(key, self.n, self.prob, shape)
+
+    def _log_prob(self, x):
+        n, p = self.n, jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        logc = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(x + 1)
+                - jax.scipy.special.gammaln(n - x + 1))
+        return logc + x * jnp.log(p) + (n - x) * jnp.log1p(-p)
+
+    def _mean(self):
+        return self.n * self.prob
+
+    def _variance(self):
+        return self.n * self.prob * (1 - self.prob)
+
+
+class NegativeBinomial(Distribution):
+    """Reference: distributions/negative_binomial.py."""
+
+    def __init__(self, n, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self.n = _raw(n)
+        self.prob = _logit_or_prob(prob, logit)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(jnp.shape(self.n), jnp.shape(self.prob))
+
+    def _sample(self, key, shape):
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, self.n, shape) \
+            * (1 - self.prob) / self.prob
+        return jax.random.poisson(k2, lam).astype(jnp.float32)
+
+    def _log_prob(self, x):
+        n, p = self.n, jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        logc = (jax.scipy.special.gammaln(x + n)
+                - jax.scipy.special.gammaln(x + 1)
+                - jax.scipy.special.gammaln(n))
+        return logc + n * jnp.log(p) + x * jnp.log1p(-p)
+
+    def _mean(self):
+        return self.n * (1 - self.prob) / self.prob
+
+
+class Categorical(Distribution):
+    """Reference: distributions/categorical.py."""
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        if prob is not None:
+            self.logit = jnp.log(jnp.clip(_raw(prob), 1e-30))
+        elif logit is not None:
+            self.logit = _raw(logit)
+        else:
+            raise ValueError("pass prob or logit")
+        self.num_events = self.logit.shape[-1]
+
+    @property
+    def prob(self):
+        return _wrap(jax.nn.softmax(self.logit, -1))
+
+    def _batch_shape(self):
+        return jnp.shape(self.logit)[:-1]
+
+    def _sample(self, key, shape):
+        return jax.random.categorical(
+            key, self.logit,
+            shape=shape or None).astype(jnp.float32)
+
+    def _log_prob(self, x):
+        logp = jax.nn.log_softmax(self.logit, -1)
+        return jnp.take_along_axis(
+            logp, x[..., None].astype(jnp.int32), -1)[..., 0]
+
+    def _entropy(self):
+        logp = jax.nn.log_softmax(self.logit, -1)
+        return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+
+class OneHotCategorical(Categorical):
+    """Reference: distributions/one_hot_categorical.py."""
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kwargs):
+        super().__init__(num_events, prob, logit, **kwargs)
+        self.event_dim = 1
+
+    def _sample(self, key, shape):
+        idx = jax.random.categorical(key, self.logit, shape=shape or None)
+        return jax.nn.one_hot(idx, self.num_events)
+
+    def _log_prob(self, x):
+        logp = jax.nn.log_softmax(self.logit, -1)
+        return jnp.sum(logp * x, -1)
+
+
+class Multinomial(Distribution):
+    """Reference: distributions/multinomial.py."""
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        if prob is not None:
+            self.prob_ = _raw(prob)
+        else:
+            self.prob_ = jax.nn.softmax(_raw(logit), -1)
+        self.total_count = total_count
+        self.num_events = self.prob_.shape[-1]
+
+    def _batch_shape(self):
+        return jnp.shape(self.prob_)[:-1]
+
+    def _sample(self, key, shape):
+        n = self.total_count
+        idx = jax.random.categorical(
+            key, jnp.log(jnp.clip(self.prob_, 1e-30)),
+            shape=(n,) + tuple(shape or self._batch_shape()))
+        return jnp.sum(jax.nn.one_hot(idx, self.num_events), axis=0)
+
+    def _log_prob(self, x):
+        logc = (jax.scipy.special.gammaln(jnp.sum(x, -1) + 1)
+                - jnp.sum(jax.scipy.special.gammaln(x + 1), -1))
+        return logc + jnp.sum(x * jnp.log(jnp.clip(self.prob_, 1e-30)), -1)
+
+
+class MultivariateNormal(Distribution):
+    """Reference: distributions/multivariate_normal.py."""
+
+    has_grad = True
+
+    def __init__(self, loc, cov=None, precision=None, scale_tril=None,
+                 **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        self.loc = _raw(loc)
+        if scale_tril is not None:
+            self.scale_tril = _raw(scale_tril)
+        elif cov is not None:
+            self.scale_tril = jnp.linalg.cholesky(_raw(cov))
+        elif precision is not None:
+            self.scale_tril = jnp.linalg.cholesky(
+                jnp.linalg.inv(_raw(precision)))
+        else:
+            raise ValueError("pass cov, precision, or scale_tril")
+
+    @property
+    def cov(self):
+        return _wrap(self.scale_tril @ jnp.swapaxes(self.scale_tril, -1, -2))
+
+    def _batch_shape(self):
+        return jnp.shape(self.loc)[:-1]
+
+    def _sample(self, key, shape):
+        d = self.loc.shape[-1]
+        eps = jax.random.normal(key, tuple(shape) + (d,))
+        return self.loc + jnp.einsum("...ij,...j->...i", self.scale_tril, eps)
+
+    def _log_prob(self, x):
+        d = self.loc.shape[-1]
+        diff = x - self.loc
+        # triangular_solve needs matching batch dims
+        tril = jnp.broadcast_to(
+            self.scale_tril, diff.shape[:-1] + self.scale_tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(
+            tril, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, -1)
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                              axis2=-1)), -1)
+        return -0.5 * (d * math.log(2 * math.pi) + maha) - logdet
+
+    def _mean(self):
+        return self.loc
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference: independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims, **kwargs):
+        super().__init__(**kwargs)
+        self.base_dist = base
+        self.ndims = reinterpreted_batch_ndims
+        self.has_grad = base.has_grad
+        self.event_dim = base.event_dim + reinterpreted_batch_ndims
+
+    def _batch_shape(self):
+        full = self.base_dist._batch_shape()
+        return full[:len(full) - self.ndims]
+
+    def _sample(self, key, shape):
+        # shape excludes reinterpreted dims; base adds them back
+        base_batch = self.base_dist._batch_shape()
+        extra = base_batch[len(base_batch) - self.ndims:]
+        return self.base_dist._sample(key, tuple(shape) + tuple(extra))
+
+    def _log_prob(self, x):
+        lp = self.base_dist._log_prob(x)
+        for _ in range(self.ndims):
+            lp = jnp.sum(lp, -1)
+        return lp
+
+    def _mean(self):
+        return self.base_dist._mean()
+
+
+class RelaxedBernoulli(Distribution):
+    """Gumbel-sigmoid relaxation (reference: relaxed_bernoulli.py)."""
+
+    has_grad = True
+
+    def __init__(self, T=1.0, prob=None, logit=None, **kwargs):
+        super().__init__(**kwargs)
+        self.T = _raw(T)
+        self.prob = _logit_or_prob(prob, logit)
+
+    def _batch_shape(self):
+        return jnp.shape(self.prob)
+
+    def _sample(self, key, shape):
+        logit = jnp.log(jnp.clip(self.prob, 1e-7)) \
+            - jnp.log1p(-jnp.clip(self.prob, None, 1 - 1e-7))
+        u = jax.random.uniform(key, shape, minval=1e-7, maxval=1 - 1e-7)
+        noise = jnp.log(u) - jnp.log1p(-u)
+        return jax.nn.sigmoid((logit + noise) / self.T)
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax relaxation (reference: relaxed_one_hot_categorical.py)."""
+
+    has_grad = True
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 **kwargs):
+        super().__init__(event_dim=1, **kwargs)
+        self.T = _raw(T)
+        if prob is not None:
+            self.logit = jnp.log(jnp.clip(_raw(prob), 1e-30))
+        else:
+            self.logit = _raw(logit)
+
+    def _batch_shape(self):
+        return jnp.shape(self.logit)[:-1]
+
+    def _sample(self, key, shape):
+        g = jax.random.gumbel(
+            key, tuple(shape) + (self.logit.shape[-1],))
+        return jax.nn.softmax((self.logit + g) / self.T, -1)
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: distributions/divergence.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    """KL(p || q) (reference: divergence.py kl_divergence)."""
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return _wrap(fn(p, q))
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.prob, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.prob, 1e-7, 1 - 1e-7)
+    return (pp * (jnp.log(pp) - jnp.log(qp))
+            + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    logp = jax.nn.log_softmax(p.logit, -1)
+    logq = jax.nn.log_softmax(q.logit, -1)
+    return jnp.sum(jnp.exp(logp) * (logp - logq), -1)
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_ohc_ohc(p, q):
+    return _kl_cat_cat(p, q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    below = (p.low < q.low) | (p.high > q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return jnp.where(below, jnp.inf, kl)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    ratio = q.rate / p.rate
+    return ratio - 1 - jnp.log(ratio)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    ap, aq = p.shape_p, q.shape_p
+    bp, bq = 1 / p.scale, 1 / q.scale
+    return ((ap - aq) * jax.scipy.special.digamma(ap)
+            - jax.scipy.special.gammaln(ap) + jax.scipy.special.gammaln(aq)
+            + aq * (jnp.log(bp) - jnp.log(bq)) + ap * (bq - bp) / bp)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    d = p.loc.shape[-1]
+    q_tril = q.scale_tril
+    p_tril = p.scale_tril
+    logdet_q = jnp.sum(jnp.log(jnp.diagonal(q_tril, axis1=-2, axis2=-1)), -1)
+    logdet_p = jnp.sum(jnp.log(jnp.diagonal(p_tril, axis1=-2, axis2=-1)), -1)
+    m = jax.scipy.linalg.solve_triangular(q_tril, p_tril, lower=True)
+    tr = jnp.sum(m ** 2, axis=(-2, -1))
+    diff = q.loc - p.loc
+    sol = jax.scipy.linalg.solve_triangular(
+        q_tril, diff[..., None], lower=True)[..., 0]
+    maha = jnp.sum(sol ** 2, -1)
+    return logdet_q - logdet_p + 0.5 * (tr + maha - d)

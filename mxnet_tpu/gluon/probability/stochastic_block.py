@@ -1,0 +1,49 @@
+"""StochasticBlock — blocks that accumulate auxiliary (e.g. KL) losses.
+
+Reference parity: python/mxnet/gluon/probability/block/stochastic_block.py
+(StochasticBlock.add_loss / collectLoss decorator; used for VAEs where the
+forward adds a KL term collected by the trainer).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..block import HybridBlock
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock whose forward can stash intermediate losses.
+
+    Decorate forward with ``StochasticBlock.collectLoss``; inside, call
+    ``self.add_loss(term)``. After calling the block, read ``block.losses``.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._flag = False
+
+    def add_loss(self, loss):
+        self._losses.append(loss)
+
+    @staticmethod
+    def collectLoss(forward_fn):
+        @functools.wraps(forward_fn)
+        def wrapped(self, *args, **kwargs):
+            self._losses = []
+            out = forward_fn(self, *args, **kwargs)
+            self._flag = True
+            return out
+        return wrapped
+
+    @property
+    def losses(self):
+        if not self._flag:
+            raise ValueError(
+                "call the block (with a @StochasticBlock.collectLoss "
+                "forward) before reading losses")
+        return self._losses
+
+
+class StochasticBlockGrad(StochasticBlock):
+    """Kept for API parity (reference exports both names)."""

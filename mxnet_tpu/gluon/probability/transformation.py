@@ -1,0 +1,170 @@
+"""Bijective transformations + TransformedDistribution.
+
+Reference parity: python/mxnet/gluon/probability/transformation/
+(transformation.py Transformation/ComposeTransform/Exp/Affine/Sigmoid/...,
+distributions/transformed_distribution.py). log_det_jacobian terms follow
+the change-of-variables formula; everything jnp-composable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...numpy.multiarray import ndarray, _wrap
+from .distributions import Distribution
+
+
+def _raw(x):
+    return x._data if isinstance(x, ndarray) else jnp.asarray(x)
+
+
+class Transformation:
+    """Reference: transformation.py Transformation."""
+
+    bijective = True
+    sign = 1
+
+    def __call__(self, x):
+        return _wrap(self._forward(_raw(x)))
+
+    def inv(self, y):
+        return _wrap(self._inverse(_raw(y)))
+
+    def log_det_jacobian(self, x, y):
+        return _wrap(self._log_det(_raw(x), _raw(y)))
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det(self, x, y):
+        raise NotImplementedError
+
+
+class ExpTransform(Transformation):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _log_det(self, x, y):
+        return x
+
+
+class LogTransform(Transformation):
+    def _forward(self, x):
+        return jnp.log(x)
+
+    def _inverse(self, y):
+        return jnp.exp(y)
+
+    def _log_det(self, x, y):
+        return -jnp.log(x)
+
+
+class AffineTransform(Transformation):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _log_det(self, x, y):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class SigmoidTransform(Transformation):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det(self, x, y):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class AbsTransform(Transformation):
+    bijective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class PowerTransform(Transformation):
+    def __init__(self, exponent):
+        self.exponent = _raw(exponent)
+
+    def _forward(self, x):
+        return jnp.power(x, self.exponent)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.exponent)
+
+    def _log_det(self, x, y):
+        return jnp.log(jnp.abs(self.exponent * y / x))
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        self.parts = list(parts)
+
+    def _forward(self, x):
+        for t in self.parts:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.parts):
+            y = t._inverse(y)
+        return y
+
+    def _log_det(self, x, y):
+        total = 0.0
+        cur = x
+        for t in self.parts:
+            nxt = t._forward(cur)
+            total = total + t._log_det(cur, nxt)
+            cur = nxt
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of T(X) for X ~ base (reference:
+    transformed_distribution.py)."""
+
+    def __init__(self, base, transforms, **kwargs):
+        super().__init__(**kwargs)
+        self.base_dist = base
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        self.has_grad = base.has_grad
+
+    def _batch_shape(self):
+        return self.base_dist._batch_shape()
+
+    def _sample(self, key, shape):
+        x = self.base_dist._sample(key, shape)
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _log_prob(self, y):
+        lp = 0.0
+        cur = y
+        for t in reversed(self.transforms):
+            x = t._inverse(cur)
+            lp = lp - t._log_det(x, cur)
+            cur = x
+        return lp + self.base_dist._log_prob(cur)

@@ -1,0 +1,209 @@
+"""gluon.probability tests.
+
+Reference strategy: tests/python/unittest/test_gluon_probability_v2.py
+(sampling shapes, log_prob vs scipy oracle, KL closed forms). scipy isn't
+in this image, so oracles are torch.distributions (torch cpu is baked in).
+"""
+import numpy as onp
+import pytest
+import torch
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as np
+from mxnet_tpu.gluon import probability as mgp
+
+
+def setup_module():
+    mx.random.seed(0)
+    onp.random.seed(0)
+
+
+def _assert_logprob(dist, tdist, values, atol=1e-4):
+    got = dist.log_prob(np.array(values.astype("float32"))).asnumpy()
+    want = tdist.log_prob(torch.tensor(values)).numpy()
+    onp.testing.assert_allclose(got, want, atol=atol, rtol=1e-4)
+
+
+def test_normal_against_torch():
+    loc, scale = onp.array([0.0, 1.5]), onp.array([1.0, 2.0])
+    d = mgp.Normal(loc, scale)
+    t = torch.distributions.Normal(torch.tensor(loc), torch.tensor(scale))
+    x = onp.array([[0.3, -1.2], [2.0, 0.0]])
+    _assert_logprob(d, t, x)
+    onp.testing.assert_allclose(d.mean.asnumpy(), loc)
+    onp.testing.assert_allclose(d.variance.asnumpy(), scale ** 2)
+    onp.testing.assert_allclose(d.entropy().asnumpy(),
+                                t.entropy().numpy(), atol=1e-5)
+    onp.testing.assert_allclose(
+        d.cdf(np.array(x.astype("float32"))).asnumpy(),
+        t.cdf(torch.tensor(x)).numpy(), atol=1e-5)
+    assert d.sample((7,)).shape == (7, 2)
+
+
+@pytest.mark.parametrize("mk_ours,mk_torch,values", [
+    (lambda: mgp.Laplace(0.5, 2.0),
+     lambda: torch.distributions.Laplace(0.5, 2.0),
+     onp.array([0.1, -3.0, 4.0])),
+    (lambda: mgp.Cauchy(0.0, 1.5),
+     lambda: torch.distributions.Cauchy(0.0, 1.5),
+     onp.array([0.4, -2.0])),
+    (lambda: mgp.Exponential(2.0),
+     lambda: torch.distributions.Exponential(2.0),
+     onp.array([0.5, 3.0])),
+    (lambda: mgp.Gamma(3.0, 0.5),
+     lambda: torch.distributions.Gamma(3.0, 2.0),  # torch uses rate
+     onp.array([0.7, 2.2])),
+    (lambda: mgp.Beta(2.0, 3.0),
+     lambda: torch.distributions.Beta(2.0, 3.0),
+     onp.array([0.2, 0.8])),
+    (lambda: mgp.Gumbel(1.0, 2.0),
+     lambda: torch.distributions.Gumbel(1.0, 2.0),
+     onp.array([0.0, 4.0])),
+    (lambda: mgp.Poisson(3.0),
+     lambda: torch.distributions.Poisson(3.0),
+     onp.array([0.0, 2.0, 7.0])),
+    (lambda: mgp.StudentT(5.0, 0.0, 1.0),
+     lambda: torch.distributions.StudentT(5.0),
+     onp.array([0.3, -2.0])),
+    (lambda: mgp.HalfNormal(2.0),
+     lambda: torch.distributions.HalfNormal(2.0),
+     onp.array([0.5, 3.0])),
+    (lambda: mgp.Uniform(-1.0, 3.0),
+     lambda: torch.distributions.Uniform(-1.0, 3.0),
+     onp.array([0.0, 2.9])),
+])
+def test_logprob_oracles(mk_ours, mk_torch, values):
+    _assert_logprob(mk_ours(), mk_torch(), values)
+
+
+def test_bernoulli_categorical():
+    p = onp.array([0.2, 0.7])
+    d = mgp.Bernoulli(prob=p)
+    t = torch.distributions.Bernoulli(torch.tensor(p))
+    x = onp.array([[0.0, 1.0], [1.0, 0.0]])
+    _assert_logprob(d, t, x)
+    onp.testing.assert_allclose(d.entropy().asnumpy(), t.entropy().numpy(),
+                                atol=1e-5)
+
+    logits = onp.random.randn(4, 5)
+    d = mgp.Categorical(logit=logits)
+    t = torch.distributions.Categorical(logits=torch.tensor(logits))
+    x = onp.array([0.0, 3.0, 1.0, 4.0])
+    _assert_logprob(d, t, x)
+    s = d.sample()
+    assert s.shape == (4,)
+    # one-hot variant
+    d = mgp.OneHotCategorical(logit=logits)
+    s = d.sample()
+    assert s.shape == (4, 5)
+    assert onp.allclose(s.asnumpy().sum(-1), 1.0)
+
+
+def test_mvn_against_torch():
+    loc = onp.zeros(3)
+    a = onp.random.randn(3, 3)
+    cov = a @ a.T + 3 * onp.eye(3)
+    d = mgp.MultivariateNormal(loc, cov=cov)
+    t = torch.distributions.MultivariateNormal(
+        torch.tensor(loc), covariance_matrix=torch.tensor(cov))
+    x = onp.random.randn(6, 3)
+    _assert_logprob(d, t, x)
+    assert d.sample((5,)).shape == (5, 3)
+
+
+def test_kl_closed_forms():
+    p = mgp.Normal(0.0, 1.0)
+    q = mgp.Normal(1.0, 2.0)
+    tp = torch.distributions.Normal(0.0, 1.0)
+    tq = torch.distributions.Normal(1.0, 2.0)
+    onp.testing.assert_allclose(
+        mgp.kl_divergence(p, q).asnumpy(),
+        torch.distributions.kl_divergence(tp, tq).numpy(), atol=1e-5)
+
+    logits = onp.random.randn(3, 4)
+    logits2 = onp.random.randn(3, 4)
+    kl = mgp.kl_divergence(mgp.Categorical(logit=logits),
+                           mgp.Categorical(logit=logits2))
+    tkl = torch.distributions.kl_divergence(
+        torch.distributions.Categorical(logits=torch.tensor(logits)),
+        torch.distributions.Categorical(logits=torch.tensor(logits2)))
+    onp.testing.assert_allclose(kl.asnumpy(), tkl.numpy(), atol=1e-5)
+
+
+def test_sampling_statistics():
+    mx.random.seed(3)
+    s = mgp.Normal(2.0, 0.5).sample((20000,)).asnumpy()
+    assert abs(s.mean() - 2.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+    s = mgp.Bernoulli(prob=0.3).sample((20000,)).asnumpy()
+    assert abs(s.mean() - 0.3) < 0.02
+    s = mgp.Gamma(2.0, 1.5).sample((20000,)).asnumpy()
+    assert abs(s.mean() - 3.0) < 0.1
+
+
+def test_rsample_gradient_flows():
+    """Pathwise gradient through a reparameterized sampler."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(mu):
+        mx.random.seed(0)
+        d = mgp.Normal(mu, 1.0)
+        return d.rsample((100,))._data.mean()
+
+    g = jax.grad(lambda mu: f(mu))(jnp.float32(0.5))
+    assert abs(float(g) - 1.0) < 1e-4  # d/dmu E[mu + eps] = 1
+
+
+def test_transformed_distribution():
+    base = mgp.Normal(0.0, 1.0)
+    logn = mgp.TransformedDistribution(base, mgp.ExpTransform())
+    t = torch.distributions.LogNormal(0.0, 1.0)
+    x = onp.array([0.5, 1.5, 3.0])
+    got = logn.log_prob(np.array(x.astype("float32"))).asnumpy()
+    onp.testing.assert_allclose(got, t.log_prob(torch.tensor(x)).numpy(),
+                                atol=1e-5)
+    s = logn.sample((10,))
+    assert bool((s.asnumpy() > 0).all())
+    # affine + sigmoid compose
+    comp = mgp.TransformedDistribution(
+        base, mgp.ComposeTransform([
+            mgp.AffineTransform(1.0, 2.0), mgp.SigmoidTransform()]))
+    assert comp.sample((4,)).shape == (4,)
+
+
+def test_independent():
+    d = mgp.Independent(mgp.Normal(onp.zeros((3, 4)), onp.ones((3, 4))), 1)
+    x = onp.random.randn(3, 4)
+    lp = d.log_prob(np.array(x.astype("float32")))
+    t = torch.distributions.Independent(
+        torch.distributions.Normal(torch.zeros(3, 4), torch.ones(3, 4)), 1)
+    onp.testing.assert_allclose(lp.asnumpy(),
+                                t.log_prob(torch.tensor(x)).numpy(),
+                                atol=1e-4)
+
+
+def test_stochastic_block_vae_style():
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.probability import StochasticBlock
+
+    class TinyVAE(StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.enc = nn.Dense(4, flatten=False)
+            self.dec = nn.Dense(8, flatten=False)
+
+        @StochasticBlock.collectLoss
+        def forward(self, x):
+            h = self.enc(x)
+            q = mgp.Normal(h, 1.0)
+            z = q.rsample()
+            self.add_loss(mgp.kl_divergence(q, mgp.Normal(0.0, 1.0)))
+            return self.dec(z)
+
+    net = TinyVAE()
+    net.initialize()
+    out = net(np.ones((2, 8)))
+    assert out.shape == (2, 8)
+    assert len(net.losses) == 1
+    assert net.losses[0].shape == (2, 4)
