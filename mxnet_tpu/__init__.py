@@ -46,6 +46,8 @@ from . import util
 from . import runtime
 from . import library
 from . import test_utils
+from . import symbol
+from . import symbol as sym
 from . import recordio
 from . import io
 from . import image

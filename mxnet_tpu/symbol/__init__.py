@@ -1,0 +1,18 @@
+"""mx.sym — symbolic graph frontend.
+
+Reference parity: python/mxnet/symbol/ (15.8k LoC: Symbol graph building
+over NNVM, bind/simple_bind executors, tojson/load). TPU-native design: a
+Symbol is a small python DAG over the same op implementations the eager
+frontend uses; ``bind`` interprets it eagerly (NDArray ops → XLA) and
+``Executor.forward`` under jit via hybridization semantics. The graph
+serializes to the reference's json shape (nodes/arg_nodes/heads) so
+model-symbol.json round-trips.
+"""
+from .symbol import (  # noqa: F401
+    Symbol, Variable, var, Group, load, load_json, Executor,
+)
+from . import symbol as _symbol_mod
+
+
+def __getattr__(name):
+    return getattr(_symbol_mod, name)

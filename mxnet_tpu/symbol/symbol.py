@@ -1,0 +1,312 @@
+"""Symbol graph + executor.
+
+Reference parity: python/mxnet/symbol/symbol.py (class Symbol: composition,
+list_arguments, infer_shape, bind, eval, tojson/fromjson; executor.py
+Executor.forward/backward). The graph is a python DAG whose ops are names
+resolved against mx.np / mx.npx / mx.sym registries — the same callables
+eager mode uses, so symbolic results match imperative results exactly.
+"""
+from __future__ import annotations
+
+import json
+
+from ..base import MXNetError
+from ..numpy.multiarray import ndarray
+
+
+class Symbol:
+    """A node in the symbolic graph."""
+
+    def __init__(self, op, inputs, kwargs=None, name=None, num_outputs=1,
+                 output_index=None):
+        self._op = op                  # op name string; None for variables
+        self._inputs = list(inputs)    # Symbol inputs
+        self._kwargs = dict(kwargs or {})
+        self.name = name or (op if op else "sym")
+        self._num_outputs = num_outputs
+        self._output_index = output_index
+
+    # -- composition --------------------------------------------------------
+    def __add__(self, other):
+        return _make("add", self, other)
+
+    def __radd__(self, other):
+        return _make("add", other, self)
+
+    def __sub__(self, other):
+        return _make("subtract", self, other)
+
+    def __rsub__(self, other):
+        return _make("subtract", other, self)
+
+    def __mul__(self, other):
+        return _make("multiply", self, other)
+
+    def __rmul__(self, other):
+        return _make("multiply", other, self)
+
+    def __truediv__(self, other):
+        return _make("divide", self, other)
+
+    def __rtruediv__(self, other):
+        return _make("divide", other, self)
+
+    def __pow__(self, other):
+        return _make("power", self, other)
+
+    def __neg__(self):
+        return _make("negative", self)
+
+    def __getitem__(self, index):
+        if isinstance(index, int) and self._num_outputs > 1:
+            return Symbol(self._op, self._inputs, self._kwargs,
+                          f"{self.name}[{index}]", self._num_outputs, index)
+        return _make("slice_index", self, index=index)
+
+    def attr(self, key):
+        return self._kwargs.get(key)
+
+    # -- introspection ------------------------------------------------------
+    def _topo(self):
+        seen, order = set(), []
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            order.append(s)
+        visit(self)
+        return order
+
+    def list_arguments(self):
+        """Free variables in topological order (reference:
+        symbol.py list_arguments)."""
+        return [s.name for s in self._topo() if s._op is None]
+
+    def list_outputs(self):
+        return [self.name + "_output"]
+
+    def get_internals(self):
+        return Group([s for s in self._topo() if s._op is not None] or [self])
+
+    def infer_shape(self, **kwargs):
+        """Shape inference by abstract evaluation (reference infer_shape)."""
+        import jax
+        import jax.numpy as jnp
+        args = self.list_arguments()
+        avals = {n: jax.ShapeDtypeStruct(tuple(kwargs[n]), jnp.float32)
+                 for n in args if n in kwargs}
+        if len(avals) != len(args):
+            missing = [n for n in args if n not in avals]
+            raise MXNetError(f"infer_shape missing args {missing}")
+
+        def fn(vals):
+            out = self._eval_with(vals)
+            unwrap = lambda o: o._data if isinstance(o, ndarray) else o
+            if isinstance(out, (list, tuple)):
+                return [unwrap(o) for o in out]
+            return unwrap(out)
+        out = jax.eval_shape(fn, avals)
+        out_shapes = [tuple(o.shape) for o in
+                      (out if isinstance(out, (list, tuple)) else [out])]
+        arg_shapes = [tuple(kwargs[n]) for n in args]
+        return arg_shapes, out_shapes, []
+
+    # -- evaluation ---------------------------------------------------------
+    def _eval_with(self, bindings):
+        """Interpret the DAG with ndarray ops (cached per-node)."""
+        from .. import numpy as np
+        from .. import numpy_extension as npx
+        values = {}
+        for node in self._topo():
+            if node._op is None:
+                if node.name not in bindings:
+                    raise MXNetError(f"unbound variable {node.name!r}")
+                values[id(node)] = bindings[node.name]
+                continue
+            fn = _resolve(node._op)
+            args = [values[id(i)] for i in node._inputs]
+            out = fn(*args, **node._kwargs)
+            if node._output_index is not None:
+                out = out[node._output_index]
+            values[id(node)] = out
+        return values[id(self)]
+
+    def eval(self, ctx=None, **kwargs):
+        """Evaluate with keyword bindings (reference: symbol.py eval)."""
+        out = self._eval_with(kwargs)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             **kwargs):
+        return Executor(self, args or {}, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate zero-filled args from shapes then bind."""
+        from .. import numpy as np
+        args = {n: np.zeros(tuple(shapes[n])) for n in self.list_arguments()}
+        return Executor(self, args, None, grad_req)
+
+    # -- serialization (reference json schema) ------------------------------
+    def tojson(self):
+        nodes, index = [], {}
+        for i, s in enumerate(self._topo()):
+            index[id(s)] = i
+            nodes.append({
+                "op": "null" if s._op is None else s._op,
+                "name": s.name,
+                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in s._kwargs.items()},
+                "inputs": [[index[id(inp)], 0, 0] for inp in s._inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(nodes) if n["op"] == "null"]
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[len(nodes) - 1, 0, 0]],
+            "attrs": {"mxnet_version": ["int", 20000]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+
+class Group(Symbol):
+    """Multiple outputs (reference: symbol.py Group)."""
+
+    def __init__(self, symbols):
+        super().__init__("group", symbols, name="group")
+        self.symbols = symbols
+
+    def _eval_with(self, bindings):
+        return [s._eval_with(bindings) for s in self.symbols]
+
+    def list_outputs(self):
+        return [s.name + "_output" for s in self.symbols]
+
+
+def Variable(name, shape=None, dtype=None, **kwargs):
+    s = Symbol(None, [], kwargs, name)
+    s._shape = shape
+    s._dtype = dtype
+    return s
+
+
+var = Variable
+
+
+def _make(op, *inputs, **kwargs):
+    syms = []
+    for x in inputs:
+        if isinstance(x, Symbol):
+            syms.append(x)
+        else:
+            const = Symbol("constant", [], {"value": x},
+                           name=f"const{len(syms)}")
+            syms.append(const)
+    return Symbol(op, syms, kwargs)
+
+
+def _resolve(op):
+    from .. import numpy as np
+    from .. import numpy_extension as npx
+    if op == "constant":
+        def c(value=None):
+            return np.array(value) if not isinstance(value, ndarray) else value
+        return c
+    if op == "slice_index":
+        return lambda x, index=None: x[index]
+    for mod in (np, npx):
+        fn = getattr(mod, op, None)
+        if fn is not None:
+            return fn
+    raise MXNetError(f"symbolic op {op!r} not found in mx.np/mx.npx")
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from the json schema (reference: fromjson)."""
+    data = json.loads(json_str)
+    built = []
+    for node in data["nodes"]:
+        kwargs = {}
+        for k, v in node.get("attrs", {}).items():
+            try:
+                kwargs[k] = json.loads(v)
+            except (json.JSONDecodeError, TypeError):
+                kwargs[k] = v
+        if node["op"] == "null":
+            built.append(Variable(node["name"], **kwargs))
+        else:
+            inputs = [built[i] for i, _, _ in node["inputs"]]
+            built.append(Symbol(node["op"], inputs, kwargs, node["name"]))
+    head = data["heads"][0][0]
+    return built[head]
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+class Executor:
+    """Reference: python/mxnet/executor.py Executor (bind product).
+
+    forward() interprets the graph with eager XLA ops; backward() records
+    a tape over the forward and writes arg grads (grad_req='write'/'add').
+    """
+
+    def __init__(self, symbol, args, args_grad=None, grad_req="write"):
+        self._symbol = symbol
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self._grad_req = grad_req
+        self.outputs = []
+
+    def forward(self, is_train=False, **kwargs):
+        self.arg_dict.update(kwargs)
+        if is_train:
+            from .. import autograd
+            for v in self.arg_dict.values():
+                if isinstance(v, ndarray) and v._grad_req == "null":
+                    v.attach_grad(self._grad_req)
+            with autograd.record():
+                out = self._symbol._eval_with(self.arg_dict)
+                self._recorded = out
+        else:
+            out = self._symbol._eval_with(self.arg_dict)
+        self.outputs = out if isinstance(out, (list, tuple)) else [out]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from .. import autograd
+        if not self.outputs:
+            raise MXNetError("call forward(is_train=True) first")
+        autograd.backward(self.outputs, out_grads)
+        for name, arr in self.arg_dict.items():
+            if isinstance(arr, ndarray) and arr.grad is not None:
+                self.grad_dict[name] = arr.grad
+        return self.grad_dict
+
+
+def __getattr__(name):
+    """Any mx.np / mx.npx op lifted to symbolic composition (the analog of
+    symbol/register.py generated wrappers)."""
+    from .. import numpy as np
+    from .. import numpy_extension as npx
+    target = getattr(np, name, None) or getattr(npx, name, None)
+    if target is None or not callable(target):
+        raise AttributeError(name)
+
+    def symbolic(*args, **kwargs):
+        if any(isinstance(a, Symbol) for a in args):
+            return _make(name, *args, **kwargs)
+        return target(*args, **kwargs)
+    symbolic.__name__ = name
+    return symbolic

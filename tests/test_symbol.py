@@ -1,0 +1,87 @@
+"""mx.sym tests (reference strategy: tests/python/unittest/test_symbol.py:
+composition, list_arguments, infer_shape, eval-vs-imperative equality,
+json round-trip, executor forward/backward)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as np
+from mxnet_tpu import symbol as sym
+
+
+def test_compose_and_eval_matches_imperative():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * a - 2.0 / (b + 1.0)
+    av = np.array(onp.random.rand(3, 4).astype("float32"))
+    bv = np.array(onp.random.rand(3, 4).astype("float32"))
+    out = c.eval(a=av, b=bv)[0]
+    want = (av + bv) * av - 2.0 / (bv + 1.0)
+    onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(), rtol=1e-6)
+
+
+def test_list_arguments_and_ops():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.dot(x, w)
+    z = sym.tanh(y)
+    assert z.list_arguments() == ["x", "w"]
+    xv = np.array(onp.random.rand(2, 3).astype("float32"))
+    wv = np.array(onp.random.rand(3, 5).astype("float32"))
+    out = z.eval(x=xv, w=wv)[0]
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.tanh(xv.asnumpy() @ wv.asnumpy()),
+                                atol=1e-5)
+
+
+def test_npx_ops_symbolic():
+    x = sym.var("x")
+    y = sym.softmax(x, axis=-1)
+    xv = np.array(onp.random.rand(2, 5).astype("float32"))
+    out = y.eval(x=xv)[0].asnumpy()
+    onp.testing.assert_allclose(out.sum(-1), onp.ones(2), atol=1e-6)
+
+
+def test_infer_shape():
+    x = sym.var("x")
+    w = sym.var("w")
+    z = sym.dot(x, w)
+    arg_shapes, out_shapes, _ = z.infer_shape(x=(2, 3), w=(3, 7))
+    assert out_shapes == [(2, 7)]
+    assert arg_shapes == [(2, 3), (3, 7)]
+
+
+def test_json_roundtrip():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.maximum(a * 2.0, b)
+    js = c.tojson()
+    c2 = sym.load_json(js)
+    assert c2.list_arguments() == c.list_arguments()
+    av = np.array(onp.random.rand(4).astype("float32"))
+    bv = np.array(onp.random.rand(4).astype("float32"))
+    onp.testing.assert_allclose(c.eval(a=av, b=bv)[0].asnumpy(),
+                                c2.eval(a=av, b=bv)[0].asnumpy())
+
+
+def test_executor_forward_backward():
+    x = sym.var("x")
+    w = sym.var("w")
+    loss = sym.sum(sym.square(sym.dot(x, w)))
+    xv = np.array(onp.random.rand(2, 3).astype("float32"))
+    wv = np.array(onp.random.rand(3, 1).astype("float32"))
+    exe = loss.bind(args={"x": xv, "w": wv})
+    (out,) = exe.forward(is_train=True)
+    exe.backward()
+    # oracle: d/dw sum((xw)^2) = 2 x^T (x w)
+    xw = xv.asnumpy() @ wv.asnumpy()
+    onp.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
+                                2 * xv.asnumpy().T @ xw, rtol=1e-4)
+
+
+def test_group_outputs():
+    a = sym.var("a")
+    g = sym.Group([a + 1.0, a * 3.0])
+    av = np.array(onp.ones(2, dtype="float32"))
+    o1, o2 = g.eval(a=av)
+    onp.testing.assert_allclose(o1.asnumpy(), [2, 2])
+    onp.testing.assert_allclose(o2.asnumpy(), [3, 3])
