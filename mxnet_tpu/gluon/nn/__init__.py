@@ -13,4 +13,9 @@ from .conv_layers import (  # noqa: F401
 from .activations import (  # noqa: F401
     Activation, LeakyReLU, PReLU, ELU, SELU, GELU, SiLU, Swish,
 )
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, PositionwiseFFN, TransformerEncoder,
+    TransformerEncoderCell, TransformerDecoderCell,
+)
+from .moe import MoEDense  # noqa: F401
 from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401

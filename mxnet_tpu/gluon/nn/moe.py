@@ -1,0 +1,113 @@
+"""Mixture-of-Experts layers (expert parallelism).
+
+Reference parity: none (the reference has no MoE — SURVEY §2.3 marks EP
+out of its scope; first-class here per the long-context/distributed brief).
+Design: Switch/Top-k router + experts stored as stacked weight tensors with
+a leading expert dim. Dispatch/combine are einsums over a one-hot dispatch
+mask — the GSPMD-friendly formulation: shard the expert dim over an 'ep'
+mesh axis (megatron_specs analog: P('ep', ...)) and XLA inserts the
+all-to-alls. Capacity-factor truncation keeps shapes static for jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import random as _random
+from ...numpy.multiarray import ndarray, _invoke, _wrap
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class MoEDense(HybridBlock):
+    """Top-k routed expert FFN on (batch, seq, units) or (tokens, units).
+
+    forward returns (output, aux_loss) where aux_loss is the Switch
+    load-balancing loss (mean over experts of fraction_tokens *
+    fraction_router_prob * n_experts).
+    """
+
+    def __init__(self, units, hidden_size, num_experts, num_experts_per_tok=1,
+                 capacity_factor=1.25, activation="gelu", dtype="float32"):
+        super().__init__()
+        self._units = units
+        self._hidden = hidden_size
+        self._n_exp = num_experts
+        self._topk = num_experts_per_tok
+        self._cap = capacity_factor
+        self._act = activation
+        self.gate = Parameter("gate", shape=(units, num_experts), dtype=dtype)
+        self.w_in = Parameter("w_in", shape=(num_experts, units, hidden_size),
+                              dtype=dtype)
+        self.w_out = Parameter("w_out",
+                               shape=(num_experts, hidden_size, units),
+                               dtype=dtype)
+
+    def forward(self, x):
+        for p in (self.gate, self.w_in, self.w_out):
+            if p._data is None:
+                p._finish_deferred_init()
+        n_exp, topk, cap_f, act = self._n_exp, self._topk, self._cap, self._act
+
+        def fn(x_, gate, w_in, w_out):
+            shape = x_.shape
+            tokens = x_.reshape(-1, shape[-1])          # (T, d)
+            T = tokens.shape[0]
+            capacity = max(1, int(cap_f * T * topk / n_exp))
+            logits = tokens @ gate                       # (T, E)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+
+            # top-k routing with per-expert capacity (Switch formulation)
+            combine = jnp.zeros((T, n_exp, capacity), jnp.float32)
+            dispatch = jnp.zeros((T, n_exp, capacity), jnp.bool_)
+            remaining = probs
+            position_in_expert = jnp.zeros((n_exp,), jnp.int32)
+            for _ in range(topk):
+                choice = jnp.argmax(remaining, -1)               # (T,)
+                gate_val = jnp.take_along_axis(
+                    remaining, choice[:, None], -1)[:, 0]
+                onehot = jax.nn.one_hot(choice, n_exp, dtype=jnp.int32)
+                pos = position_in_expert[None, :] + \
+                    (jnp.cumsum(onehot, 0) - onehot)             # (T, E)
+                pos_tok = jnp.sum(pos * onehot, -1)              # (T,)
+                keep = pos_tok < capacity
+                pos_oh = jax.nn.one_hot(jnp.clip(pos_tok, 0, capacity - 1),
+                                        capacity, dtype=jnp.float32)
+                sel = (onehot.astype(jnp.float32)
+                       * keep[:, None].astype(jnp.float32))
+                dispatch = dispatch | (
+                    sel[:, :, None] * pos_oh[:, None, :] > 0)
+                combine = combine + (gate_val[:, None, None]
+                                     * sel[:, :, None] * pos_oh[:, None, :])
+                position_in_expert = position_in_expert + jnp.sum(
+                    onehot * keep[:, None].astype(jnp.int32), 0)
+                remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+            # dispatch tokens to expert buffers: (E, C, d)
+            exp_in = jnp.einsum("tec,td->ecd",
+                                dispatch.astype(x_.dtype), tokens)
+            h = jnp.einsum("ecd,edh->ech", exp_in, w_in)
+            h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+            exp_out = jnp.einsum("ech,ehd->ecd", h, w_out)
+            out = jnp.einsum("tec,ecd->td", combine.astype(x_.dtype),
+                             exp_out)
+
+            # load-balancing aux loss (Switch): E * sum_e f_e * P_e
+            f = jnp.mean(jnp.max(dispatch, -1).astype(jnp.float32), 0)
+            p_mean = jnp.mean(probs, 0)
+            aux = n_exp * jnp.sum(f * p_mean)
+            return out.reshape(shape), aux
+
+        return _invoke(fn, (x, self.gate.data(), self.w_in.data(),
+                            self.w_out.data()), name="moe_dense")
+
+
+def moe_expert_specs(mesh, ep_axis="ep"):
+    """PartitionSpecs for MoEDense params: experts sharded over `ep_axis`
+    (the parallel.train.megatron_specs analog for EP)."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        "gate": P(),
+        "w_in": P(ep_axis, None, None),
+        "w_out": P(ep_axis, None, None),
+    }

@@ -143,3 +143,86 @@ def test_sharded_train_step_matches_single_device():
         trainer.step(1, ignore_stale_grad=True)
     w_eager = net2.weight.data().asnumpy()
     onp.testing.assert_allclose(w_sharded, w_eager, atol=1e-5)
+
+
+def test_gpipe_matches_sequential():
+    """Pipeline parallelism: fwd and grads equal the unpipelined stack."""
+    from mxnet_tpu.parallel.pp import (gpipe, shard_stages,
+                                       stack_stage_params)
+    mesh = make_mesh({"pp": 4})
+    S, M, mb, d = 4, 6, 2, 8
+    onp.random.seed(0)
+    Ws = [onp.random.randn(d, d).astype("float32") * 0.5 for _ in range(S)]
+    params = shard_stages(stack_stage_params(
+        [{"w": jnp.asarray(w)} for w in Ws]), mesh)
+    xs = jnp.asarray(onp.random.randn(M, mb, d).astype("float32"))
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    ys = gpipe(stage, params, xs, mesh)
+    ref = xs
+    for w in Ws:
+        ref = jnp.tanh(ref @ jnp.asarray(w))
+    onp.testing.assert_allclose(onp.asarray(ys), onp.asarray(ref),
+                                atol=1e-5)
+
+    g = jax.grad(lambda p: gpipe(stage, p, xs, mesh).sum())(params)
+    gref = jax.grad(lambda ws: _seq_loss(ws, xs))(
+        jnp.stack([jnp.asarray(w) for w in Ws]))
+    onp.testing.assert_allclose(onp.asarray(g["w"]), onp.asarray(gref),
+                                atol=1e-4)
+
+
+def _seq_loss(ws, xs):
+    r = xs
+    for i in range(ws.shape[0]):
+        r = jnp.tanh(r @ ws[i])
+    return r.sum()
+
+
+def test_moe_top1_oracle_and_ep_sharding():
+    import math
+    from mxnet_tpu.gluon.nn.moe import MoEDense, moe_expert_specs
+    from mxnet_tpu.parallel.train import ShardedTrainStep
+
+    mx.random.seed(0)
+    onp.random.seed(0)
+    moe = MoEDense(16, 32, num_experts=4, num_experts_per_tok=1,
+                   capacity_factor=8.0)
+    moe.initialize()
+    x = np.array(onp.random.randn(2, 6, 16).astype("float32"))
+    out, aux = moe(x)
+    assert out.shape == (2, 6, 16)
+
+    g = moe.gate.data().asnumpy()
+    wi = moe.w_in.data().asnumpy()
+    wo = moe.w_out.data().asnumpy()
+    toks = x.asnumpy().reshape(-1, 16)
+    logits = toks @ g
+    probs = onp.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    choice = probs.argmax(-1)
+    ref = onp.zeros_like(toks)
+    for t in range(toks.shape[0]):
+        e = choice[t]
+        h = toks[t] @ wi[e]
+        h = 0.5 * h * (1 + onp.array([math.erf(v / 2 ** 0.5) for v in h]))
+        ref[t] = probs[t, e] * (h @ wo[e])
+    onp.testing.assert_allclose(out.asnumpy().reshape(-1, 16), ref,
+                                atol=1e-4)
+
+    # expert-parallel training over dp x ep
+    mesh = make_mesh({"dp": 2, "ep": 4})
+
+    def loss_fn(outputs, y):
+        o, aux = outputs
+        return jnp.mean((o - y) ** 2) + 0.01 * aux
+
+    step = ShardedTrainStep(moe, loss_fn, "adam", mesh,
+                            batch_specs=(P("dp"), P("dp")), n_labels=1,
+                            param_specs=moe_expert_specs(mesh))
+    xb = onp.random.randn(8, 6, 16).astype("float32")
+    losses = [float(step(xb, xb).asnumpy()) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    assert step.trainable["w_in"].sharding.spec == P("ep", None, None)
