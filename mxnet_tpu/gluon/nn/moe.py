@@ -13,8 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ... import random as _random
-from ...numpy.multiarray import ndarray, _invoke, _wrap
+from ...numpy.multiarray import _invoke
 from ..block import HybridBlock
 from ..parameter import Parameter
 
@@ -30,6 +29,10 @@ class MoEDense(HybridBlock):
     def __init__(self, units, hidden_size, num_experts, num_experts_per_tok=1,
                  capacity_factor=1.25, activation="gelu", dtype="float32"):
         super().__init__()
+        if num_experts_per_tok > num_experts:
+            raise ValueError(
+                f"num_experts_per_tok {num_experts_per_tok} > "
+                f"num_experts {num_experts}")
         self._units = units
         self._hidden = hidden_size
         self._n_exp = num_experts
@@ -62,6 +65,7 @@ class MoEDense(HybridBlock):
             dispatch = jnp.zeros((T, n_exp, capacity), jnp.bool_)
             remaining = probs
             position_in_expert = jnp.zeros((n_exp,), jnp.int32)
+            route_count = jnp.zeros((n_exp,), jnp.float32)
             for _ in range(topk):
                 choice = jnp.argmax(remaining, -1)               # (T,)
                 gate_val = jnp.take_along_axis(
@@ -81,6 +85,10 @@ class MoEDense(HybridBlock):
                                      * sel[:, :, None] * pos_oh[:, None, :])
                 position_in_expert = position_in_expert + jnp.sum(
                     onehot * keep[:, None].astype(jnp.int32), 0)
+                # pre-drop router assignments (Switch defines f_i over what
+                # the router *chose*, not what survived capacity)
+                route_count = route_count + jnp.sum(
+                    onehot.astype(jnp.float32), 0)
                 remaining = remaining * (1.0 - onehot.astype(jnp.float32))
 
             # dispatch tokens to expert buffers: (E, C, d)
@@ -92,8 +100,10 @@ class MoEDense(HybridBlock):
             out = jnp.einsum("tec,ecd->td", combine.astype(x_.dtype),
                              exp_out)
 
-            # load-balancing aux loss (Switch): E * sum_e f_e * P_e
-            f = jnp.mean(jnp.max(dispatch, -1).astype(jnp.float32), 0)
+            # load-balancing aux loss (Switch): E * sum_e f_e * P_e, with
+            # f_e the PRE-capacity-drop routed fraction so the gradient
+            # keeps penalizing collapse even when the hot expert overflows
+            f = route_count / (T * topk)
             p_mean = jnp.mean(probs, 0)
             aux = n_exp * jnp.sum(f * p_mean)
             return out.reshape(shape), aux
@@ -102,7 +112,7 @@ class MoEDense(HybridBlock):
                             self.w_out.data()), name="moe_dense")
 
 
-def moe_expert_specs(mesh, ep_axis="ep"):
+def moe_expert_specs(ep_axis="ep"):
     """PartitionSpecs for MoEDense params: experts sharded over `ep_axis`
     (the parallel.train.megatron_specs analog for EP)."""
     from jax.sharding import PartitionSpec as P

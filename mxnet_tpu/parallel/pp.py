@@ -36,6 +36,13 @@ def gpipe(stage_fn, stage_params, xs, mesh, axis="pp"):
     """
     n_stages = mesh.shape[axis]
     n_micro = xs.shape[0]
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    for leaf in leaves:
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pp axis size "
+                f"{n_stages}: each device holds exactly one stage (a "
+                f"divisible multiple would silently drop stages)")
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     @functools.partial(

@@ -221,8 +221,43 @@ def test_moe_top1_oracle_and_ep_sharding():
 
     step = ShardedTrainStep(moe, loss_fn, "adam", mesh,
                             batch_specs=(P("dp"), P("dp")), n_labels=1,
-                            param_specs=moe_expert_specs(mesh))
+                            param_specs=moe_expert_specs())
     xb = onp.random.randn(8, 6, 16).astype("float32")
     losses = [float(step(xb, xb).asnumpy()) for _ in range(5)]
     assert losses[-1] < losses[0]
     assert step.trainable["w_in"].sharding.spec == P("ep", None, None)
+
+
+def test_moe_aux_loss_penalizes_collapse_under_tight_capacity():
+    """Regression: f must come from pre-capacity-drop routing, so the
+    balance loss still distinguishes collapse when the hot expert
+    overflows (Switch formulation)."""
+    from mxnet_tpu.gluon.nn.moe import MoEDense
+    mx.random.seed(0)
+    onp.random.seed(0)
+    moe = MoEDense(8, 16, num_experts=4, num_experts_per_tok=1,
+                   capacity_factor=1.0)
+    moe.initialize()
+    x = np.array(onp.abs(onp.random.randn(2, 8, 8)).astype("float32"))
+    # all-positive tokens + one-hot gate column => full collapse to expert 0
+    moe.gate.set_data(np.array(onp.concatenate(
+        [onp.full((8, 1), 5.0), onp.zeros((8, 3))], 1).astype("float32")))
+    _, aux_collapsed = moe(x)
+    moe.gate.set_data(np.zeros((8, 4)))
+    _, aux_balanced = moe(x)
+    assert float(aux_collapsed.asnumpy()) > float(aux_balanced.asnumpy()) + 0.5
+
+
+def test_gpipe_rejects_stage_count_mismatch():
+    from mxnet_tpu.parallel.pp import gpipe, stack_stage_params
+    mesh = make_mesh({"pp": 4})
+    params8 = stack_stage_params([{"w": jnp.ones((4, 4))}
+                                  for _ in range(8)])
+    with pytest.raises(ValueError, match="pp axis size"):
+        gpipe(lambda p, x: x @ p["w"], params8, jnp.ones((2, 2, 4)), mesh)
+
+
+def test_moe_topk_validation():
+    from mxnet_tpu.gluon.nn.moe import MoEDense
+    with pytest.raises(ValueError, match="num_experts_per_tok"):
+        MoEDense(8, 16, num_experts=2, num_experts_per_tok=3)
