@@ -1,21 +1,93 @@
-"""Benchmark: ResNet-50 training throughput on one chip.
+"""Benchmark: training/inference throughput with MFU accounting, one chip.
 
-Matches the reference's headline row (BASELINE.md: ResNet-50 training,
-bs=32, V100 = 298.51 img/s, from docs/.../perf.md:243-254). Full training
-step — forward, backward, SGD-momentum update, BatchNorm stat threading —
-as one donated jitted XLA program.
+Mirrors the reference's headline grid (BASELINE.md, from
+docs/static_site/src/pages/api/faq/perf.md:150-254): ResNet-50 train
+(fp32 + bf16), ResNet-50 inference (bf16), BERT-base pretraining (bf16).
+The north star (BASELINE.json) is MFU, so every row reports
+model FLOPs (XLA's own cost analysis of the compiled program) divided by
+measured time and chip peak.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Measurement method: N steps chained on-device through donated params with a
+SINGLE host fetch of the final loss at the end.  On this environment's
+tunneled TPU platform, `block_until_ready()` returns before execution
+finishes (round 1 reported 25k img/s ≈ 160% of chip peak because of this),
+and a per-step host fetch pays a full tunnel round-trip (~450 ms) — the
+chain+final-fetch pattern is the only honest window.  Windows are
+calibrated to >= ~1.2 s.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} where
+the extra keys carry MFU, precision, ms/step, and the full grid.
 """
 from __future__ import annotations
 
 import json
+import math
 import time
 
-BASELINE_IMG_S = 298.51  # reference V100 bs=32 training (BASELINE.md)
+BASELINE_TRAIN_IMG_S = 298.51   # reference V100 bs=32 ResNet-50 train (BASELINE.md)
+BASELINE_INFER_IMG_S = 1076.81  # reference V100 bs=32 ResNet-50 inference fp32
+
+# bf16 peak FLOP/s by device_kind substring (public TPU specs).
+PEAK_BF16 = {
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
 
 
-def main():
+def _chip_peak(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_BF16.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+def _measure(step, args, n_state: int, target_s: float = 1.2,
+             max_iters: int = 400):
+    """Time `step` by chaining iterations through its first n_state outputs.
+
+    Returns (seconds_per_step, final_scalar). The final output of `step`
+    must be a scalar whose host fetch forces completion of the whole chain.
+    """
+    state, rest = list(args[:n_state]), list(args[n_state:])
+
+    def run(iters):
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(*state, *rest)
+            state = list(out[:n_state])
+        val = float(out[-1])  # single host fetch: syncs the full chain
+        return time.perf_counter() - t0, val
+
+    run(3)                       # warmup (compile + first dispatches)
+    dt, _ = run(5)               # pilot to calibrate the window
+    iters = min(max_iters, max(10, math.ceil(target_s / max(dt / 5, 1e-5))))
+    dt, val = run(iters)
+    return dt / iters, val
+
+
+def _flops_per_step(jitted, *abstract_args) -> float | None:
+    try:
+        comp = jitted.lower(*abstract_args).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"]) if ca and "flops" in ca else None
+    except Exception:
+        return None
+
+
+def _cast_tree(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a, tree)
+
+
+def bench_resnet50_train(precision: str, on_cpu: bool):
     import jax
     import jax.numpy as jnp
 
@@ -23,57 +95,170 @@ def main():
     from mxnet_tpu import functional
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
-    platform = jax.devices()[0].platform
-    bs = 32 if platform != "cpu" else 8
-    size = 224 if platform != "cpu" else 64
-    nclass = 1000
+    bs, size, nclass = (32, 224, 1000) if not on_cpu else (8, 64, 100)
+    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
     net = resnet50_v1(classes=nclass)
     net.initialize()
     net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
     trainable, aux = functional.split_params(net)
     momenta = jax.tree_util.tree_map(jnp.zeros_like, trainable)
-    lr, mom = 0.05, 0.9
 
     def train_step(trainable, aux, momenta, x, y):
+        # mixed precision: fp32 master weights, compute cast inside the step
         def loss_fn(tr):
             logits, mutated = functional.functional_call(
-                net, {**tr, **aux}, x, train=True)
+                net, {**_cast_tree(tr, cdtype), **aux},
+                x.astype(cdtype), train=True)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
             return loss, mutated
         (loss, mutated), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(trainable)
         momenta = jax.tree_util.tree_map(
-            lambda m, g: mom * m + g, momenta, grads)
+            lambda m, g: 0.9 * m + g.astype(m.dtype), momenta, grads)
         trainable = jax.tree_util.tree_map(
-            lambda w, m: w - lr * m, trainable, momenta)
+            lambda w, m: w - 0.05 * m, trainable, momenta)
         return trainable, {**aux, **mutated}, momenta, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (bs, 3, size, size), jnp.float32)
     y = jax.random.randint(key, (bs,), 0, nclass)
 
-    # warmup (compile)
-    for _ in range(3):
-        trainable, aux, momenta, loss = step(trainable, aux, momenta, x, y)
-    loss.block_until_ready()
+    flops = _flops_per_step(
+        step, trainable, aux, momenta,
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        jax.ShapeDtypeStruct(y.shape, y.dtype))
+    sec, _ = _measure(step, (trainable, aux, momenta, x, y), n_state=3)
+    return {"name": f"resnet50_train_bs{bs}_{precision}",
+            "items_per_s": bs / sec, "ms_per_step": sec * 1e3,
+            "flops_per_step": flops, "precision": precision}
 
-    iters = 20 if platform != "cpu" else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        trainable, aux, momenta, loss = step(trainable, aux, momenta, x, y)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
 
-    img_s = bs * iters / dt
+def bench_resnet50_infer(precision: str, on_cpu: bool):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import functional
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    bs, size = (32, 224) if not on_cpu else (8, 64)
+    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    net = resnet50_v1()
+    net.initialize()
+    net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
+    params = _cast_tree(functional.param_arrays(net), cdtype)
+
+    def fwd(carry, params, x):
+        # `carry` threads a data dependency so chained calls serialize
+        out, _ = functional.functional_call(
+            net, params, x + carry.astype(x.dtype), train=False)
+        return jnp.max(out).astype(jnp.float32), jnp.sum(out, dtype=jnp.float32)
+
+    step = jax.jit(fwd)
+    x = jax.random.normal(jax.random.PRNGKey(0), (bs, 3, size, size), cdtype)
+    flops = _flops_per_step(step, jax.ShapeDtypeStruct((), jnp.float32),
+                            params, jax.ShapeDtypeStruct(x.shape, x.dtype))
+    sec, _ = _measure(step, (jnp.zeros(()), params, x), n_state=1)
+    return {"name": f"resnet50_infer_bs{bs}_{precision}",
+            "items_per_s": bs / sec, "ms_per_step": sec * 1e3,
+            "flops_per_step": flops, "precision": precision}
+
+
+def bench_bert_train(precision: str, on_cpu: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import functional
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining
+
+    if on_cpu:
+        bs, seq, units, layers, heads, vocab = 4, 32, 64, 2, 4, 1000
+    else:  # BERT-base: 12 layers, 768 units, 12 heads (BASELINE.json row 2)
+        bs, seq, units, layers, heads, vocab = 32, 128, 768, 12, 12, 30522
+    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    net = BERTForPretraining(vocab_size=vocab, units=units,
+                             hidden_size=units * 4, num_layers=layers,
+                             num_heads=heads, max_length=512,
+                             dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((2, seq), dtype="int32"))
+    trainable, aux = functional.split_params(net)
+    opt_m = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+
+    def train_step(trainable, opt_m, ids, labels):
+        def loss_fn(tr):
+            (mlm, _nsp), _ = functional.functional_call(
+                net, {**_cast_tree(tr, cdtype), **aux}, ids, train=True)
+            logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
+            return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+        loss, grads = jax.value_and_grad(loss_fn)(trainable)
+        opt_m = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g.astype(m.dtype), opt_m, grads)
+        trainable = jax.tree_util.tree_map(
+            lambda w, m: w - 1e-3 * m, trainable, opt_m)
+        return trainable, opt_m, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    ids = jnp.asarray(onp.random.randint(0, vocab, (bs, seq)), jnp.int32)
+    flops = _flops_per_step(step, trainable, opt_m,
+                            jax.ShapeDtypeStruct(ids.shape, ids.dtype),
+                            jax.ShapeDtypeStruct(ids.shape, ids.dtype))
+    sec, _ = _measure(step, (trainable, opt_m, ids, ids), n_state=2)
+    return {"name": f"bert_base_pretrain_bs{bs}_seq{seq}_{precision}",
+            "items_per_s": bs / sec, "ms_per_step": sec * 1e3,
+            "flops_per_step": flops, "precision": precision}
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    platform, on_cpu = dev.platform, dev.platform == "cpu"
+    peak = _chip_peak(dev)
+
+    rows = []
+    for fn, args in [
+        (bench_resnet50_train, ("bf16",)),   # headline
+        (bench_resnet50_train, ("fp32",)),
+        (bench_resnet50_infer, ("bf16",)),
+        (bench_bert_train, ("bf16",)),
+    ]:
+        try:
+            row = fn(*args, on_cpu)
+        except Exception as e:  # a failed row must not kill the bench
+            rows.append({"name": f"{fn.__name__}{args}", "error": repr(e)})
+            continue
+        if row["flops_per_step"] and peak:
+            eff = row["flops_per_step"] / (row["ms_per_step"] / 1e3)
+            row["effective_tflops"] = round(eff / 1e12, 2)
+            row["mfu_vs_bf16_peak"] = round(eff / peak, 4)
+            # a reading above peak means the timing window is broken —
+            # report it as invalid rather than as a throughput.
+            row["valid"] = eff <= peak
+        rows.append({k: (round(v, 2) if isinstance(v, float) else v)
+                     for k, v in row.items()})
+
+    head = next((r for r in rows if "items_per_s" in r), {})
     print(json.dumps({
-        "metric": f"resnet50_train_img_per_sec_bs{bs}_{platform}",
-        "value": round(img_s, 2),
+        "metric": head.get("name", "resnet50_train"),
+        "value": head.get("items_per_s"),
         "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "vs_baseline": (round(head["items_per_s"] / BASELINE_TRAIN_IMG_S, 3)
+                        if head.get("items_per_s") else None),
+        "mfu": head.get("mfu_vs_bf16_peak"),
+        "precision": head.get("precision"),
+        "ms_per_step": head.get("ms_per_step"),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "chip_peak_bf16_tflops": round(peak / 1e12, 1) if peak else None,
+        "grid": rows,
     }))
 
 
