@@ -23,17 +23,23 @@ from . import lists  # noqa: F401
 
 _state = threading.local()
 
-# ops that should run in low precision (the FP16_FUNCS analog): MXU ops
-_WIDEST = ("matmul", "dot", "einsum", "convolution", "fully_connected",
-           "multi_head_attention", "interleaved_matmul_selfatt_qk",
-           "interleaved_matmul_selfatt_valatt", "batch_dot", "tensordot")
+_TARGET_OPS = frozenset(lists.TARGET_DTYPE_OPS)
+_FP32_OPS = frozenset(lists.FP32_OPS)
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Install the global dtype policy (reference: amp.init)."""
     _state.dtype = np_dtype(target_dtype)
+    _state.target_ops = _TARGET_OPS | set(target_precision_ops or ())
+    _state.fp32_ops = _FP32_OPS | set(fp32_ops or ()) \
+        | set(conditional_fp32_ops or ())
     _state.active = True
+
+
+def _deactivate():
+    """Turn the policy off (test isolation; the reference has no off switch)."""
+    _state.active = False
 
 
 def is_active():
@@ -44,13 +50,31 @@ def target_dtype():
     return getattr(_state, "dtype", jnp.bfloat16)
 
 
+def _op_cast_dtype(name):
+    """dtype the dispatcher should cast `name`'s floating inputs to, or None.
+
+    Called by _invoke (numpy/multiarray.py) on every dispatch, inside the
+    traced function so the cast's VJP returns cotangents in the original
+    dtype — both the eager path and _CachedGraph trace-time policy
+    (reference: amp.py:105-246 wrapper casts + low_precision_pass.cc).
+    """
+    if not is_active():
+        return None
+    if name in getattr(_state, "target_ops", _TARGET_OPS):
+        return target_dtype()
+    if name in getattr(_state, "fp32_ops", _FP32_OPS):
+        return jnp.float32
+    return None
+
+
 def _maybe_cast_op_inputs(name, raws):
-    """Called by the dispatcher for low-precision-listed ops."""
-    if not is_active() or name not in _WIDEST:
+    """Cast a raw-input list per the active policy (dispatcher helper)."""
+    dt = _op_cast_dtype(name)
+    if dt is None:
         return raws
-    dt = target_dtype()
     return [r.astype(dt) if hasattr(r, "dtype")
-            and jnp.issubdtype(r.dtype, jnp.floating) else r for r in raws]
+            and jnp.issubdtype(r.dtype, jnp.floating)
+            and r.dtype != dt else r for r in raws]
 
 
 def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None,

@@ -290,9 +290,13 @@ class _CachedGraph:
                 static_leaves.append(_ARR)
             else:
                 static_leaves.append(l)
+        from .. import amp as _amp
         sig = (str(treedef),
                tuple("A" if l is _ARR else repr(l) for l in static_leaves),
-               tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
+               tuple((tuple(r.shape), str(r.dtype)) for r in input_raws),
+               # dtype policy is applied inside _invoke at trace time, so a
+               # policy change must invalidate the cached trace
+               (_amp.is_active(), str(_amp.target_dtype())))
         sig_key = hash(sig)
         self._signatures[sig_key] = (treedef, static_leaves)
 

@@ -74,6 +74,8 @@ def _invoke(prim, args, kwargs=None, name=None):
     dispatch); under recording additionally capture the VJP with jax.vjp.
     """
     kwargs = kwargs or {}
+    from .. import amp as _amp
+    amp_dt = _amp._op_cast_dtype(name or getattr(prim, "__name__", ""))
     leaves, treedef = jax.tree_util.tree_flatten(
         (args, kwargs), is_leaf=lambda x: isinstance(x, ndarray))
     # differentiable inputs: inexact-dtype ndarrays; others are unwrapped
@@ -88,6 +90,13 @@ def _invoke(prim, args, kwargs=None, name=None):
                 leaves[i] = leaf._data
 
     def fn(*xs):
+        if amp_dt is not None:
+            # cast inside the traced fn: the cast's VJP upcasts cotangents
+            # back to the caller's dtype, and _CachedGraph tracing re-enters
+            # here so hybrid forward gets the same policy (amp.init()).
+            xs = [x.astype(amp_dt)
+                  if jnp.issubdtype(x.dtype, jnp.floating)
+                  and x.dtype != amp_dt else x for x in xs]
         ls = list(leaves)
         for p, x in zip(arr_pos, xs):
             ls[p] = x

@@ -1,0 +1,151 @@
+"""AMP: dtype policy observably applied in eager + hybrid dispatch, loss
+scaling under overflow.
+
+Reference: python/mxnet/amp/amp.py:105-246 (wrapper-level input casts),
+amp/loss_scaler.py:26-60, tests/python/gpu/test_amp.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    amp._deactivate()
+
+
+def test_amp_inactive_by_default():
+    a = mx.np.ones((4, 4))
+    assert mx.np.matmul(a, a).dtype == mx.np.float32
+
+
+def test_amp_init_casts_matmul_eager():
+    amp.init()
+    a = mx.np.ones((4, 4), dtype="float32")
+    out = mx.np.matmul(a, a)
+    assert out.dtype == mx.np.bfloat16
+    # numerics preserved at bf16 resolution
+    onp.testing.assert_allclose(out.asnumpy().astype("float32"),
+                                onp.full((4, 4), 4.0), rtol=1e-2)
+
+
+def test_amp_fp32_ops_stay_fp32():
+    amp.init()
+    a = mx.np.ones((4, 4), dtype="bfloat16")
+    from mxnet_tpu import npx
+    assert npx.softmax(a).dtype == mx.np.float32
+
+
+def test_amp_elementwise_unaffected():
+    amp.init()
+    a = mx.np.ones((4, 4), dtype="float32")
+    assert (a + a).dtype == mx.np.float32
+
+
+def test_amp_dense_eager_vs_hybrid():
+    net = nn.Dense(8)
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 16))
+    ref = net(x)  # fp32, pre-amp
+    amp.init()
+    eager = net(x)
+    assert eager.dtype == mx.np.bfloat16
+    net.hybridize()
+    hybrid = net(x)
+    assert hybrid.dtype == mx.np.bfloat16
+    onp.testing.assert_allclose(eager.asnumpy().astype("float32"),
+                                hybrid.asnumpy().astype("float32"),
+                                rtol=2e-2, atol=2e-2)
+    onp.testing.assert_allclose(ref.asnumpy(),
+                                hybrid.asnumpy().astype("float32"),
+                                rtol=5e-2, atol=5e-2)
+
+
+def test_amp_policy_change_invalidates_hybrid_cache():
+    net = nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((2, 8))
+    assert net(x).dtype == mx.np.float32
+    amp.init()
+    assert net(x).dtype == mx.np.bfloat16
+    amp._deactivate()
+    assert net(x).dtype == mx.np.float32
+
+
+def test_amp_backward_master_weights_stay_fp32():
+    amp.init()
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 8))
+    with autograd.record():
+        y = net(x)
+        loss = (y.astype("float32") ** 2).sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert net.weight.data().dtype == mx.np.float32  # master weights
+    assert onp.isfinite(g.asnumpy()).all()
+    assert g.asnumpy().astype("float32").any()
+
+
+def test_amp_conv_eager_cast():
+    amp.init()
+    net = nn.Conv2D(4, kernel_size=3, in_channels=3)
+    net.initialize()
+    out = net(mx.np.ones((1, 3, 8, 8)))
+    assert out.dtype == mx.np.bfloat16
+
+
+def test_convert_hybrid_block_casts_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    net(mx.np.ones((2, 16)))
+    amp.convert_hybrid_block(net)
+    params = net.collect_params()
+    for name, p in params.items():
+        if name.endswith(("gamma", "beta", "running_mean", "running_var")):
+            assert p.data().dtype == mx.np.float32, name
+        else:
+            assert p.data().dtype == mx.np.bfloat16, name
+
+
+def test_loss_scaler_overflow_cycle():
+    from mxnet_tpu.amp import LossScaler
+    s = LossScaler(init_scale=2 ** 8, scale_factor=2.0, scale_window=2)
+    s.update_scale(True)
+    assert s.loss_scale == 2 ** 7
+    s.update_scale(False)
+    s.update_scale(False)  # window reached -> grow
+    assert s.loss_scale == 2 ** 8
+    for _ in range(30):
+        s.update_scale(True)
+    assert s.loss_scale == 1  # floor
+
+
+def test_loss_scaler_detects_inf_grads():
+    from mxnet_tpu.amp import LossScaler
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    x = mx.np.full((1, 4), 1e38)
+    with autograd.record():
+        loss = (net(x) * 1e38).sum()
+    loss.backward()
+    params = list(net.collect_params().values())
+    assert LossScaler().has_overflow(params)
+
+
+def test_scale_loss_scope():
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+
+    class FakeTrainer:
+        _params = list(net.collect_params().values())
+    tr = FakeTrainer()
+    loss = mx.np.ones((2,))
+    with amp.scale_loss(loss, tr) as scaled:
+        assert float(scaled.sum()) == pytest.approx(2 * tr._amp_loss_scaler.loss_scale)
