@@ -21,6 +21,10 @@ Layer map vs the reference (see SURVEY.md):
 
 __version__ = "2.0.0a1"
 
+# must run before anything touches the JAX backend (see _dist_init docstring)
+from ._dist_init import ensure_distributed as _ensure_distributed
+_ensure_distributed()
+
 from . import base
 from .base import MXNetError
 from . import context

@@ -12,6 +12,17 @@ analog and is executed as sync (documented divergence; the reference itself
 only guarantees eventual consistency there). Optimizer-on-server
 (update_on_kvstore) runs the updater identically on every worker after the
 reduce — bitwise-identical state without a server round-trip.
+
+Gradient compression (reference: src/kvstore/gradient_compression.h) applies
+on the worker before the cross-process reduce: the local gradient is 1-bit/
+2-bit quantized with an error-feedback residual, and the psum accumulates
+the (exactly representable) quantized contributions — numerically identical
+to the reference's server-side dequantize-then-sum.
+
+Multi-process bring-up is via env vars set by ``tools/launch.py`` (the
+dmlc-tracker analog, tests/nightly/test_distributed_training-gpu.sh:25-38):
+DMLC_PS_ROOT_URI/PORT, DMLC_NUM_WORKER, DMLC_WORKER_ID; or native
+JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID.
 """
 from __future__ import annotations
 
@@ -25,19 +36,7 @@ from ..numpy.multiarray import ndarray, _wrap
 from .kvstore import KVStore
 
 
-def _ensure_distributed():
-    """Initialize jax.distributed from MXNet-style or native env vars."""
-    if jax.process_count() > 1:
-        return
-    coord = (os.environ.get("JAX_COORDINATOR_ADDRESS")
-             or os.environ.get("DMLC_PS_ROOT_URI"))
-    nproc = get_env("DMLC_NUM_WORKER", None, int) or get_env("JAX_NUM_PROCESSES", None, int)
-    pid = get_env("DMLC_WORKER_ID", None, int) or get_env("JAX_PROCESS_ID", None, int)
-    if coord and nproc and nproc > 1:
-        port = os.environ.get("DMLC_PS_ROOT_PORT", "1234")
-        addr = coord if ":" in coord else f"{coord}:{port}"
-        jax.distributed.initialize(coordinator_address=addr,
-                                   num_processes=nproc, process_id=pid or 0)
+from .._dist_init import ensure_distributed as _ensure_distributed
 
 
 class DistKVStore(KVStore):
@@ -48,6 +47,7 @@ class DistKVStore(KVStore):
         _ensure_distributed()
         self._nprocs = jax.process_count()
         self._rank = jax.process_index()
+        self._gc = None
 
     @property
     def rank(self):
@@ -57,6 +57,12 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return self._nprocs
 
+    def set_gradient_compression(self, compression_params):
+        """Install 1-bit/2-bit worker-side compression (reference:
+        kvstore.h SetGradientCompression -> gradient_compression.h)."""
+        from .gradient_compression import GradientCompression
+        self._gc = GradientCompression(**dict(compression_params or {}))
+
     def _allreduce(self, merged):
         """Cross-process sum. Single process: identity. Multi-process: a
         tiny pjit'd psum over a global 1-d process mesh (DCN axis)."""
@@ -65,12 +71,19 @@ class DistKVStore(KVStore):
         from ..parallel.collectives import allreduce_across_processes
         return _wrap(allreduce_across_processes(merged._data))
 
+    def _merged(self, k, vs):
+        """Local device reduce, optional quantization, cross-process sum."""
+        merged = self._reduce(vs)
+        if self._gc is not None:
+            merged = _wrap(self._gc.quantize(k, merged._data))
+        return self._allreduce(merged)
+
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, vs in zip(keys, values):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
-            merged = self._allreduce(self._reduce(vs))
+            merged = self._merged(k, vs)
             if self._updater is not None:
                 self._updater(self._key_int(k), merged, self._store[k])
             else:
@@ -80,7 +93,7 @@ class DistKVStore(KVStore):
         keys, values = self._normalize(key, value)
         merged_list = []
         for k, vs in zip(keys, values):
-            merged = self._allreduce(self._reduce(vs))
+            merged = self._merged(k, vs)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} not initialized")

@@ -30,7 +30,6 @@ class KVStore(KVStoreBase):
         self._updater = None
         self._optimizer = None
         self._updater_states = {}
-        self._compression = {}
 
     @property
     def type(self):
@@ -156,6 +155,9 @@ class KVStore(KVStoreBase):
             self._updater.set_states(f.read())
 
     def set_gradient_compression(self, compression_params):
-        """Reference: kvstore.h SetGradientCompression (1-bit/2-bit). Stored
-        and applied in the dist path (gradient_compression.py)."""
-        self._compression = dict(compression_params or {})
+        """Reference: kvstore.h SetGradientCompression. As in the reference,
+        compression only applies to the cross-process push path — a dist
+        kvstore (see dist.py); single-process stores reject it."""
+        raise MXNetError(
+            "gradient compression requires a dist kvstore "
+            "(reference: src/kvstore/kvstore_dist.h only)")

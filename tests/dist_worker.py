@@ -1,0 +1,66 @@
+"""Multi-process dist_sync kvstore worker (run under tools/launch.py).
+
+Mirrors the reference's tests/nightly/dist_sync_kvstore.py:40-50 check_diff:
+every worker pushes known rank-dependent values and asserts the EXACT
+reduced result, plus a gradient-compression case and an
+optimizer-on-kvstore case. Prints DIST_OK <rank> on success.
+"""
+import sys
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+
+
+def check_eq(arr, expect, what):
+    got = arr.asnumpy()
+    assert onp.array_equal(got, onp.full(arr.shape, expect, got.dtype)), \
+        f"{what}: expected {expect}, got {got.ravel()[:4]}"
+
+
+def main():
+    kv = kvstore.create("dist_sync")
+    n, rank = kv.num_workers, kv.rank
+    assert n > 1, "launcher did not create a multi-process world"
+    shape = (4, 3)
+
+    # --- plain sync pushpull: exact sum across workers -------------------
+    kv.init("w0", mx.np.zeros(shape))
+    kv.push("w0", mx.np.full(shape, float(rank + 1)))
+    out = mx.np.empty(shape)
+    kv.pull("w0", out=out)
+    check_eq(out, sum(range(1, n + 1)), "push/pull sum")
+
+    kv.pushpull("w0", mx.np.ones(shape), out=out)
+    check_eq(out, float(n), "pushpull")
+
+    # --- gradient compression: 2-bit quantization + residual -------------
+    kv2 = kvstore.DistKVStore("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv2.init("c0", mx.np.zeros(shape))
+    # each worker pushes 0.3: below threshold -> quantized to 0, residual
+    # keeps 0.3; second push of 0.3 crosses 0.5 -> quantized to +0.5 each
+    kv2.push("c0", mx.np.full(shape, 0.3))
+    out2 = mx.np.empty(shape)
+    kv2.pull("c0", out=out2)
+    check_eq(out2, 0.0, "2bit first push (all residual)")
+    kv2.push("c0", mx.np.full(shape, 0.3))
+    kv2.pull("c0", out=out2)
+    check_eq(out2, 0.5 * n, "2bit second push (residual crossed threshold)")
+
+    # --- optimizer on kvstore: identical state on every worker -----------
+    kv3 = kvstore.DistKVStore("dist_sync")
+    kv3.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv3.init(3, mx.np.zeros(shape))
+    kv3.push(3, mx.np.full(shape, 1.0))  # summed grad = n
+    out3 = mx.np.empty(shape)
+    kv3.pull(3, out=out3)
+    check_eq(out3, -0.1 * n, "sgd on kvstore")
+
+    print(f"DIST_OK {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
