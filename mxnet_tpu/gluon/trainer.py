@@ -11,10 +11,97 @@ python thread never blocks, matching the reference's engine overlap.
 """
 from __future__ import annotations
 
+import functools
+
+import jax
+import jax.numpy as jnp
+
 from .. import optimizer as opt
 from ..base import MXNetError
 from ..kvstore import create as create_kvstore, KVStoreBase
 from .parameter import Parameter
+
+
+class _FusedUpdate:
+    """All parameter updates as ONE jitted multi-tensor XLA program.
+
+    Reference analog: aggregate_num batching into multi_sgd_update /
+    multi_mp_sgd_update / multi_lamb (src/operator/optimizer_op.cc:352-1130)
+    — one kernel for many tensors instead of one dispatch per parameter.
+    Here lr/wd/t arrive as traced arrays, so lr schedules and Adam's
+    per-step bias correction do NOT retrace; the program recompiles only
+    when shapes or static hyperparameters (momentum/betas/clip) change.
+    """
+
+    def __init__(self, optimizer):
+        self.opt = optimizer
+        self._jit = None
+        self._static = None
+
+    def applicable(self):
+        o = self.opt
+        return (getattr(o, "_FUSED_FAMILY", None) in ("sgd", "adam")
+                and not o.multi_precision)
+
+    def _build(self, family, static):
+        rule = type(self.opt)._rule
+
+        if family == "sgd":
+            momentum, rescale_ignored, clip = static
+
+            def run(ws, gs, ss, lrs, wds, ts, rescale):
+                outs = [rule(w, g, s[0] if s else None, lrs[j], wds[j],
+                             momentum, rescale, clip)
+                        for j, (w, g, s) in enumerate(zip(ws, gs, ss))]
+                return ([o[0] for o in outs],
+                        [(o[1],) if o[1] is not None else () for o in outs])
+        else:  # adam family
+            beta1, beta2, eps, clip = static
+
+            def run(ws, gs, ss, lrs, wds, ts, rescale):
+                outs = [rule(w, g, s[0], s[1], lrs[j], wds[j], ts[j],
+                             beta1, beta2, eps, rescale, clip)
+                        for j, (w, g, s) in enumerate(zip(ws, gs, ss))]
+                return ([o[0] for o in outs],
+                        [(o[1], o[2]) for o in outs])
+
+        return jax.jit(run, donate_argnums=(0, 2))
+
+    def __call__(self, work, states):
+        """work: list of (index, Parameter); states: Updater.states dict."""
+        o = self.opt
+        family = o._FUSED_FAMILY
+        clip = o.clip_gradient or -1.0
+        static = ((o.momentum, None, clip) if family == "sgd"
+                  else (o.beta1, o.beta2, o.epsilon, clip))
+        if self._jit is None or self._static != (family, static):
+            self._jit = self._build(family, static)
+            self._static = (family, static)
+
+        lrs, wds, ts = [], [], []
+        ws, gs, ss, state_nds = [], [], [], []
+        for i, p in work:
+            o._update_count(i)
+            lrs.append(o._get_lr(i))
+            wds.append(o._get_wd(i))
+            ts.append(float(max(o._index_update_count[i], 1)))
+            ws.append(p.data()._data)
+            gs.append(p.grad()._data)
+            s = states[i]
+            nds = (() if s is None
+                   else tuple(s) if isinstance(s, tuple) else (s,))
+            state_nds.append(nds)
+            ss.append(tuple(nd._data for nd in nds))
+
+        new_ws, new_ss = self._jit(
+            ws, gs, ss, jnp.asarray(lrs, jnp.float32),
+            jnp.asarray(wds, jnp.float32), jnp.asarray(ts, jnp.float32),
+            jnp.asarray(o.rescale_grad, jnp.float32))
+
+        for (i, p), nw, nss, nds in zip(work, new_ws, new_ss, state_nds):
+            p.data()._rebind(nw.astype(p.data().dtype))
+            for nd, raw in zip(nds, nss):
+                nd._rebind(raw)
 
 
 class Trainer:
@@ -45,6 +132,7 @@ class Trainer:
         self._kv_initialized = False
         self._params_to_init = []
         self._contains_sparse_grad = False
+        self._fused_update = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -128,13 +216,27 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
-        for i, p in enumerate(self._params):
-            if p.grad_req == "null" or p._data is None:
-                continue
-            if self._update_on_kvstore:
-                # weights were updated inside the store: pull them back
-                self._kvstore.pull(i, out=p.data(), priority=-i)
-            else:
+        if self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null" and p._data is not None:
+                    # weights were updated inside the store: pull them back
+                    self._kvstore.pull(i, out=p.data(), priority=-i)
+            return
+        work = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null" and p._data is not None]
+        if not work:
+            return
+        if self._fused_update is None:
+            fu = _FusedUpdate(self._optimizer)
+            self._fused_update = fu if fu.applicable() else False
+        if self._fused_update:
+            for i, p in work:
+                if i not in updater.states:
+                    updater.states[i] = \
+                        self._optimizer.create_state_multi_precision(i, p.data())
+            self._fused_update(work, updater.states)
+        else:
+            for i, p in work:
                 updater(i, p.grad(), p.data())
 
     def save_states(self, fname):
@@ -159,3 +261,4 @@ class Trainer:
                 self._updaters[0].set_states(f.read())
             self._optimizer = self._updaters[0].optimizer
         self._optimizer.param_dict = {i: p for i, p in enumerate(self._params)}
+        self._fused_update = None  # rebuilt against the (possibly new) optimizer

@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import pickle
 
+import jax
 import jax.numpy as jnp
+import numpy as onp
 
 from ..base import MXNetError
 from ..numpy.multiarray import ndarray, _wrap
@@ -61,17 +63,64 @@ class KVStore(KVStoreBase):
         return [key], [value]
 
     @staticmethod
+    def _one_device(v):
+        ds = v._data.devices() if hasattr(v._data, "devices") else set()
+        return next(iter(ds)) if len(ds) == 1 else None
+
+    @staticmethod
+    def _reduce_parts(vals):
+        """Sum a list of per-device arrays (CommDevice::Reduce analog,
+        src/kvstore/comm.h:474).
+
+        When each value lives on a distinct device, the sum is ONE XLA
+        all-reduce over a mesh of those devices (psum rides ICI on real
+        chips), and the result list keeps one reduced copy resident on each
+        contributing device — the CommDevice reduce+broadcast without host
+        staging. Otherwise falls back to a tree-sum on the common device.
+        Returns a list aligned with ``vals``.
+        """
+        if len(vals) == 1:
+            return [vals[0]]
+        devs = []
+        for v in vals:
+            d = KVStore._one_device(v)
+            if d is None or d in devs or v.shape != vals[0].shape:
+                devs = None
+                break
+            devs.append(d)
+        if devs is None:
+            acc = vals[0]._data
+            for v in vals[1:]:
+                acc = acc + v._data
+            merged = _wrap(acc)
+            return [merged] * len(vals)
+
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from jax import shard_map
+        import functools
+
+        n, shape = len(vals), tuple(vals[0].shape)
+        mesh = Mesh(onp.array(devs), ("kv",))
+        glob = jax.make_array_from_single_device_arrays(
+            (n,) + shape, NamedSharding(mesh, P("kv")),
+            [v._data[None] for v in vals])
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("kv"),
+                           out_specs=P("kv"))
+        def _psum(x):
+            return jax.lax.psum(x, "kv")
+
+        out = _psum(glob)
+        shards = sorted(out.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return [_wrap(s.data.reshape(shape)) for s in shards]
+
+    @staticmethod
     def _reduce(vals):
-        """Sum a list of per-device arrays (CommDevice::Reduce analog —
-        engine-free: XLA schedules the adds/collectives)."""
+        """Merged value of a push (single reduced copy)."""
         if isinstance(vals, ndarray):
             return vals
-        if len(vals) == 1:
-            return vals[0]
-        acc = vals[0]._data
-        for v in vals[1:]:
-            acc = acc + v._data
-        return _wrap(acc)
+        return KVStore._reduce_parts(vals)[0]
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
@@ -100,20 +149,29 @@ class KVStore(KVStoreBase):
         keys, values = self._normalize(key, value)
         merged_list = []
         for k, vs in zip(keys, values):
-            merged = self._reduce(vs)
+            if isinstance(vs, ndarray):
+                parts = [vs]
+            else:
+                parts = self._reduce_parts(vs)
+            merged = parts[0]
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} not initialized")
                 self._updater(self._key_int(k), merged, self._store[k])
-                merged = self._store[k]
-            merged_list.append(merged)
+                merged, parts = self._store[k], None
+            merged_list.append((merged, parts))
         if out is None:
             return
         _, outs = self._normalize(key, out)
-        for merged, o in zip(merged_list, outs):
+        for (merged, parts), o in zip(merged_list, outs):
             targets = o if isinstance(o, (list, tuple)) else [o]
-            for t in targets:
-                t._rebind(merged._data.astype(t.dtype))
+            if parts is not None and len(targets) == len(parts):
+                # per-device reduced copies: each target keeps its placement
+                for t, part in zip(targets, parts):
+                    t._rebind(part._data.astype(t.dtype))
+            else:
+                for t in targets:
+                    t._rebind(merged._data.astype(t.dtype))
 
     def broadcast(self, key, value, out, priority=0):
         """init + pull (reference: kvstore/base.py broadcast)."""

@@ -36,6 +36,14 @@ def create(name, **kwargs):
 class Optimizer:
     """Base optimizer (reference: optimizer.py:91)."""
 
+    # Fused multi-tensor family (reference: multi_sgd_update / multi_mp_sgd /
+    # multi_lamb, src/operator/optimizer_op.cc:352-1130). Classes whose
+    # ``_rule`` is pure w.r.t. traced (lr, wd, t) opt in; Trainer then runs
+    # ALL parameter updates as one jitted XLA program per step.
+    #   "sgd":  _rule(w, g, mom,  lr, wd, momentum, rescale, clip)
+    #   "adam": _rule(w, g, m, v, lr, wd, t, beta1, beta2, eps, rescale, clip)
+    _FUSED_FAMILY = None
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
@@ -179,6 +187,8 @@ class SGD(Optimizer):
     """Reference: optimizer/sgd.py over optimizer_op.cc sgd_update /
     sgd_mom_update: state = momentum buffer."""
 
+    _FUSED_FAMILY = "sgd"
+
     def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -265,6 +275,8 @@ class SGLD(Optimizer):
 @register
 class Adam(Optimizer):
     """Reference: optimizer/adam.py over adam_update (optimizer_op.cc)."""
+
+    _FUSED_FAMILY = "adam"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=False, **kwargs):
