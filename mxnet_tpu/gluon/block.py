@@ -377,6 +377,7 @@ class HybridBlock(Block):
         self._cached_graphs = {}
         self._flags = {}
         self._backend = None
+        self._last_input_sig = None
 
     def hybridize(self, active=True, backend=None, backend_opts=None,
                   clear=True, static_alloc=False, static_shape=False,
@@ -413,6 +414,10 @@ class HybridBlock(Block):
         return bool(pending)
 
     def __call__(self, *args, **kwargs):
+        if not kwargs and all(_is_nd(a) for a in args):
+            # remembered for export(): the traced input signature
+            self._last_input_sig = [(tuple(a.shape), str(a.dtype))
+                                    for a in args]
         if not self._active:
             return super().__call__(*args, **kwargs)
         if kwargs:
@@ -434,19 +439,51 @@ class HybridBlock(Block):
 
     # -- export (reference: block.py:1471 export to json+params) -----------
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Save compiled-model artifacts: params npz + a model config json.
+        """Save a graph-only model artifact: params npz + serialized
+        StableHLO + a manifest json.
 
-        The reference writes NNVM json; the graph here is the traced jax
-        program, so we persist the block class path + params. StableHLO
-        export lives in mxnet_tpu.onnx / compiled-artifact tooling.
+        The reference writes NNVM json reloadable by SymbolBlock without the
+        python class (gluon/block.py:1471,1638); the TPU-native equivalent
+        is a jax.export StableHLO artifact (cross-lowered for cpu+tpu, with
+        first-order VJP so the reload stays trainable). Requires at least
+        one prior forward call (to know the input signature) — same
+        precondition as the reference's deferred-compute export.
         """
+        from .. import functional
+        from ..base import np_dtype
+
         params_file = f"{path}-{epoch:04d}.params.npz"
         self.save_parameters(params_file)
         meta = {
-            "format": "mxnet_tpu-hybrid-v1",
+            "format": "mxnet_tpu-hybrid-v2",
             "block_class": f"{type(self).__module__}.{type(self).__name__}",
             "params": os.path.basename(params_file),
         }
+        if self._last_input_sig is None:
+            raise MXNetError(
+                "export requires a prior forward call so the input "
+                "signature is known (reference: hybridize+forward before "
+                "export)")
+        from jax import export as jax_export
+
+        params = functional.param_arrays(self)
+
+        def fwd(params, *inputs):
+            out, _ = functional.functional_call(self, params, *inputs,
+                                                train=False)
+            return out
+
+        param_specs = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       for n, a in params.items()}
+        in_specs = tuple(jax.ShapeDtypeStruct(s, np_dtype(d))
+                         for s, d in self._last_input_sig)
+        exported = jax_export.export(
+            jax.jit(fwd), platforms=["cpu", "tpu"])(param_specs, *in_specs)
+        hlo_file = f"{path}-{epoch:04d}.stablehlo"
+        with open(hlo_file, "wb") as f:
+            f.write(exported.serialize(vjp_order=1))
+        meta["stablehlo"] = os.path.basename(hlo_file)
+        meta["inputs"] = self._last_input_sig
         json_file = f"{path}-symbol.json"
         with open(json_file, "w") as f:
             json.dump(meta, f, indent=2)
@@ -468,23 +505,70 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Load an exported model without its python class (reference:
-    block.py:1638). Minimal: reloads params into a user-supplied block; full
-    graph-only reload is a compiled-artifact (AOT) feature tracked for a
-    later round."""
+    """Run an exported model WITHOUT its python class (reference:
+    block.py:1638): the serialized StableHLO artifact from
+    ``HybridBlock.export`` is the graph, the params npz is the state.
+    Forward dispatches the deserialized program through ``_invoke`` so
+    autograd records it (the artifact carries a first-order VJP), making
+    reloaded models trainable like the reference's SymbolBlock."""
 
-    def __init__(self, outputs=None, inputs=None, params=None):
+    def __init__(self, exported=None, params=None):
         super().__init__()
-        self._outputs = outputs
+        self._exported = exported
+        self._sym_params = dict(params or {})
+
+    def collect_params(self, select=None):
+        if select is None:
+            return dict(self._sym_params)
+        pat = re.compile(select)
+        return {n: p for n, p in self._sym_params.items() if pat.search(n)}
+
+    def forward(self, *args):
+        if self._exported is None:
+            raise MXNetError("SymbolBlock has no graph; use SymbolBlock."
+                             "imports(symbol_file, ...)")
+        from ..numpy.multiarray import _invoke
+        names = sorted(self._sym_params)
+        pdict = {n: self._sym_params[n].data() for n in names}
+
+        def run(pdict_raw, *input_raws):
+            return self._exported.call(pdict_raw, *input_raws)
+
+        return _invoke(run, (pdict, *args), name="SymbolBlock")
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, ctx=None):
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None,
+                allow_class_fallback=False):
+        """Reload an exported artifact. ``input_names`` is accepted for
+        reference-API parity (the artifact embeds its signature)."""
         with open(symbol_file) as f:
             meta = json.load(f)
-        mod_name, cls_name = meta["block_class"].rsplit(".", 1)
-        import importlib
-        cls = getattr(importlib.import_module(mod_name), cls_name)
-        block = cls()
-        if param_file:
-            block.load_parameters(param_file, ctx=ctx)
-        return block
+        base = os.path.dirname(os.path.abspath(symbol_file))
+        if meta.get("stablehlo"):
+            from jax import export as jax_export
+            with open(os.path.join(base, meta["stablehlo"]), "rb") as f:
+                exported = jax_export.deserialize(bytearray(f.read()))
+            params = {}
+            pfile = (param_file
+                     or os.path.join(base, meta.get("params", "")))
+            if pfile and os.path.exists(pfile):
+                import numpy as onp
+                from ..numpy import array
+                with onp.load(pfile) as data:
+                    for name in data.files:
+                        p = Parameter(name, shape=data[name].shape)
+                        p.set_data(array(data[name]))
+                        params[name] = p
+            return SymbolBlock(exported, params)
+        if allow_class_fallback and meta.get("block_class"):
+            # v1 manifests (no graph artifact): reconstruct via the class
+            mod_name, cls_name = meta["block_class"].rsplit(".", 1)
+            import importlib
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            block = cls()
+            if param_file:
+                block.load_parameters(param_file, ctx=ctx)
+            return block
+        raise MXNetError(
+            f"{symbol_file} has no stablehlo graph artifact; re-export with "
+            "HybridBlock.export (or pass allow_class_fallback=True)")
