@@ -289,13 +289,20 @@ class InstanceNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Reference: basic_layers.py Embedding over indexing_op.cc."""
+    """Reference: basic_layers.py Embedding over indexing_op.cc.
+
+    ``sparse_grad=True`` marks the weight for row-sparse access:
+    ``weight.row_sparse_data(ids)`` / ``kvstore.row_sparse_pull`` fetch only
+    touched rows. The gradient itself is computed dense (XLA scatter-add —
+    the reference's storage-fallback path when a dense kernel serves a
+    sparse request, src/common/exec_utils.h)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False):
         super().__init__()
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         self.weight = Parameter("weight", shape=(input_dim, output_dim),
                                 dtype=dtype, init=weight_initializer)
 

@@ -152,6 +152,20 @@ class Parameter:
     def list_grad(self):
         return [self.grad()]
 
+    def row_sparse_data(self, row_id):
+        """Rows of this parameter for the given ids as a RowSparseNDArray
+        (reference: parameter.py row_sparse_data over kvstore
+        PullRowSparse) — the sparse-embedding pull path."""
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        from ..numpy.multiarray import _wrap
+        src = self.data()
+        ids = (row_id._data if isinstance(row_id, ndarray)
+               else jnp.asarray(row_id))
+        from ..ndarray.sparse import _IDX
+        ids = jnp.unique(ids).astype(_IDX)
+        return RowSparseNDArray(_wrap(src._data[ids]), _wrap(ids), src.shape)
+
     def list_ctx(self):
         return [self._data.ctx] if self._data is not None else [current_context()]
 

@@ -179,8 +179,39 @@ class KVStore(KVStoreBase):
         self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # sparse storage is emulated dense on TPU (SURVEY §2.1 NDArray note)
-        self.pull(key, out=out, priority=priority)
+        """Pull only the rows in ``row_ids`` (reference: kvstore.h
+        PullRowSparse / python kvstore.py row_sparse_pull). Returns (and
+        writes into row_sparse ``out`` targets) a RowSparseNDArray holding
+        just those rows — the distributed-embedding fast path."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        from ..ndarray.sparse import RowSparseNDArray
+        keys, _ = self._normalize(key, None)
+        rids = (row_ids if isinstance(row_ids, (list, tuple))
+                else [row_ids] * len(keys))
+        results = []
+        for k, rid in zip(keys, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            from ..ndarray.sparse import _IDX
+            ids = jnp.unique(rid._data if isinstance(rid, ndarray)
+                             else jnp.asarray(rid)).astype(_IDX)
+            vals = src._data[ids]
+            results.append(RowSparseNDArray(_wrap(vals), _wrap(ids),
+                                            src.shape))
+        if out is not None:
+            _, outs = self._normalize(key, out)
+            for rsp, o in zip(results, outs):
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    if isinstance(t, RowSparseNDArray):
+                        t.data = rsp.data
+                        t.indices = rsp.indices
+                        t.shape = rsp.shape
+                    else:  # dense target: retained rows, zeros elsewhere
+                        t._rebind(rsp.tostype("default")._data.astype(t.dtype))
+        return results[0] if not isinstance(key, (list, tuple)) else results
 
     # -- updater / optimizer ----------------------------------------------
     @staticmethod

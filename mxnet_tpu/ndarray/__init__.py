@@ -13,6 +13,8 @@ from ..numpy import ndarray as NDArray, array, zeros, ones, full, arange  # noqa
 from ..numpy.multiarray import _wrap, _invoke  # noqa: F401
 from ..numpy import random  # noqa: F401
 from .. import numpy as _np
+from . import sparse  # noqa: F401
+from .sparse import RowSparseNDArray, CSRNDArray  # noqa: F401
 
 
 def waitall():

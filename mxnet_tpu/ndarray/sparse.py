@@ -1,0 +1,256 @@
+"""Sparse NDArray types: row_sparse and CSR.
+
+Reference parity: python/mxnet/ndarray/sparse.py (RowSparseNDArray,
+CSRNDArray, row_sparse_array/csr_matrix constructors, retain, sparse dot)
+over include/mxnet/ndarray.h:60-64 storage types; kvstore PullRowSparse.
+
+TPU-native design: XLA has no native sparse storage, so a sparse array is a
+pair/triple of DENSE component arrays (values + indices [+ indptr]) and
+sparse ops lower to gather/scatter/segment-sum — static-shaped, MXU/VPU
+friendly. Conversions with data-dependent sizes (dense -> sparse, which
+must discover nnz) run eagerly on host, mirroring the reference's
+imperative-only conversion ops. Everything here is inference of the
+reference's *semantics*, not a translation of its kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..numpy.multiarray import ndarray, _wrap
+
+# index dtype: int64 under x64, else int32 (jax's default truncation would
+# warn on every construction otherwise); reference uses int64 throughout
+_IDX = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
+           "csr_matrix", "zeros", "retain", "dot", "add", "BaseSparseNDArray"]
+
+
+def _as_raw(x, dtype=None):
+    if isinstance(x, ndarray):
+        x = x._data
+    out = jnp.asarray(x, dtype=dtype)
+    return out
+
+
+class BaseSparseNDArray:
+    """Common surface of the sparse types (reference: sparse.py
+    BaseSparseNDArray)."""
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    ctx = context
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.shape} "
+                f"nnz-storage={tuple(self.data.shape)}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at ``indices`` hold ``data``; all other rows are zero
+    (reference: sparse.py RowSparseNDArray). data: (nnz, *row_shape),
+    indices: (nnz,) int64, sorted unique."""
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, ndarray) else _wrap(_as_raw(data))
+        self.indices = (indices if isinstance(indices, ndarray)
+                        else _wrap(_as_raw(indices, _IDX)))
+        self.shape = tuple(int(s) for s in shape)
+        if self.data.shape[1:] != self.shape[1:]:
+            raise MXNetError(
+                f"row shape {self.data.shape[1:]} != array row shape "
+                f"{self.shape[1:]}")
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype != "default":
+            raise MXNetError(f"cannot convert row_sparse to {stype!r}")
+        dense = jnp.zeros(self.shape, self.data.dtype)
+        dense = dense.at[self.indices._data].set(self.data._data)
+        return _wrap(dense)
+
+    def retain(self, row_ids):
+        """Keep only rows in row_ids (reference: sparse.retain op)."""
+        row_ids = _as_raw(row_ids, _IDX)
+        keep = jnp.isin(self.indices._data, row_ids)
+        # data-dependent output size: resolve eagerly (imperative-only op,
+        # like the reference's sparse conversions)
+        keep_np = onp.asarray(keep)
+        idx_np = onp.asarray(self.indices._data)[keep_np]
+        val_np = onp.asarray(self.data._data)[keep_np]
+        return RowSparseNDArray(jnp.asarray(val_np), jnp.asarray(idx_np),
+                                self.shape)
+
+    def copy(self):
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self.shape)
+
+    def __add__(self, other):
+        return add(self, other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: sparse.py CSRNDArray).
+    data/indices: (nnz,), indptr: (m+1,)."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data if isinstance(data, ndarray) else _wrap(_as_raw(data))
+        self.indices = (indices if isinstance(indices, ndarray)
+                        else _wrap(_as_raw(indices, _IDX)))
+        self.indptr = (indptr if isinstance(indptr, ndarray)
+                       else _wrap(_as_raw(indptr, _IDX)))
+        if len(shape) != 2:
+            raise MXNetError("CSR arrays are 2-D")
+        self.shape = tuple(int(s) for s in shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    def _row_of_nnz(self):
+        """row index of each stored value: (nnz,) from indptr."""
+        m = self.shape[0]
+        counts = self.indptr._data[1:] - self.indptr._data[:-1]
+        return jnp.repeat(jnp.arange(m, dtype=_IDX), counts,
+                          total_repeat_length=self.data.shape[0])
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype != "default":
+            raise MXNetError(f"cannot convert csr to {stype!r}")
+        rows = self._row_of_nnz()
+        dense = jnp.zeros(self.shape, self.data.dtype)
+        dense = dense.at[rows, self.indices._data].set(self.data._data)
+        return _wrap(dense)
+
+    def dot(self, rhs):
+        return dot(self, rhs)
+
+    def copy(self):
+        return CSRNDArray(self.data.copy(), self.indices.copy(),
+                          self.indptr.copy(), self.shape)
+
+
+# -- constructors (reference: sparse.py row_sparse_array / csr_matrix) ------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("shape required with (data, indices)")
+        return RowSparseNDArray(_as_raw(data, dtype), indices, shape)
+    # dense input: find the non-zero rows on host (imperative conversion)
+    dense = onp.asarray(arg1.asnumpy() if isinstance(arg1, ndarray)
+                        else arg1, dtype=dtype)
+    nz = onp.where(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz]),
+                            jnp.asarray(nz, _IDX), dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape required with (data, indices, indptr)")
+        return CSRNDArray(_as_raw(data, dtype), indices, indptr, shape)
+    dense = onp.asarray(arg1.asnumpy() if isinstance(arg1, ndarray)
+                        else arg1, dtype=dtype)
+    if dense.ndim != 2:
+        raise MXNetError("CSR arrays are 2-D")
+    rows, cols = onp.nonzero(dense)
+    indptr = onp.zeros(dense.shape[0] + 1, "int64")
+    onp.add.at(indptr, rows + 1, 1)
+    indptr = onp.cumsum(indptr)
+    return CSRNDArray(jnp.asarray(dense[rows, cols]),
+                      jnp.asarray(cols, _IDX),
+                      jnp.asarray(indptr), dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """Empty sparse array (reference: sparse.zeros)."""
+    if stype == "row_sparse":
+        row_shape = tuple(shape[1:])
+        return RowSparseNDArray(jnp.zeros((0,) + row_shape, dtype),
+                                jnp.zeros((0,), _IDX), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), _IDX),
+                          jnp.zeros((shape[0] + 1,), _IDX), shape)
+    if stype == "default":
+        return _wrap(jnp.zeros(shape, dtype))
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+# -- ops --------------------------------------------------------------------
+
+def retain(rsp, row_ids):
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return rsp.retain(row_ids)
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """csr @ dense (reference: sparse dot, src/operator/tensor/dot.cc CSR
+    kernels) as a segment-sum — static shapes, VPU friendly."""
+    if not isinstance(lhs, CSRNDArray):
+        raise MXNetError("sparse dot expects a CSR lhs")
+    rhs_raw = rhs._data if isinstance(rhs, ndarray) else jnp.asarray(rhs)
+    rows = lhs._row_of_nnz()
+    gathered = rhs_raw[lhs.indices._data] * lhs.data._data[:, None]
+    if transpose_a:
+        out = jax.ops.segment_sum(
+            rhs_raw[rows] * lhs.data._data[:, None], lhs.indices._data,
+            num_segments=lhs.shape[1])
+    else:
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=lhs.shape[0])
+    return _wrap(out)
+
+
+def add(a, b):
+    """Sparse + sparse/dense. Same-stype row_sparse adds merge indices;
+    anything else densifies (the reference's storage-fallback path,
+    src/common/exec_utils dispatch-fallback)."""
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        if a.shape != b.shape:
+            raise MXNetError("shape mismatch")
+        idx = onp.union1d(onp.asarray(a.indices._data),
+                          onp.asarray(b.indices._data)).astype("int64")
+        pos = {int(i): j for j, i in enumerate(idx)}
+        vals = onp.zeros((len(idx),) + a.shape[1:],
+                         onp.asarray(a.data._data).dtype)
+        for rsp in (a, b):
+            for j, i in enumerate(onp.asarray(rsp.indices._data)):
+                vals[pos[int(i)]] += onp.asarray(rsp.data._data[j])
+        return RowSparseNDArray(jnp.asarray(vals), jnp.asarray(idx), a.shape)
+    da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
+    db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
+    return da + db

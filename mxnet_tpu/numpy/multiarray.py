@@ -540,9 +540,14 @@ class ndarray:
     def relu(self): return _invoke(jax.nn.relu, (self,))
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage types are emulated as dense on TPU")
-        return self
+        if stype == "default":
+            return self
+        from ..ndarray import sparse as _sparse
+        if stype == "row_sparse":
+            return _sparse.row_sparse_array(self)
+        if stype == "csr":
+            return _sparse.csr_matrix(self)
+        raise MXNetError(f"unknown storage type {stype!r}")
 
     @property
     def stype(self):
