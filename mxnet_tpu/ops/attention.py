@@ -48,27 +48,64 @@ def _use_pallas():
     return devs and devs[0].platform in ("tpu", "axon")
 
 
+# flash wins clearly from ~4k seq and saves O(s^2) HBM from ~2k; below that
+# the XLA composition's fused softmax is faster (measured on v5e, see
+# tests/test_transformer.py + bench notes in the kernel module)
+_FLASH_MIN_SEQ = 2048
+
+
+def _sp_mesh():
+    """Active sequence-parallel mesh from an activation_sharding scope, or
+    None. The 'sp' axis is the ring-attention ring (parallel/ring_attention
+    — the long-context path the brief makes first-class)."""
+    from ..parallel import mesh as _pmesh
+    rules = _pmesh._act_rules
+    if rules is None:
+        return None
+    mesh = rules[0]
+    if "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        return mesh
+    return None
+
+
 def multi_head_attention(query, key, value, heads, mask=None, dropout_p=0.0,
                          causal=False):
-    """Fused MHA on (batch, seq, heads*dim) ndarrays. Attention-prob dropout
-    (applied only in training mode, reference: transformer attention cells)
-    forces the XLA path; the flash kernel handles the pure case."""
+    """Fused MHA on (batch, seq, heads*dim) ndarrays.
+
+    Routing: sp-sharded scope -> ring attention (sequence parallelism over
+    ICI); long unmasked sequences on TPU -> Pallas flash kernel; otherwise
+    the XLA dot_general composition. Attention-prob dropout (training only,
+    reference: transformer attention cells) forces the XLA path.
+    """
     from .. import autograd
     if not autograd.is_training():
         dropout_p = 0.0
-    use_flash = _use_pallas() and mask is None and dropout_p == 0.0
+    pure = mask is None and dropout_p == 0.0
+    sp_mesh = _sp_mesh() if pure else None
 
     def fn(q, k, v):
-        if use_flash:
+        b, sq, hd = q.shape
+        sk = k.shape[1]
+        d = hd // heads
+        if sp_mesh is not None and sq == sk:
+            try:
+                from ..parallel.ring_attention import ring_attention
+                qh = q.reshape(b, sq, heads, d).transpose(0, 2, 1, 3)
+                kh = k.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+                vh = v.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+                out = ring_attention(qh, kh, vh, sp_mesh, axis="sp",
+                                     causal=causal)
+                return out.transpose(0, 2, 1, 3).reshape(b, sq, hd)
+            except Exception:  # seq not divisible by ring, etc.
+                pass
+        if _use_pallas() and pure and sk >= _FLASH_MIN_SEQ:
             try:
                 from .pallas.flash_attention import flash_attention
-                b, sq, hd = q.shape
-                d = hd // heads
                 qh = q.reshape(b, sq, heads, d).transpose(0, 2, 1, 3)
-                kh = k.reshape(b, k.shape[1], heads, d).transpose(0, 2, 1, 3)
-                vh = v.reshape(b, v.shape[1], heads, d).transpose(0, 2, 1, 3)
+                kh = k.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
+                vh = v.reshape(b, sk, heads, d).transpose(0, 2, 1, 3)
                 out = flash_attention(qh, kh, vh, causal=causal)
-                return out.transpose(0, 2, 1, 3).reshape(b, sq, heads * d)
+                return out.transpose(0, 2, 1, 3).reshape(b, sq, hd)
             except Exception:  # pallas unavailable/shape-unsupported
                 pass
         m = mask._data if hasattr(mask, "_data") else mask

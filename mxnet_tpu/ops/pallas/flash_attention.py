@@ -123,31 +123,159 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    """Recompute backward (flash-style residuals: out + logsumexp).
+def _bwd_block(q, do, lse, delta, kb, vb, q0, k0, seq_q, seq_k, causal,
+               scale):
+    """Shared recompute for one (q-block, k-block) tile: returns (p, ds).
 
-    dS = P * (dP - rowsum(dO * O)); XLA fuses the rebuild — the (s, s)
-    matrices live only inside the fused loop nest, per (batch*head).
+    p = exp(s - lse) rebuilt from saved logsumexp; ds = p*(dp - delta)*scale
+    (standard flash-attention backward tile math). All fp32 on the MXU.
+    """
+    bq, bk = q.shape[0], kb.shape[0]
+    s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = (q_pos < seq_q) & (k_pos < seq_k)
+    if causal:
+        valid &= q_pos >= k_pos
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                    dk_ref, dv_ref, *, block_q, block_k, seq_q, seq_k,
+                    causal, scale):
+    """dK/dV for one k-block, accumulated over sequential q-block steps
+    (grid (bh, nk, nq): last axis revisits the same output block)."""
+    j, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse, delta = lse_ref[0], delta_ref[0]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        p, ds = _bwd_block(q, do, lse, delta, kb, vb, qi * block_q,
+                           j * block_k, seq_q, seq_k, causal, scale)
+        dv_ref[0] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_ref[0] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # the tile is all-masked when every q_pos < the k block start
+        pl.when((qi + 1) * block_q - 1 >= j * block_k)(_compute)
+    else:
+        _compute()
+
+
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
+                   *, block_q, block_k, seq_q, seq_k, causal, scale):
+    """dQ for one q-block, accumulated over sequential k-block steps."""
+    qi, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse, delta = lse_ref[0], delta_ref[0]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        _, ds = _bwd_block(q, do, lse, delta, kb, vb, qi * block_q,
+                           j * block_k, seq_q, seq_k, causal, scale)
+        dq_ref[0] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= j * block_k)(_compute)
+    else:
+        _compute()
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    """Blocked Pallas backward (flash-style residuals: out + logsumexp).
+
+    Memory is O(seq): P is rebuilt per (q-block, k-block) tile in VMEM from
+    the saved lse, never materialized in HBM — the training-side completion
+    of the forward kernel's claim (round-1 VJP materialized (s, s) scores).
     """
     q, k, v, out, lse = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    if causal:
-        # top-left alignment (absolute positions), matching the fwd kernel
-        sq, sk = s.shape[1], s.shape[2]
-        mask = jnp.tril(jnp.ones((sq, sk), bool))
-        s = jnp.where(mask[None], s, _NEG_INF)
-    p = jnp.exp(s - lse)                                   # (bh, sq, sk)
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf).astype(q.dtype)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf).astype(k.dtype)
-    return dq, dk, dv.astype(v.dtype)
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    sq_pad, sk_pad = -sq % bq, -sk % bk
+    if sq_pad:
+        pad = ((0, 0), (0, sq_pad), (0, 0))
+        q, do = jnp.pad(q, pad), jnp.pad(do, pad)
+        lse, delta = (jnp.pad(lse, ((0, 0), (0, sq_pad), (0, 0))),
+                      jnp.pad(delta, ((0, 0), (0, sq_pad), (0, 0))))
+    if sk_pad:
+        pad = ((0, 0), (0, sk_pad), (0, 0))
+        k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+    sq_full, sk_full = sq + sq_pad, sk + sk_pad
+    nq, nk = sq_full // bq, sk_full // bk
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda i, a, b: (i, a, 0))
+    r_spec = pl.BlockSpec((1, bq, 1), lambda i, a, b: (i, a, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda i, a, b: (i, b, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, block_k=bk,
+                          seq_q=sq, seq_k=sk, causal=causal, scale=scale),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, b, a: (i, a, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, b, a: (i, a, 0)),
+            pl.BlockSpec((1, bq, 1), lambda i, b, a: (i, a, 0)),
+            pl.BlockSpec((1, bq, 1), lambda i, b, a: (i, a, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, b, a: (i, b, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, b, a: (i, b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, b, a: (i, b, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, b, a: (i, b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_full, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk_full, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, do, lse, delta, k, v)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=bq, block_k=bk,
+                          seq_q=sq, seq_k=sk, causal=causal, scale=scale),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, q_spec, r_spec, r_spec, k_spec, k_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq_full, d), jnp.float32),
+        interpret=interpret,
+    )(q, do, lse, delta, k, v)
+
+    if sq_pad:
+        dq = dq[:, :sq]
+    if sk_pad:
+        dk, dv = dk[:, :sk], dv[:, :sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

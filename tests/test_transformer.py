@@ -216,3 +216,25 @@ def test_flash_attention_ragged_shapes(sq, sk, causal):
     r = jax.grad(lambda *a: ref(*a).sum(), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g, r):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b), atol=1e-3)
+
+
+def test_mha_routes_to_ring_attention_under_sp_scope():
+    """MultiHeadAttention under an sp-sharded activation scope must produce
+    the same values as the unsharded composition (ring attention path)."""
+    import jax
+    import numpy as onp
+    from jax.sharding import PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.nn.transformer import MultiHeadAttention
+
+    mesh = parallel.make_mesh({"sp": 4})
+    mha = MultiHeadAttention(units=32, num_heads=4, causal=True)
+    mha.initialize()
+    x = mx.np.array(onp.random.RandomState(0).randn(2, 16, 32)
+                    .astype("float32"))
+    ref = mha(x).asnumpy()  # no scope: XLA composition
+    with parallel.activation_sharding(mesh, residual=P(None, "sp", None)):
+        out = mha(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
