@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Per-op microbenchmark harness (reference: benchmark/opperf/opperf.py:56 —
+runs every registered op with timing; here the focus is the two numbers the
+TPU design cares about per op: eager DISPATCH overhead on the host (the
+reference's 'hard part #1', SURVEY §7) and end-to-end device time).
+
+Method: for each op, N dispatches are issued back-to-back and the chain is
+synced once at the end (e2e/iter); dispatch overhead is the host time of
+the issuing loop alone. Prints a table and optionally JSON.
+
+Usage: python benchmark/opperf.py [--ops add,matmul,...] [--json out.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as onp
+
+
+def _default_ops(mx, shape):
+    np, npx = mx.np, mx.npx
+    a = np.random.uniform(size=shape)
+    b = np.random.uniform(size=shape)
+    m = np.random.uniform(size=(shape[0], shape[0]))
+    idx = np.array(onp.random.randint(0, shape[0], (64,)), dtype="int32")
+    ops = {
+        # elementwise arithmetic
+        "add": lambda: a + b, "subtract": lambda: a - b,
+        "multiply": lambda: a * b, "true_divide": lambda: a / b,
+        "negative": lambda: -a, "power": lambda: a ** 2,
+        "maximum": lambda: np.maximum(a, b),
+        "minimum": lambda: np.minimum(a, b),
+        "where": lambda: np.where(a > b, a, b),
+        "clip": lambda: np.clip(a, 0.2, 0.8),
+        # unary math
+        "exp": lambda: np.exp(a), "log": lambda: np.log(a + 1),
+        "sqrt": lambda: np.sqrt(a), "square": lambda: np.square(a),
+        "abs": lambda: np.abs(a), "sign": lambda: np.sign(a),
+        "tanh": lambda: np.tanh(a), "erf": lambda: npx.erf(a),
+        "sigmoid": lambda: npx.sigmoid(a), "relu": lambda: npx.relu(a),
+        "gelu": lambda: npx.leaky_relu(a, act_type="gelu"),
+        # reductions
+        "sum": lambda: np.sum(a), "mean": lambda: np.mean(a),
+        "max": lambda: np.max(a), "min": lambda: np.min(a),
+        "var": lambda: np.var(a), "argmax": lambda: np.argmax(a),
+        "norm": lambda: np.linalg.norm(a),
+        "softmax": lambda: npx.softmax(a),
+        "log_softmax": lambda: npx.log_softmax(a),
+        # linear algebra / MXU
+        "matmul": lambda: np.matmul(m, m),
+        "dot": lambda: np.dot(m, m),
+        "einsum": lambda: np.einsum("ij,jk->ik", m, m),
+        "tensordot": lambda: np.tensordot(m, m, axes=1),
+        # shape / data movement
+        "reshape": lambda: a.reshape(-1),
+        "transpose": lambda: np.transpose(a),
+        "concatenate": lambda: np.concatenate([a, b], axis=0),
+        "stack": lambda: np.stack([a, b]),
+        "split": lambda: np.split(a, 2, axis=0),
+        "expand_dims": lambda: np.expand_dims(a, 0),
+        "squeeze": lambda: np.squeeze(np.expand_dims(a, 0), 0),
+        "broadcast_to": lambda: np.broadcast_to(a[:1], shape),
+        "tile": lambda: np.tile(a[:8], (2, 1)),
+        "take": lambda: np.take(a, idx, axis=0),
+        "gather(embedding)": lambda: npx.embedding(
+            idx, m, input_dim=m.shape[0], output_dim=m.shape[1]),
+        "one_hot": lambda: npx.one_hot(idx, 64),
+        "arange": lambda: np.arange(shape[0]),
+        "zeros": lambda: np.zeros(shape),
+        "cumsum": lambda: np.cumsum(a, axis=0),
+        "sort": lambda: np.sort(a, axis=-1),
+        "topk": lambda: npx.topk(a, k=4),
+        "batch_norm-like": lambda: (a - np.mean(a)) / np.sqrt(np.var(a) + 1e-5),
+        "layer_norm": lambda: npx.layer_norm(
+            a, np.ones((shape[-1],)), np.zeros((shape[-1],)), axis=-1),
+    }
+    return ops
+
+
+def run(ops=None, warmup=5, iters=100, shape=(128, 128)):
+    import mxnet_tpu as mx
+    table = _default_ops(mx, shape)
+    if ops:
+        table = {k: v for k, v in table.items() if k in ops}
+    rows = []
+    for name, fn in table.items():
+        try:
+            for _ in range(warmup):
+                out = fn()
+            mx.nd.waitall()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn()
+            t_dispatch = time.perf_counter() - t0
+            mx.nd.waitall()
+            t_e2e = time.perf_counter() - t0
+            rows.append({"op": name,
+                         "dispatch_us": round(t_dispatch / iters * 1e6, 2),
+                         "e2e_us": round(t_e2e / iters * 1e6, 2)})
+        except Exception as e:
+            rows.append({"op": name, "error": repr(e)[:120]})
+        del out
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ops", default=None,
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--shape", default="128,128")
+    p.add_argument("--json", default=None, help="also write JSON here")
+    args = p.parse_args()
+    shape = tuple(int(s) for s in args.shape.split(","))
+    ops = set(args.ops.split(",")) if args.ops else None
+    rows = run(ops=ops, iters=args.iters, shape=shape)
+    print(f"{'Op':24s} {'dispatch(us)':>14s} {'e2e(us)':>12s}")
+    for r in sorted(rows, key=lambda r: -r.get("e2e_us", 0)):
+        if "error" in r:
+            print(f"{r['op']:24s}  ERROR {r['error']}")
+        else:
+            print(f"{r['op']:24.24s} {r['dispatch_us']:14.2f} "
+                  f"{r['e2e_us']:12.2f}")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

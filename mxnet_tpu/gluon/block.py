@@ -282,6 +282,17 @@ class _CachedGraph:
                 self.params[n]._data._data = raw
 
     def __call__(self, args):
+        from .. import profiler as _profiler
+        if _profiler._state["running"] and \
+                _profiler._config["profile_symbolic"]:
+            # one span per compiled-forward replay (the reference profiles
+            # CachedOp as a single engine op)
+            with _profiler.span(f"CachedOp:{type(self.block).__name__}",
+                                "symbolic"):
+                return self._call_impl(args)
+        return self._call_impl(args)
+
+    def _call_impl(self, args):
         leaves, treedef = _flatten_args(args)
         input_raws, static_leaves = [], []
         for l in leaves:

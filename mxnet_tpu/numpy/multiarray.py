@@ -72,7 +72,19 @@ def _invoke(prim, args, kwargs=None, name=None):
     Reference analog: FFI glue -> Imperative::Invoke -> Engine::PushAsync
     (src/imperative/imperative.cc:49-140). Here: jnp call (async PJRT
     dispatch); under recording additionally capture the VJP with jax.vjp.
+    When the profiler runs, every dispatch is recorded as a host span and
+    an Xprof TraceAnnotation — the analog of the engine-integrated
+    ProfileOperator (src/engine/threaded_engine.h:356-367).
     """
+    from .. import profiler as _profiler
+    if _profiler._state["running"] and _profiler._config["profile_imperative"]:
+        with _profiler.span(name or getattr(prim, "__name__", "op"),
+                            "operator"):
+            return _invoke_impl(prim, args, kwargs, name)
+    return _invoke_impl(prim, args, kwargs, name)
+
+
+def _invoke_impl(prim, args, kwargs=None, name=None):
     kwargs = kwargs or {}
     from .. import amp as _amp
     amp_dt = _amp._op_cast_dtype(name or getattr(prim, "__name__", ""))

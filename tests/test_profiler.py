@@ -1,0 +1,82 @@
+"""Profiler: per-op attribution from the dispatcher + CachedOp spans +
+chrome-trace dump (reference: tests/python/unittest/test_profiler.py over
+src/engine/threaded_engine.h:356 engine-integrated ProfileOperator)."""
+import json
+import os
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.gluon import nn
+
+
+def _reset():
+    profiler._events.clear()
+    profiler.set_state("stop")
+
+
+def test_ops_recorded_when_running(tmp_path):
+    _reset()
+    profiler.set_state("run")
+    a = mx.np.ones((8, 8))
+    _ = mx.np.matmul(a, a)
+    _ = a + a
+    profiler.set_state("stop")
+    names = [e["name"] for e in profiler._events]
+    assert "matmul" in names, names
+    stats = profiler.dumps()
+    assert "matmul" in stats
+    f = tmp_path / "trace.json"
+    profiler.set_config(filename=str(f))
+    profiler.dump()
+    with open(f) as fh:
+        trace = json.load(fh)
+    assert any(ev["name"] == "matmul" for ev in trace["traceEvents"])
+
+
+def test_nothing_recorded_when_stopped():
+    _reset()
+    a = mx.np.ones((4, 4))
+    _ = mx.np.matmul(a, a)
+    assert not profiler._events
+
+
+def test_cachedop_span_recorded():
+    _reset()
+    net = nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((2, 8))
+    net(x)  # build cache before profiling
+    profiler.set_state("run")
+    net(x)
+    profiler.set_state("stop")
+    names = [e["name"] for e in profiler._events]
+    assert any(n.startswith("CachedOp:") for n in names), names
+
+
+def test_profile_imperative_flag_gates_op_spans():
+    _reset()
+    profiler.set_config(profile_imperative=False)
+    try:
+        profiler.set_state("run")
+        a = mx.np.ones((4, 4))
+        _ = mx.np.matmul(a, a)
+        profiler.set_state("stop")
+        assert not any(e["name"] == "matmul" for e in profiler._events)
+    finally:
+        profiler.set_config(profile_imperative=True)
+
+
+def test_opperf_harness_runs():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "benchmark"))
+    import opperf
+    rows = opperf.run(ops={"add", "matmul", "softmax"}, warmup=1, iters=3,
+                      shape=(16, 16))
+    assert len(rows) == 3
+    for r in rows:
+        assert "error" not in r, r
+        assert r["e2e_us"] >= 0 and r["dispatch_us"] >= 0
