@@ -66,6 +66,7 @@ class MoEDense(HybridBlock):
             remaining = probs
             position_in_expert = jnp.zeros((n_exp,), jnp.int32)
             route_count = jnp.zeros((n_exp,), jnp.float32)
+            gate_sum = jnp.zeros((T,), jnp.float32)
             for _ in range(topk):
                 choice = jnp.argmax(remaining, -1)               # (T,)
                 gate_val = jnp.take_along_axis(
@@ -83,6 +84,7 @@ class MoEDense(HybridBlock):
                     sel[:, :, None] * pos_oh[:, None, :] > 0)
                 combine = combine + (gate_val[:, None, None]
                                      * sel[:, :, None] * pos_oh[:, None, :])
+                gate_sum = gate_sum + gate_val
                 position_in_expert = position_in_expert + jnp.sum(
                     onehot * keep[:, None].astype(jnp.int32), 0)
                 # pre-drop router assignments (Switch defines f_i over what
@@ -90,6 +92,12 @@ class MoEDense(HybridBlock):
                 route_count = route_count + jnp.sum(
                     onehot.astype(jnp.float32), 0)
                 remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+            if topk > 1:
+                # GShard top-k: renormalize combine weights over the chosen
+                # experts (pre-capacity-drop), so kept gates sum to <= 1;
+                # top-1 keeps the raw router prob (Switch formulation)
+                combine = combine / (gate_sum[:, None, None] + 1e-9)
 
             # dispatch tokens to expert buffers: (E, C, d)
             exp_in = jnp.einsum("tec,td->ecd",

@@ -53,8 +53,12 @@ class FunctionalOptimizer:
 
     def init(self, raw_params):
         states = {}
-        for i, name in enumerate(sorted(raw_params)):
-            s = self.opt.create_state(i, _wrap(raw_params[name]))
+        for name in raw_params:
+            # states/settings key by STRUCTURAL NAME, not position: dict
+            # ordering through a jit boundary is canonicalized, so a
+            # positional index could bind lr_mult/wd_mult to the wrong
+            # parameter vs the eager Trainer (collect_params order)
+            s = self.opt.create_state(name, _wrap(raw_params[name]))
             states[name] = jax.tree_util.tree_map(
                 lambda x: x._data if isinstance(x, ndarray) else x, s,
                 is_leaf=lambda x: isinstance(x, ndarray))
@@ -62,13 +66,13 @@ class FunctionalOptimizer:
 
     def update(self, raw_params, raw_grads, states, lr=None):
         new_p, new_s = {}, {}
-        for i, name in enumerate(sorted(raw_params)):
+        for name in raw_params:
             if name not in raw_grads:
                 new_p[name] = raw_params[name]
                 new_s[name] = states[name]
                 continue
-            wd = self.opt._get_wd(i)
-            lr_i = lr if lr is not None else self.opt._get_lr(i)
+            wd = self.opt._get_wd(name)
+            lr_i = lr if lr is not None else self.opt._get_lr(name)
             wrapped = jax.tree_util.tree_map(
                 _wrap, states[name],
                 is_leaf=lambda x: x is None)

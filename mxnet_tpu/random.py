@@ -48,6 +48,16 @@ def _next_key():
             # constant key instead (randomness is then baked per-trace; pass
             # an explicit key for per-step randomness under jit).
             _fallback_n += 1
+            import sys
+            ag = sys.modules.get("mxnet_tpu.autograd")
+            if ag is not None and ag.is_training():
+                import warnings
+                warnings.warn(
+                    "mxnet_tpu.random: RNG drawn inside an external jit "
+                    "trace without a trace_key_scope — the sample (e.g. a "
+                    "dropout mask) is baked into the compiled program and "
+                    "repeats every step. Use hybridize()/functional_call "
+                    "or pass an explicit key.", stacklevel=3)
             # tag keeps this stream disjoint from any seeded eager stream
             return jax.random.fold_in(
                 jax.random.PRNGKey(0x7A17BA5E), _fallback_n)

@@ -150,23 +150,46 @@ class Symbol:
         return Executor(self, args, None, grad_req)
 
     # -- serialization (reference json schema) ------------------------------
+    @staticmethod
+    def _enc_attr(v):
+        """Attr encoder: ndarray constants serialize by value (the
+        reference stores constants in the params file; here they live in
+        the graph json so a bare json round-trips)."""
+        if isinstance(v, ndarray):
+            return json.dumps({"__ndarray__": v.asnumpy().tolist(),
+                               "dtype": str(v.dtype)})
+        return v if isinstance(v, str) else json.dumps(v)
+
     def tojson(self):
-        nodes, index = [], {}
-        for i, s in enumerate(self._topo()):
-            index[id(s)] = i
+        # Group serializes as multiple heads entries (the reference schema
+        # supports this); the synthetic 'group' node itself is not emitted.
+        head_syms = self.symbols if isinstance(self, Group) else [self]
+        nodes, index, seen = [], {}, set()
+
+        def visit(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            index[id(s)] = len(nodes)
             nodes.append({
                 "op": "null" if s._op is None else s._op,
                 "name": s.name,
-                "attrs": {k: json.dumps(v) if not isinstance(v, str) else v
+                "attrs": {k: self._enc_attr(v)
                           for k, v in s._kwargs.items()},
                 "inputs": [[index[id(inp)], 0, 0] for inp in s._inputs],
             })
+        for h in head_syms:
+            if isinstance(h, Group):
+                raise MXNetError("nested Group symbols do not serialize")
+            visit(h)
         arg_nodes = [i for i, n in enumerate(nodes) if n["op"] == "null"]
         return json.dumps({
             "nodes": nodes,
             "arg_nodes": arg_nodes,
             "node_row_ptr": list(range(len(nodes) + 1)),
-            "heads": [[len(nodes) - 1, 0, 0]],
+            "heads": [[index[id(h)], 0, 0] for h in head_syms],
             "attrs": {"mxnet_version": ["int", 20000]},
         }, indent=2)
 
@@ -238,16 +261,20 @@ def load_json(json_str):
         kwargs = {}
         for k, v in node.get("attrs", {}).items():
             try:
-                kwargs[k] = json.loads(v)
+                val = json.loads(v)
             except (json.JSONDecodeError, TypeError):
-                kwargs[k] = v
+                val = v
+            if isinstance(val, dict) and "__ndarray__" in val:
+                from ..numpy import array
+                val = array(val["__ndarray__"], dtype=val.get("dtype"))
+            kwargs[k] = val
         if node["op"] == "null":
             built.append(Variable(node["name"], **kwargs))
         else:
             inputs = [built[i] for i, _, _ in node["inputs"]]
             built.append(Symbol(node["op"], inputs, kwargs, node["name"]))
-    head = data["heads"][0][0]
-    return built[head]
+    heads = [built[i] for i, _, _ in data["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
 
 
 def load(fname):

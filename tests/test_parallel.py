@@ -261,3 +261,36 @@ def test_moe_topk_validation():
     from mxnet_tpu.gluon.nn.moe import MoEDense
     with pytest.raises(ValueError, match="num_experts_per_tok"):
         MoEDense(8, 16, num_experts=2, num_experts_per_tok=3)
+
+
+def test_moe_top2_oracle():
+    """Top-2 routing with GShard gate renormalization vs a numpy oracle."""
+    import math
+    from mxnet_tpu.gluon.nn.moe import MoEDense
+
+    mx.random.seed(3)
+    onp.random.seed(3)
+    moe = MoEDense(8, 16, num_experts=4, num_experts_per_tok=2,
+                   capacity_factor=8.0)  # capacity high: no drops
+    moe.initialize()
+    x = np.array(onp.random.randn(1, 5, 8).astype("float32"))
+    out, aux = moe(x)
+
+    g = moe.gate.data().asnumpy()
+    wi = moe.w_in.data().asnumpy()
+    wo = moe.w_out.data().asnumpy()
+    toks = x.asnumpy().reshape(-1, 8)
+    logits = toks @ g
+    probs = onp.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = onp.zeros_like(toks)
+    for t in range(toks.shape[0]):
+        top2 = onp.argsort(-probs[t])[:2]
+        denom = probs[t, top2].sum() + 1e-9
+        for e in top2:
+            h = toks[t] @ wi[e]
+            h = 0.5 * h * (1 + onp.array(
+                [math.erf(v / 2 ** 0.5) for v in h]))
+            ref[t] += (probs[t, e] / denom) * (h @ wo[e])
+    onp.testing.assert_allclose(out.asnumpy().reshape(-1, 8), ref,
+                                atol=1e-4)

@@ -85,3 +85,28 @@ def test_group_outputs():
     o1, o2 = g.eval(a=av)
     onp.testing.assert_allclose(o1.asnumpy(), [2, 2])
     onp.testing.assert_allclose(o2.asnumpy(), [3, 3])
+
+
+def test_json_roundtrip_with_ndarray_constant():
+    """sym + mx.np.array(...) constants must serialize by value."""
+    a = sym.Variable("a")
+    c = a + mx.np.array([1.0, 2.0, 3.0])
+    js = c.tojson()
+    c2 = sym.load_json(js)
+    x = mx.np.array([10.0, 20.0, 30.0])
+    onp.testing.assert_allclose(c2.eval(a=x)[0].asnumpy(),
+                                [11.0, 22.0, 33.0])
+
+
+def test_group_json_roundtrip():
+    """Group serializes as multiple heads and reloads as a Group."""
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    g = sym.Group([a + b, a * b])
+    js = g.tojson()
+    g2 = sym.load_json(js)
+    x = mx.np.array([2.0, 3.0])
+    y = mx.np.array([4.0, 5.0])
+    outs = g2.eval(a=x, b=y)
+    onp.testing.assert_allclose(outs[0].asnumpy(), [6.0, 8.0])
+    onp.testing.assert_allclose(outs[1].asnumpy(), [8.0, 15.0])
