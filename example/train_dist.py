@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Multi-process data-parallel training via dist_sync KVStore (reference:
+tests/nightly/dist_device_sync_kvstore.py usage; launch with the tracker
+analog):
+
+    python tools/launch.py -n 2 python example/train_dist.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = onp.random.RandomState(kv.rank)
+    for step in range(20):
+        x = mx.np.array(rng.randn(32, 128).astype("float32"))
+        y = mx.np.array(rng.randint(0, 10, (32,)).astype("int32"))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(32)
+    print(f"worker {kv.rank}/{kv.num_workers} final loss "
+          f"{float(loss.mean()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""LeNet on MNIST — the reference's canonical first example
+(example/image-classification; BASELINE.json config #1/#2 shape).
+
+Synthetic data is used automatically when the MNIST files aren't cached
+(this environment has no egress); pass --data for a local copy.
+
+    python example/train_mnist.py [--epochs 2] [--batch-size 64] [--hybridize]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def build_lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, kernel_size=5, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, kernel_size=5, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(500, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--hybridize", action="store_true")
+    p.add_argument("--data", default=None, help="MNIST root (optional)")
+    args = p.parse_args()
+
+    kwargs = {"root": args.data} if args.data else {}
+    train_set = gluon.data.vision.MNIST(train=True, **kwargs)
+    train_loader = gluon.data.DataLoader(
+        train_set.transform_first(
+            lambda d: mx.np.array(d, dtype="float32").reshape(1, 28, 28)
+            / 255.0),
+        batch_size=args.batch_size, shuffle=True, last_batch="discard")
+
+    net = build_lenet()
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic, n = time.time(), 0
+        for x, y in train_loader:
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update(y, out)
+            n += args.batch_size
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.4f} "
+              f"({n / (time.time() - tic):.0f} samples/s)")
+    net.export("lenet")
+    print("exported lenet-symbol.json + params (+ stablehlo artifact)")
+
+
+if __name__ == "__main__":
+    main()

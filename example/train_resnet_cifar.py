@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""ResNet-18 on CIFAR-10 with KVStore data parallelism (reference:
+example/image-classification/train_cifar10.py shape). Falls back to
+synthetic data without a cached dataset; runs data-parallel when more
+than one device is visible.
+
+    python example/train_resnet_cifar.py [--epochs 1] [--batch-size 128]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon.model_zoo.vision import get_resnet  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--kvstore", default="device")
+    p.add_argument("--max-batches", type=int, default=None)
+    args = p.parse_args()
+
+    dataset = gluon.data.vision.CIFAR10(train=True)
+    loader = gluon.data.DataLoader(
+        dataset.transform_first(
+            lambda d: mx.np.array(d, dtype="float32")
+            .transpose(2, 0, 1) / 255.0),
+        batch_size=args.batch_size, shuffle=True, last_batch="discard")
+
+    net = get_resnet(1, 18, classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4}, kvstore=args.kvstore)
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic, n = time.time(), 0
+        for bi, (x, y) in enumerate(loader):
+            if args.max_batches and bi >= args.max_batches:
+                break
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            n += args.batch_size
+        print(f"epoch {epoch}: {n / (time.time() - tic):.0f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Pretrained weight cache (reference: gluon/model_zoo/model_store.py —
+get_model_file with sha1-checked download into MXNET_HOME/models, purge).
+
+TPU-native build ships no weights and this environment has no egress, so
+the cache-first mechanism is the deliverable: weights found under the
+cache root load immediately; otherwise a download from
+``MXNET_GLUON_REPO`` is attempted and a clear, actionable error names the
+exact path to provision offline.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import zipfile
+
+from ...base import MXNetError
+
+_REPO_ENV = "MXNET_GLUON_REPO"
+_DEFAULT_REPO = "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
+
+# name -> sha1 of the reference release archives (model_store.py
+# _model_sha1); entries are added as archives are provisioned locally.
+_model_sha1 = {}
+
+
+def data_dir():
+    return os.path.expanduser(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")))
+
+
+def _default_root():
+    return os.path.join(data_dir(), "models")
+
+
+def short_hash(name):
+    if name in _model_sha1:
+        return _model_sha1[name][:8]
+    return None
+
+
+def _check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def get_model_file(name, root=None):
+    """Path to the cached params file for ``name``, downloading if a repo is
+    reachable (reference: model_store.py get_model_file)."""
+    root = os.path.expanduser(root or _default_root())
+    candidates = [os.path.join(root, f"{name}.params"),
+                  os.path.join(root, f"{name}.params.npz")]
+    h = short_hash(name)
+    if h:
+        candidates.insert(0, os.path.join(root, f"{name}-{h}.params"))
+    for c in candidates:
+        if os.path.exists(c):
+            if h and c.endswith(f"{h}.params") and \
+                    not _check_sha1(c, _model_sha1[name]):
+                raise MXNetError(f"checksum mismatch for {c}; delete and "
+                                 "re-provision")
+            return c
+
+    os.makedirs(root, exist_ok=True)
+    repo = os.environ.get(_REPO_ENV, _DEFAULT_REPO)
+    url = f"{repo.rstrip('/')}/gluon/models/{name}.zip"
+    zip_path = os.path.join(root, f"{name}.zip")
+    try:
+        from urllib.request import urlretrieve
+        urlretrieve(url, zip_path)
+        with zipfile.ZipFile(zip_path) as zf:
+            zf.extractall(root)
+        os.remove(zip_path)
+    except Exception as e:
+        raise MXNetError(
+            f"pretrained weights for {name!r} are not cached and could not "
+            f"be downloaded from {url} ({type(e).__name__}). Provision the "
+            f"file offline as {candidates[-1]} (Block.save_parameters "
+            "format) or set MXNET_GLUON_REPO to a reachable mirror."
+        ) from e
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    raise MXNetError(f"downloaded archive for {name!r} did not contain a "
+                     "params file")
+
+
+def load_pretrained(net, name, root=None, ctx=None):
+    """Load cached weights into ``net`` (helper used by model factories)."""
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net
+
+
+def purge(root=None):
+    """Remove cached model files (reference: model_store.purge)."""
+    root = os.path.expanduser(root or _default_root())
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith((".params", ".params.npz", ".zip")):
+            os.remove(os.path.join(root, f))

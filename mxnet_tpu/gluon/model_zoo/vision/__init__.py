@@ -24,9 +24,20 @@ for _mod_name in ("resnet", "alexnet", "vgg", "mobilenet", "squeezenet",
 
 
 def get_model(name, **kwargs):
-    """Reference: model_zoo/vision get_model(name)."""
+    """Reference: model_zoo/vision get_model(name). ``pretrained=True``
+    loads cached weights through model_store (get_model_file)."""
     name = name.lower().replace(".", "_")
     if name not in _models:
         raise MXNetError(
             f"unknown model {name!r}; available: {sorted(set(_models))}")
-    return _models[name](**kwargs)
+    fn = _models[name]
+    if name.startswith(("resnet", "vgg", "alexnet", "inception")):
+        return fn(**kwargs)  # factory handles pretrained natively
+    pretrained = kwargs.pop("pretrained", False)
+    root = kwargs.pop("root", None)
+    ctx = kwargs.pop("ctx", None)
+    net = fn(**kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, name, root, ctx)
+    return net

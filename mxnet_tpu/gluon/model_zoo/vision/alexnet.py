@@ -31,4 +31,8 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
-    return AlexNet(**kwargs)
+    net = AlexNet(**kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", root, ctx)
+    return net

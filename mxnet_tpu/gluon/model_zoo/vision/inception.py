@@ -147,4 +147,8 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
-    return Inception3(**kwargs)
+    net = Inception3(**kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", root, ctx)
+    return net

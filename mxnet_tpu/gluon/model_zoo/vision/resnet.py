@@ -237,8 +237,8 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable offline; "
-                         "load_parameters() from a local file instead")
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}", root, ctx)
     return net
 
 

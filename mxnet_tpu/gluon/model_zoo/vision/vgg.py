@@ -46,9 +46,11 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     if num_layers not in vgg_spec:
         raise MXNetError(f"invalid vgg depth {num_layers}")
     layers, filters = vgg_spec[num_layers]
+    net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable offline")
-    return VGG(layers, filters, **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"vgg{num_layers}", root, ctx)
+    return net
 
 
 def vgg11(**kwargs):

@@ -109,3 +109,416 @@ def color_normalize(src, mean, std=None):
     if std is not None:
         src = src / std
     return src
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is `size` (reference: image.py
+    resize_short)."""
+    H, W = src.shape[0], src.shape[1]
+    if H > W:
+        new_w, new_h = size, int(H * size / W)
+    else:
+        new_w, new_h = int(W * size / H), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random area/aspect crop (reference: image.py random_size_crop —
+    the Inception-style training crop)."""
+    H, W = src.shape[0], src.shape[1]
+    src_area = H * W
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = onp.random.uniform(*area) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        aspect = onp.exp(onp.random.uniform(*log_ratio))
+        w = int(round((target_area * aspect) ** 0.5))
+        h = int(round((target_area / aspect) ** 0.5))
+        if w <= W and h <= H:
+            x0 = onp.random.randint(0, W - w + 1)
+            y0 = onp.random.randint(0, H - h + 1)
+            return fixed_crop(src, x0, y0, w, h, size, interp), \
+                (x0, y0, w, h)
+    return center_crop(src, size, interp)
+
+
+# -- augmenter chain (reference: python/mxnet/image/image.py Augmenter
+#    classes + CreateAugmenter) ---------------------------------------------
+
+class Augmenter:
+    """Image augmenter base (reference: image.py:~1000 Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = onp.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = onp.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
+        import jax.numpy as jnp
+        raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
+        gray = (raw.astype(jnp.float32) * jnp.asarray(self._coef)).sum()
+        gray = gray * (3.0 / raw.size) * (1.0 - alpha)
+        return _wrap((raw * alpha + gray).astype(raw.dtype))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = onp.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.saturation, self.saturation)
+        import jax.numpy as jnp
+        raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
+        gray = (raw.astype(jnp.float32)
+                * jnp.asarray(self._coef)).sum(-1, keepdims=True)
+        return _wrap((raw * alpha + gray * (1.0 - alpha)).astype(raw.dtype))
+
+
+class HueJitterAug(Augmenter):
+    """Hue jitter via the YIQ rotation trick (reference: image.py
+    HueJitterAug cites the same approximation)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], "float32")
+        self.ityiq = onp.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], "float32")
+
+    def __call__(self, src):
+        import jax.numpy as jnp
+        alpha = onp.random.uniform(-self.hue, self.hue)
+        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       "float32")
+        t = onp.dot(onp.dot(self.ityiq, bt), self.tyiq).T
+        raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
+        return _wrap(jnp.einsum("hwc,cd->hwd", raw.astype(jnp.float32),
+                                jnp.asarray(t)).astype(raw.dtype))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA (AlexNet-style) lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, "float32")
+        self.eigvec = onp.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = onp.dot(self.eigvec * alpha, self.eigval)
+        return src + rgb.astype("float32")
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else onp.asarray(mean, "float32")
+        self.std = None if std is None else onp.asarray(std, "float32")
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = onp.array([[[0.299], [0.587], [0.114]]], "float32").reshape(1, 1, 3)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.random() < self.p:
+            import jax.numpy as jnp
+            raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
+            gray = (raw.astype(jnp.float32)
+                    * jnp.asarray(self._coef)).sum(-1, keepdims=True)
+            return _wrap(jnp.broadcast_to(gray, raw.shape).astype(raw.dtype))
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter list factory (reference: image.py
+    CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(
+            pca_noise,
+            onp.array([55.46, 4.794, 1.148]),
+            onp.array([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]])))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Image data iterator over RecordIO or an image list (reference:
+    image.py ImageIter: decode -> augment -> batch, NCHW output)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        from .recordio import MXIndexedRecordIO, MXRecordIO
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.aug_list = (aug_list if aug_list is not None
+                         else CreateAugmenter(data_shape))
+        self.shuffle = shuffle
+        self.record = None
+        self.imglist = None
+        self.path_root = path_root
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.record = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self.seq = list(self.record.keys)
+            else:
+                self.record = MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist or imglist is not None:
+            entries = []
+            if path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        entries.append((
+                            onp.array([float(x) for x in parts[1:-1]]),
+                            parts[-1]))
+            else:
+                for item in imglist:
+                    entries.append((onp.asarray(item[:-1], "float32"),
+                                    item[-1]))
+            self.imglist = entries
+            self.seq = list(range(len(entries)))
+        else:
+            raise MXNetError("ImageIter needs path_imgrec, path_imglist or "
+                             "imglist")
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self.seq is not None and self.shuffle:
+            onp.random.shuffle(self.seq)
+        if self.record is not None and self.seq is None:
+            self.record.reset()
+
+    def _next_sample(self):
+        from . import recordio as rio
+        if self.record is not None:
+            if self.seq is not None:
+                if self._cursor >= len(self.seq):
+                    raise StopIteration
+                s = self.record.read_idx(self.seq[self._cursor])
+                self._cursor += 1
+            else:
+                s = self.record.read()
+                if s is None:
+                    raise StopIteration
+            header, img = rio.unpack(s)
+            label = onp.array(header.label)
+            return label, img
+        if self._cursor >= len(self.seq):
+            raise StopIteration
+        label, fname = self.imglist[self.seq[self._cursor]]
+        self._cursor += 1
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return onp.asarray(label), f.read()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from .io import DataBatch
+        from .numpy import zeros as np_zeros
+        import jax.numpy as jnp
+        c, h, w = self.data_shape
+        batch = onp.zeros((self.batch_size, c, h, w), "float32")
+        labels = onp.zeros((self.batch_size, self.label_width), "float32")
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, buf = self._next_sample()
+                img = imdecode(buf, flag=1 if c == 3 else 0)
+                for aug in self.aug_list:
+                    img = aug(img)
+                arr = img.asnumpy() if isinstance(img, ndarray) \
+                    else onp.asarray(img)
+                batch[i] = arr.transpose(2, 0, 1)
+                labels[i] = onp.asarray(label).reshape(-1)[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch([_wrap(jnp.asarray(batch))],
+                         [_wrap(jnp.asarray(lab))], pad=pad)

@@ -80,4 +80,8 @@ def allreduce_across_processes(x):
     def _ar(v):
         return jax.lax.psum(v, "dcn")
 
-    return _ar(global_arr)[0]
+    out = _ar(global_arr)
+    # the psum result is replicated across ALL processes' devices; callers
+    # feed it back into single-process eager ops, so hand back this
+    # process's own copy (fully addressable) rather than the global array
+    return out.addressable_data(0)[0]

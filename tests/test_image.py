@@ -1,0 +1,141 @@
+"""Image pipeline: augmenters, ImageIter over RecordIO, im2rec, model_store
+(reference taxonomy: tests/python/unittest/test_image.py +
+test_gluon_model_zoo.py)."""
+import os
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _rand_img(h=36, w=42, c=3, seed=0):
+    return onp.random.RandomState(seed).randint(
+        0, 255, (h, w, c)).astype("uint8")
+
+
+def test_imdecode_imencode_roundtrip_png():
+    img = _rand_img()
+    buf = image.imencode(img, fmt=".png")
+    back = image.imdecode(buf)
+    onp.testing.assert_array_equal(back.asnumpy(), img)
+
+
+def test_resize_and_crops():
+    img = mx.np.array(_rand_img())
+    r = image.resize_short(img, 24)
+    assert min(r.shape[:2]) == 24
+    c, _ = image.center_crop(img, (20, 20))
+    assert c.shape[:2] == (20, 20)
+    rc, _ = image.random_crop(img, (16, 16))
+    assert rc.shape[:2] == (16, 16)
+    rsz, _ = image.random_size_crop(img, (20, 20), (0.5, 1.0), (0.9, 1.1))
+    assert rsz.shape[:2] == (20, 20)
+
+
+def test_create_augmenter_chain():
+    augs = image.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.1,
+                                 rand_gray=0.1)
+    out = mx.np.array(_rand_img())
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == mx.np.float32
+    for a in augs:
+        assert a.dumps()  # serializable descriptions
+
+
+def test_augmenter_determinism_flip():
+    flip = image.HorizontalFlipAug(p=1.0)
+    img = mx.np.array(_rand_img())
+    onp.testing.assert_array_equal(flip(img).asnumpy(),
+                                   img.asnumpy()[:, ::-1])
+
+
+def _write_rec(prefix, n=6, size=32):
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        img = _rand_img(size, size, seed=i)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    rec.close()
+
+
+def test_imageiter_over_recordio(tmp_path):
+    prefix = str(tmp_path / "data")
+    _write_rec(prefix)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=prefix + ".rec",
+                         aug_list=image.CreateAugmenter((3, 24, 24)))
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4,)
+    it.reset()
+    batches = list(it)
+    assert sum(4 - b.pad for b in batches) == 6
+
+
+def test_im2rec_roundtrip(tmp_path):
+    sys.path.insert(0, TOOLS)
+    import im2rec
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            buf = image.imencode(_rand_img(20, 20, seed=i), fmt=".png")
+            with open(root / cls / f"{i}.png", "wb") as f:
+                f.write(buf)
+    prefix = str(tmp_path / "pack")
+    classes = im2rec.make_list(prefix, str(root))
+    assert classes == ["cat", "dog"]
+    im2rec.pack(prefix, str(root))
+    it = image.ImageIter(batch_size=2, data_shape=(3, 20, 20),
+                         path_imgrec=prefix + ".rec",
+                         aug_list=image.CreateAugmenter((3, 20, 20)))
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 20, 20)
+
+
+def test_model_store_cache_and_pretrained(tmp_path, monkeypatch):
+    from mxnet_tpu.gluon.model_zoo import model_store
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    # provision weights into the cache as a user would offline
+    src = get_model("squeezenet1_0", classes=10)
+    src.initialize()
+    src(mx.np.zeros((1, 3, 64, 64)))
+    root = tmp_path / "models"
+    root.mkdir()
+    src.save_parameters(str(root / "squeezenet1_0.params.npz"))
+
+    net = get_model("squeezenet1_0", classes=10, pretrained=True,
+                    root=str(root))
+    a = src.collect_params()
+    b = net.collect_params()
+    for k in a:
+        onp.testing.assert_array_equal(a[k].data().asnumpy(),
+                                       b[k].data().asnumpy())
+
+
+def test_model_store_missing_weights_actionable_error(tmp_path):
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    with pytest.raises(mx.MXNetError) as ei:
+        get_resnet(1, 18, pretrained=True, root=str(tmp_path))
+    msg = str(ei.value)
+    assert "resnet18_v1" in msg and "params" in msg
+
+
+def test_model_store_purge(tmp_path):
+    from mxnet_tpu.gluon.model_zoo import model_store
+    f = tmp_path / "x.params"
+    f.write_bytes(b"abc")
+    model_store.purge(str(tmp_path))
+    assert not f.exists()

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Pack an image folder / .lst file into RecordIO (reference:
+tools/im2rec.py — list generation + pack modes; this covers the python
+single-process path, the common case).
+
+Usage:
+    # generate a list file from a folder of class subdirs
+    python tools/im2rec.py --make-list prefix image_root
+    # pack images from prefix.lst into prefix.rec (+ prefix.idx)
+    python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_list(prefix, root, train_ratio=1.0, shuffle=True, exts=None):
+    exts = exts or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    for label, cls in enumerate(classes):
+        for dirpath, _, files in os.walk(os.path.join(root, cls)):
+            for f in sorted(files):
+                if f.lower().endswith(exts):
+                    rel = os.path.relpath(os.path.join(dirpath, f), root)
+                    entries.append((label, rel))
+    if shuffle:
+        random.shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    for name, chunk in ((f"{prefix}.lst", entries[:n_train]),
+                        (f"{prefix}_val.lst", entries[n_train:])):
+        if not chunk and name.endswith("_val.lst"):
+            continue
+        with open(name, "w") as f:
+            for i, (label, rel) in enumerate(chunk):
+                f.write(f"{i}\t{label}\t{rel}\n")
+    return classes
+
+
+def pack(prefix, root, resize=0, quality=95, color=1):
+    from mxnet_tpu import recordio, image
+
+    record = recordio.MXIndexedRecordIO(f"{prefix}.idx", f"{prefix}.rec", "w")
+    n = 0
+    with open(f"{prefix}.lst") as f:
+        for line in f:
+            idx, label, rel = line.strip().split("\t")
+            img = image.imread(os.path.join(root, rel), flag=color)
+            if resize:
+                img = image.resize_short(img, resize)
+            header = recordio.IRHeader(0, float(label), int(idx), 0)
+            payload = recordio.pack_img(header, img.asnumpy(),
+                                        quality=quality)
+            record.write_idx(int(idx), payload)
+            n += 1
+    record.close()
+    print(f"packed {n} images into {prefix}.rec")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--make-list", action="store_true")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--color", type=int, default=1)
+    args = p.parse_args()
+    if args.make_list:
+        classes = make_list(args.prefix, args.root, args.train_ratio,
+                            not args.no_shuffle)
+        print(f"wrote {args.prefix}.lst ({len(classes)} classes)")
+    else:
+        if not os.path.exists(f"{args.prefix}.lst"):
+            make_list(args.prefix, args.root)
+        pack(args.prefix, args.root, args.resize, args.quality, args.color)
+
+
+if __name__ == "__main__":
+    main()
