@@ -75,14 +75,17 @@ def _compile(jitted, *abstract_args):
     flops = None
     try:
         comp = jitted.lower(*abstract_args).compile()
+    except Exception:
+        return jitted, flops
+    try:
         ca = comp.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         if ca and "flops" in ca:
             flops = float(ca["flops"])
-        return comp, flops
     except Exception:
-        return jitted, flops
+        pass
+    return comp, flops
 
 
 def _cast_tree(tree, dtype):

@@ -55,6 +55,17 @@ from . import symbol as sym
 from . import recordio
 from . import io
 from . import image
+try:
+    from . import onnx
+except ImportError:  # protobuf missing: degrade the feature, not the package
+    import types as _types
+
+    class _OnnxUnavailable(_types.ModuleType):
+        def __getattr__(self, name):
+            raise ImportError(
+                "mx.onnx requires the 'protobuf' package (pip install "
+                "protobuf)")
+    onnx = _OnnxUnavailable("mxnet_tpu.onnx")
 
 kv = kvstore
 

@@ -1,0 +1,695 @@
+"""jaxpr -> ONNX graph translation.
+
+The TPU-native analog of the reference exporter
+(reference: python/mxnet/onnx/mx2onnx/_export_onnx.py MXNetGraph.create_onnx_graph_proto,
+with ~200 per-op translations under mx2onnx/_op_translations/).  The
+reference walks an NNVM symbol graph node by node; here the source of truth
+is what actually executes on TPU — the jaxpr traced from a HybridBlock's
+forward — and each lax primitive has an ONNX translation.  Sub-jaxprs
+(jit/custom_jvp/remat) are inlined, RNG plumbing is removed by dead-code
+elimination of the eval-mode trace.
+
+Opset 17 semantics throughout (ReduceSum takes axes as input; ReduceMax/
+Min/Prod as attribute).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import serde
+from .serde import make_node, make_tensor, make_value_info, onnx_dtype
+
+_INT64_MIN = -(2 ** 63)
+_INT64_MAX = 2 ** 63 - 1
+
+
+# --------------------------------------------------------------------------
+# dead-code elimination (our own, over the public jaxpr datatypes)
+# --------------------------------------------------------------------------
+
+def _dce(jaxpr):
+    """Drop equations whose outputs are never used (e.g. the RNG key
+    plumbing traced by functional_call in eval mode)."""
+    from jax.extend import core as jcore  # Literal/Var types
+    needed = {v for v in jaxpr.outvars if not isinstance(v, jcore.Literal)}
+    keep = []
+    for eqn in reversed(jaxpr.eqns):
+        if any(v in needed for v in eqn.outvars):
+            keep.append(eqn)
+            for v in eqn.invars:
+                if not isinstance(v, jcore.Literal):
+                    needed.add(v)
+    keep.reverse()
+    return jaxpr.replace(eqns=keep)
+
+
+# --------------------------------------------------------------------------
+# translation context
+# --------------------------------------------------------------------------
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = {}
+        self._n = 0
+        self.env = {}           # jax Var -> onnx value name
+
+    def fresh(self, prefix="t"):
+        self._n += 1
+        return f"{prefix}_{self._n}"
+
+    def node(self, op, inputs, n_out=1, out=None, **attrs):
+        outs = out if out is not None else [self.fresh(op.lower())]
+        if isinstance(outs, str):
+            outs = [outs]
+        self.nodes.append(make_node(op, list(inputs), outs, **attrs))
+        return outs[0] if n_out == 1 else outs
+
+    def const(self, array, name=None):
+        arr = np.asarray(array)
+        name = name or self.fresh("const")
+        self.initializers[name] = make_tensor(name, arr)
+        return name
+
+    def i64(self, values):
+        return self.const(np.asarray(values, np.int64))
+
+    def name_of(self, atom):
+        from jax.extend import core as jcore
+        if isinstance(atom, jcore.Literal):
+            return self.const(np.asarray(atom.val, atom.aval.dtype))
+        return self.env[atom]
+
+    def bind(self, var, name):
+        self.env[var] = name
+
+
+def _shape(atom):
+    return tuple(atom.aval.shape)
+
+
+def _dtype(atom):
+    return atom.aval.dtype
+
+
+# --------------------------------------------------------------------------
+# primitive handlers
+# --------------------------------------------------------------------------
+
+_HANDLERS = {}
+
+
+def _reg(*names):
+    def deco(fn):
+        for n in names:
+            _HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+def _simple(onnx_op):
+    def h(ctx, eqn, ins, out):
+        ctx.node(onnx_op, ins, out=out)
+    return h
+
+
+for _lax, _onnx in [
+    ("add", "Add"), ("add_any", "Add"), ("sub", "Sub"), ("mul", "Mul"),
+    ("div", "Div"), ("max", "Max"), ("min", "Min"), ("pow", "Pow"),
+    ("neg", "Neg"), ("exp", "Exp"), ("log", "Log"), ("tanh", "Tanh"),
+    ("logistic", "Sigmoid"), ("erf", "Erf"), ("sqrt", "Sqrt"),
+    ("abs", "Abs"), ("sign", "Sign"), ("floor", "Floor"),
+    ("ceil", "Ceil"), ("round", "Round"), ("is_finite", None),
+    ("sin", "Sin"), ("cos", "Cos"), ("atan", "Atan"), ("asin", "Asin"),
+    ("acos", "Acos"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+    ("eq", "Equal"), ("lt", "Less"), ("le", "LessOrEqual"),
+    ("gt", "Greater"), ("ge", "GreaterOrEqual"),
+    ("and", "And"), ("or", "Or"), ("xor", "Xor"), ("not", "Not"),
+    ("copy", "Identity"), ("stop_gradient", "Identity"),
+]:
+    if _onnx:
+        _reg(_lax)(_simple(_onnx))
+
+
+@_reg("rsqrt")
+def _rsqrt(ctx, eqn, ins, out):
+    s = ctx.node("Sqrt", ins)
+    ctx.node("Reciprocal", [s], out=out)
+
+
+@_reg("square")
+def _square(ctx, eqn, ins, out):
+    ctx.node("Mul", [ins[0], ins[0]], out=out)
+
+
+@_reg("erfc")
+def _erfc(ctx, eqn, ins, out):
+    one = ctx.const(np.asarray(1, _dtype(eqn.invars[0])))
+    e = ctx.node("Erf", ins)
+    ctx.node("Sub", [one, e], out=out)
+
+
+@_reg("log1p")
+def _log1p(ctx, eqn, ins, out):
+    one = ctx.const(np.asarray(1, _dtype(eqn.invars[0])))
+    ctx.node("Log", [ctx.node("Add", [ins[0], one])], out=out)
+
+
+@_reg("expm1")
+def _expm1(ctx, eqn, ins, out):
+    one = ctx.const(np.asarray(1, _dtype(eqn.invars[0])))
+    ctx.node("Sub", [ctx.node("Exp", ins), one], out=out)
+
+
+@_reg("ne")
+def _ne(ctx, eqn, ins, out):
+    ctx.node("Not", [ctx.node("Equal", ins)], out=out)
+
+
+@_reg("rem")
+def _rem(ctx, eqn, ins, out):
+    ctx.node("Mod", ins, out=out, fmod=1)
+
+
+@_reg("clamp")
+def _clamp(ctx, eqn, ins, out):
+    lo, x, hi = ins
+    ctx.node("Clip", [x, lo, hi], out=out)
+
+
+@_reg("integer_pow")
+def _integer_pow(ctx, eqn, ins, out):
+    y = eqn.params["y"]
+    exp = ctx.const(np.asarray(y, _dtype(eqn.invars[0])))
+    ctx.node("Pow", [ins[0], exp], out=out)
+
+
+@_reg("convert_element_type")
+def _convert(ctx, eqn, ins, out):
+    ctx.node("Cast", ins, out=out,
+             to=onnx_dtype(eqn.params["new_dtype"]))
+
+
+@_reg("select_n")
+def _select_n(ctx, eqn, ins, out):
+    pred, *cases = ins
+    if len(cases) != 2 or _dtype(eqn.invars[0]) != np.bool_:
+        raise NotImplementedError(
+            f"select_n with {len(cases)} cases / non-bool predicate")
+    # select_n: False -> cases[0], True -> cases[1]; Where picks X when cond
+    ctx.node("Where", [pred, cases[1], cases[0]], out=out)
+
+
+@_reg("transpose")
+def _transpose(ctx, eqn, ins, out):
+    ctx.node("Transpose", ins, out=out,
+             perm=list(eqn.params["permutation"]))
+
+
+@_reg("reshape")
+def _reshape(ctx, eqn, ins, out):
+    x = ins[0]
+    dims = eqn.params.get("dimensions")
+    if dims is not None:
+        x = ctx.node("Transpose", [x], perm=list(dims))
+    shape = ctx.i64(eqn.params["new_sizes"])
+    ctx.node("Reshape", [x, shape], out=out)
+
+
+@_reg("squeeze")
+def _squeeze(ctx, eqn, ins, out):
+    axes = ctx.i64(eqn.params["dimensions"])
+    ctx.node("Squeeze", [ins[0], axes], out=out)
+
+
+@_reg("expand_dims")
+def _expand_dims(ctx, eqn, ins, out):
+    axes = ctx.i64(eqn.params["dimensions"])
+    ctx.node("Unsqueeze", [ins[0], axes], out=out)
+
+
+@_reg("broadcast_in_dim")
+def _broadcast_in_dim(ctx, eqn, ins, out):
+    shape = tuple(eqn.params["shape"])
+    bdims = tuple(eqn.params["broadcast_dimensions"])
+    in_shape = _shape(eqn.invars[0])
+    mid = [1] * len(shape)
+    for src, dst in enumerate(bdims):
+        mid[dst] = in_shape[src]
+    x = ins[0]
+    if tuple(mid) != in_shape:
+        x = ctx.node("Reshape", [x, ctx.i64(mid)])
+    if tuple(mid) == shape:
+        ctx.node("Identity", [x], out=out)
+    else:
+        ctx.node("Expand", [x, ctx.i64(shape)], out=out)
+
+
+@_reg("concatenate")
+def _concat(ctx, eqn, ins, out):
+    ctx.node("Concat", ins, out=out, axis=int(eqn.params["dimension"]))
+
+
+@_reg("slice")
+def _slice(ctx, eqn, ins, out):
+    p = eqn.params
+    rank = len(_shape(eqn.invars[0]))
+    strides = p["strides"] or (1,) * rank
+    ctx.node("Slice", [ins[0], ctx.i64(p["start_indices"]),
+                       ctx.i64(p["limit_indices"]), ctx.i64(range(rank)),
+                       ctx.i64(strides)], out=out)
+
+
+@_reg("rev")
+def _rev(ctx, eqn, ins, out):
+    dims = list(eqn.params["dimensions"])
+    n = len(dims)
+    ctx.node("Slice", [ins[0], ctx.i64([-1] * n),
+                       ctx.i64([_INT64_MIN] * n), ctx.i64(dims),
+                       ctx.i64([-1] * n)], out=out)
+
+
+@_reg("dynamic_slice")
+def _dynamic_slice(ctx, eqn, ins, out):
+    operand, *starts = ins
+    sizes = list(eqn.params["slice_sizes"])
+    op_shape = _shape(eqn.invars[0])
+    rank = len(sizes)
+    axes_one = ctx.i64([0])
+    starts64 = [ctx.node("Cast", [ctx.node("Unsqueeze", [s, axes_one])],
+                         to=onnx_dtype(np.int64)) for s in starts]
+    start_vec = (starts64[0] if rank == 1
+                 else ctx.node("Concat", starts64, axis=0))
+    # lax.dynamic_slice clamps start into [0, dim - size]; ONNX Slice
+    # clamps the end instead, so reproduce the start clamp explicitly
+    max_start = ctx.i64([d - s for d, s in zip(op_shape, sizes)])
+    start_vec = ctx.node("Max", [start_vec, ctx.i64([0] * rank)])
+    start_vec = ctx.node("Min", [start_vec, max_start])
+    ends = ctx.node("Add", [start_vec, ctx.i64(sizes)])
+    ctx.node("Slice", [operand, start_vec, ends, ctx.i64(range(rank))],
+             out=out)
+
+
+@_reg("pad")
+def _pad(ctx, eqn, ins, out):
+    operand, value = ins
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise NotImplementedError("interior (dilating) pad")
+    rank = len(cfg)
+    lo = [max(p, 0) for p, _, _ in cfg]
+    hi = [max(p, 0) for _, p, _ in cfg]
+    x = operand
+    if any(lo) or any(hi):
+        x = ctx.node("Pad", [x, ctx.i64(lo + hi), value], mode="constant")
+    if any(p < 0 for p, _, _ in cfg) or any(p < 0 for _, p, _ in cfg):
+        starts = [max(-p, 0) for p, _, _ in cfg]
+        ends = [s + d for s, d in zip(starts, _shape(eqn.outvars[0]))]
+        x = ctx.node("Slice", [x, ctx.i64(starts), ctx.i64(ends),
+                               ctx.i64(range(rank))])
+    ctx.node("Identity", [x], out=out)
+
+
+@_reg("iota")
+def _iota(ctx, eqn, ins, out):
+    p = eqn.params
+    arr = np.reshape(
+        np.broadcast_to(
+            np.arange(p["shape"][p["dimension"]], dtype=p["dtype"]).reshape(
+                [-1 if i == p["dimension"] else 1
+                 for i in range(len(p["shape"]))]),
+            p["shape"]),
+        p["shape"])
+    name = ctx.const(arr)
+    ctx.node("Identity", [name], out=out)
+
+
+@_reg("cumsum")
+def _cumsum(ctx, eqn, ins, out):
+    axis = ctx.const(np.asarray(eqn.params["axis"], np.int64))
+    ctx.node("CumSum", [ins[0], axis], out=out,
+             reverse=int(eqn.params.get("reverse", False)))
+
+
+def _reduce(onnx_op, axes_as_input):
+    def h(ctx, eqn, ins, out):
+        axes = list(eqn.params["axes"])
+        if axes_as_input:
+            ctx.node(onnx_op, [ins[0], ctx.i64(axes)], out=out, keepdims=0)
+        else:
+            ctx.node(onnx_op, ins, out=out, axes=axes, keepdims=0)
+    return h
+
+
+_reg("reduce_sum")(_reduce("ReduceSum", True))
+_reg("reduce_max")(_reduce("ReduceMax", False))
+_reg("reduce_min")(_reduce("ReduceMin", False))
+_reg("reduce_prod")(_reduce("ReduceProd", False))
+
+
+@_reg("reduce_and", "reduce_or")
+def _reduce_bool(ctx, eqn, ins, out):
+    op = "ReduceMin" if eqn.primitive.name == "reduce_and" else "ReduceMax"
+    x = ctx.node("Cast", ins, to=onnx_dtype(np.int32))
+    r = ctx.node(op, [x], axes=list(eqn.params["axes"]), keepdims=0)
+    ctx.node("Cast", [r], to=onnx_dtype(np.bool_), out=out)
+
+
+@_reg("argmax", "argmin")
+def _argminmax(ctx, eqn, ins, out):
+    op = "ArgMax" if eqn.primitive.name == "argmax" else "ArgMin"
+    (axis,) = eqn.params["axes"]
+    r = ctx.node(op, ins, axis=int(axis), keepdims=0)
+    want = eqn.params["index_dtype"]
+    if np.dtype(want) != np.int64:
+        ctx.node("Cast", [r], to=onnx_dtype(want), out=out)
+    else:
+        ctx.node("Identity", [r], out=out)
+
+
+@_reg("dot_general")
+def _dot_general(ctx, eqn, ins, out):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[:2]
+    lr, rr = len(_shape(lhs)), len(_shape(rhs))
+    out_dtype = _dtype(eqn.outvars[0])
+    a, b = ins
+    # cast inputs when XLA would accumulate in a wider type
+    # (preferred_element_type); ONNX matmul has no accumulator control.
+    if _dtype(lhs) != out_dtype:
+        a = ctx.node("Cast", [a], to=onnx_dtype(out_dtype))
+    if _dtype(rhs) != out_dtype:
+        b = ctx.node("Cast", [b], to=onnx_dtype(out_dtype))
+    if (lr == 2 and rr == 2 and lb == () and lc == (1,) and rc == (0,)):
+        ctx.node("MatMul", [a, b], out=out)
+        return
+    # general case: Einsum (opset 12+), equation built from dimension_numbers
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    l_sub = [None] * lr
+    r_sub = [None] * rr
+    batch = []
+    for i, j in zip(lb, rb):
+        c = next(letters)
+        l_sub[i] = r_sub[j] = c
+        batch.append(c)
+    for i, j in zip(lc, rc):
+        c = next(letters)
+        l_sub[i] = r_sub[j] = c
+    l_free = []
+    for i in range(lr):
+        if l_sub[i] is None:
+            l_sub[i] = next(letters)
+            l_free.append(l_sub[i])
+    r_free = []
+    for j in range(rr):
+        if r_sub[j] is None:
+            r_sub[j] = next(letters)
+            r_free.append(r_sub[j])
+    eq = f"{''.join(l_sub)},{''.join(r_sub)}->" \
+         f"{''.join(batch + l_free + r_free)}"
+    ctx.node("Einsum", [a, b], out=out, equation=eq)
+
+
+@_reg("conv_general_dilated")
+def _conv(ctx, eqn, ins, out):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed convolution (lhs_dilation)")
+    if p.get("batch_group_count", 1) != 1:
+        raise NotImplementedError("batch_group_count != 1")
+    nd = len(p["window_strides"])
+    x, w = ins
+    # transpose input to NCHW if its spec is not already (N, C, spatial...)
+    if tuple(lhs_spec) != tuple(range(nd + 2)):
+        x = ctx.node("Transpose", [x], perm=list(lhs_spec))
+    if tuple(rhs_spec) != tuple(range(nd + 2)):
+        w = ctx.node("Transpose", [w], perm=list(rhs_spec))
+    pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+    conv = ctx.node("Conv", [x, w],
+                    strides=list(p["window_strides"]),
+                    dilations=list(p["rhs_dilation"]),
+                    group=int(p["feature_group_count"]),
+                    pads=pads)
+    if tuple(out_spec) != tuple(range(nd + 2)):
+        inv = [0] * (nd + 2)
+        for i, d in enumerate(out_spec):
+            inv[d] = i
+        ctx.node("Transpose", [conv], perm=inv, out=out)
+    else:
+        ctx.node("Identity", [conv], out=out)
+
+
+def _window_attrs(eqn):
+    p = eqn.params
+    wd = tuple(p["window_dimensions"])
+    ws = tuple(p["window_strides"])
+    pad = tuple(p["padding"])
+    if any(d != 1 for d in p.get("base_dilation", (1,) * len(wd))):
+        raise NotImplementedError("base_dilation in pooling")
+    if wd[0] != 1 or wd[1] != 1:
+        raise NotImplementedError("pooling window over batch/channel dims")
+    kernel = list(wd[2:])
+    strides = list(ws[2:])
+    pads = [lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]]
+    dil = list(p.get("window_dilation", (1,) * len(wd))[2:])
+    return kernel, strides, pads, dil
+
+
+@_reg("reduce_window_max")
+def _maxpool(ctx, eqn, ins, out):
+    kernel, strides, pads, dil = _window_attrs(eqn)
+    ctx.node("MaxPool", ins, out=out, kernel_shape=kernel,
+             strides=strides, pads=pads, dilations=dil)
+
+
+@_reg("reduce_window_sum")
+def _sumpool(ctx, eqn, ins, out):
+    kernel, strides, pads, dil = _window_attrs(eqn)
+    if any(d != 1 for d in dil):
+        raise NotImplementedError("window_dilation in sum-pooling")
+    avg = ctx.node("AveragePool", ins, kernel_shape=kernel,
+                   strides=strides, pads=pads, count_include_pad=1)
+    count = ctx.const(np.asarray(float(np.prod(kernel)),
+                                 _dtype(eqn.invars[0])))
+    ctx.node("Mul", [avg, count], out=out)
+
+
+@_reg("gather")
+def _gather(ctx, eqn, ins, out):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand, indices = eqn.invars[:2]
+    op_shape = _shape(operand)
+    idx_shape = _shape(indices)
+    slice_sizes = tuple(p["slice_sizes"])
+    rank = len(op_shape)
+    x, idx = ins
+    if _dtype(indices) != np.int64:
+        idx = ctx.node("Cast", [idx], to=onnx_dtype(np.int64))
+
+    offset = tuple(dn.offset_dims)
+    collapsed = tuple(dn.collapsed_slice_dims)
+    start_map = tuple(dn.start_index_map)
+    ob = tuple(getattr(dn, "operand_batching_dims", ()))
+    sb = tuple(getattr(dn, "start_indices_batching_dims", ()))
+
+    # Pattern B: take_along_axis -> GatherElements (+ layout transposes)
+    if (ob and offset == () and len(collapsed) == 1
+            and start_map == collapsed
+            and all(s == 1 for s in slice_sizes)
+            and ob == tuple(d for d in range(rank) if d != collapsed[0])
+            and sb == tuple(range(len(ob)))):
+        axis = collapsed[0]
+        out_shape = idx_shape[:-1]
+        idx2 = ctx.node("Reshape", [idx, ctx.i64(out_shape)])
+        # gather output layout: (batching dims..., free idx dims);
+        # GatherElements works in operand layout -> permute there and back
+        perm = []
+        for d in range(rank):
+            perm.append(ob.index(d) if d != axis else rank - 1)
+        if perm != list(range(rank)):
+            idx2 = ctx.node("Transpose", [idx2], perm=perm)
+        g = ctx.node("GatherElements", [x, idx2], axis=axis)
+        inv = [0] * rank
+        for i, d in enumerate(perm):
+            inv[d] = i
+        if perm != list(range(rank)):
+            ctx.node("Transpose", [g], perm=inv, out=out)
+        else:
+            ctx.node("Identity", [g], out=out)
+        return
+
+    if ob or sb:
+        raise NotImplementedError("gather with batching dims (general form)")
+
+    # Pattern A: jnp.take/embedding -> Gather(axis)
+    if (len(start_map) == 1 and collapsed == start_map
+            and idx_shape[-1] == 1
+            and all(slice_sizes[d] == (1 if d == start_map[0] else op_shape[d])
+                    for d in range(rank))):
+        axis = start_map[0]
+        n_idx = len(idx_shape) - 1
+        want_offset = tuple(
+            d if d < axis else d - 1 + n_idx
+            for d in range(rank) if d != axis)
+        if offset == want_offset:
+            idx2 = ctx.node("Reshape", [idx, ctx.i64(idx_shape[:-1])])
+            ctx.node("Gather", [x, idx2], axis=axis, out=out)
+            return
+
+    # Pattern C: advanced integer indexing over leading dims -> GatherND
+    depth = len(start_map)
+    if (start_map == tuple(range(depth)) and collapsed == start_map
+            and idx_shape[-1] == depth
+            and all(slice_sizes[d] == 1 for d in range(depth))
+            and all(slice_sizes[d] == op_shape[d]
+                    for d in range(depth, rank))
+            and offset == tuple(range(len(idx_shape) - 1,
+                                      len(idx_shape) - 1 + rank - depth))):
+        ctx.node("GatherND", [x, idx], out=out)
+        return
+
+    raise NotImplementedError(
+        f"gather pattern not translatable: {dn}, slice_sizes={slice_sizes}")
+
+
+# sub-jaxpr inlining ---------------------------------------------------------
+
+def _inline(ctx, eqn, ins, out):
+    params = eqn.params
+    sub = None
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in params and params[key] is not None:
+            sub = params[key]
+            break
+    if sub is None:
+        raise NotImplementedError(
+            f"no sub-jaxpr on {eqn.primitive.name}: {list(params)}")
+    closed = sub if hasattr(sub, "jaxpr") else None
+    inner = closed.jaxpr if closed is not None else sub
+    consts = closed.consts if closed is not None else []
+    names = _translate_jaxpr(ctx, inner, consts, ins)
+    outs = [out] if isinstance(out, str) else out
+    for name, o in zip(names, outs):
+        if o is not None:
+            ctx.node("Identity", [name], out=o)
+
+
+for _p in ("jit", "pjit", "closed_call", "core_call", "remat",
+           "remat2", "checkpoint", "custom_jvp_call", "custom_vjp_call",
+           "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr"):
+    _reg(_p)(_inline)
+
+
+# --------------------------------------------------------------------------
+# jaxpr walker
+# --------------------------------------------------------------------------
+
+def _translate_jaxpr(ctx, jaxpr, consts, invar_names):
+    """Translate one (open) jaxpr; returns the onnx names of its outvars."""
+    from jax.extend import core as jcore
+    env = dict()
+
+    def name_of(atom):
+        if isinstance(atom, jcore.Literal):
+            return ctx.const(np.asarray(atom.val, atom.aval.dtype))
+        if atom in env:
+            return env[atom]
+        return ctx.env[atom]
+
+    for var, val in zip(jaxpr.constvars, consts):
+        env[var] = ctx.const(np.asarray(val))
+    for var, name in zip(jaxpr.invars, invar_names):
+        env[var] = name
+
+    saved = ctx.env
+    ctx.env = {**saved, **env}
+    try:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            h = _HANDLERS.get(prim)
+            if h is None:
+                raise NotImplementedError(
+                    f"lax primitive {prim!r} has no ONNX translation")
+            ins = [name_of(v) for v in eqn.invars]
+            outs = []
+            for v in eqn.outvars:
+                if type(v).__name__ == "DropVar":
+                    outs.append(None)
+                else:
+                    n = ctx.fresh(prim)
+                    ctx.env[v] = n
+                    env[v] = n
+                    outs.append(n)
+            if len(outs) == 1:
+                h(ctx, eqn, ins, outs[0])
+            else:
+                h(ctx, eqn, ins, outs)
+        return [name_of(v) for v in jaxpr.outvars]
+    finally:
+        ctx.env = saved
+        ctx.env.update(env)
+
+
+# --------------------------------------------------------------------------
+# public entry
+# --------------------------------------------------------------------------
+
+def trace_to_onnx(fn, example_args, *, graph_name="mxnet_tpu",
+                  param_args=(), param_names=None, input_names=None,
+                  opset=17):
+    """Trace `fn(*param_args, *example_args)` and translate to a ModelProto.
+
+    `param_args` leaves become graph initializers (weights baked into the
+    model, named by `param_names` when given); `example_args` leaves become
+    graph inputs.
+    """
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*param_args, *example_args)
+    jaxpr = _dce(closed.jaxpr)
+
+    ctx = _Ctx()
+    flat_params, _ = jax.tree_util.tree_flatten(list(param_args))
+    flat_inputs, _ = jax.tree_util.tree_flatten(list(example_args))
+    n_params = len(flat_params)
+
+    invar_names = []
+    graph_inputs = []
+    for i, var in enumerate(jaxpr.invars):
+        if i < n_params:
+            name = (param_names[i] if param_names else f"param_{i}")
+            ctx.initializers[name] = make_tensor(
+                name, np.asarray(flat_params[i]))
+            invar_names.append(name)
+        else:
+            j = i - n_params
+            name = (input_names[j] if input_names else f"input_{j}")
+            graph_inputs.append(make_value_info(
+                name, var.aval.dtype, var.aval.shape))
+            invar_names.append(name)
+        ctx.env[var] = name
+
+    out_names = _translate_jaxpr(ctx, jaxpr, closed.consts, invar_names)
+
+    graph = serde.GraphProto()
+    graph.name = graph_name
+    # an output that is directly an input/initializer needs a node
+    final = []
+    produced = {o for n in ctx.nodes for o in n.output}
+    for i, (name, var) in enumerate(zip(out_names, closed.jaxpr.outvars)):
+        if name not in produced or name in ctx.initializers:
+            name = ctx.node("Identity", [name], out=f"output_{i}")
+        final.append(name)
+    for n in ctx.nodes:
+        graph.node.add().CopyFrom(n)
+    for t in ctx.initializers.values():
+        graph.initializer.add().CopyFrom(t)
+    for vi in graph_inputs:
+        graph.input.add().CopyFrom(vi)
+    for name, var in zip(final, closed.jaxpr.outvars):
+        graph.output.add().CopyFrom(make_value_info(
+            name, var.aval.dtype, var.aval.shape))
+    return serde.make_model(graph, opset=opset)

@@ -1,0 +1,442 @@
+"""A jnp-backed ONNX graph evaluator.
+
+Serves two roles: (1) `mx.onnx.import_model` — run third-party or exported
+ONNX models inside the framework (the reference keeps its importer in
+mx.contrib / onnx2mx, reference: python/mxnet/onnx/mx2onnx/_export_onnx.py
+module docstring notes the paired direction), and (2) the round-trip oracle
+for the exporter's tests: export -> parse -> evaluate -> compare with the
+original TPU forward.
+
+Supports the op subset the exporter emits plus common aliases (Relu,
+Softmax, Gemm) so simple externally-produced models also load.  Evaluation
+is jit-friendly: building `make_fn` returns a pure function of the graph
+inputs that can be wrapped in jax.jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import serde
+from .serde import node_attrs, np_dtype, to_array
+
+_OPS = {}
+
+
+def _op(*names):
+    def deco(fn):
+        for n in names:
+            _OPS[n] = fn
+        return fn
+    return deco
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# elementwise ---------------------------------------------------------------
+
+for _name, _fn in [
+    ("Add", lambda a, b: a + b), ("Sub", lambda a, b: a - b),
+    ("Mul", lambda a, b: a * b), ("Div", lambda a, b: a / b),
+    ("Pow", lambda a, b: a ** b), ("Neg", lambda x: -x),
+    ("Max", lambda *xs: _reduce_variadic("maximum", xs)),
+    ("Min", lambda *xs: _reduce_variadic("minimum", xs)),
+]:
+    _OPS[_name] = (lambda f: (lambda attrs, *ins: f(*ins)))(_fn)
+
+
+def _reduce_variadic(name, xs):
+    jnp = _jnp()
+    out = xs[0]
+    for x in xs[1:]:
+        out = getattr(jnp, name)(out, x)
+    return out
+
+
+def _unary(fname):
+    def impl(attrs, x):
+        jnp = _jnp()
+        return getattr(jnp, fname)(x)
+    return impl
+
+
+for _o, _f in [("Exp", "exp"), ("Log", "log"), ("Tanh", "tanh"),
+               ("Sqrt", "sqrt"), ("Abs", "abs"), ("Sign", "sign"),
+               ("Floor", "floor"), ("Ceil", "ceil"),
+               ("Sin", "sin"), ("Cos", "cos"), ("Atan", "arctan"),
+               ("Asin", "arcsin"), ("Acos", "arccos"),
+               ("Sinh", "sinh"), ("Cosh", "cosh")]:
+    _OPS[_o] = _unary(_f)
+
+
+@_op("Round")
+def _round(attrs, x):
+    return _jnp().round(x)
+
+
+@_op("Reciprocal")
+def _reciprocal(attrs, x):
+    return 1.0 / x
+
+
+@_op("Erf")
+def _erf(attrs, x):
+    import jax
+    return jax.scipy.special.erf(x)
+
+
+@_op("Sigmoid")
+def _sigmoid(attrs, x):
+    import jax
+    return jax.nn.sigmoid(x)
+
+
+@_op("Relu")
+def _relu(attrs, x):
+    return _jnp().maximum(x, 0)
+
+
+@_op("Softmax")
+def _softmax(attrs, x):
+    import jax
+    return jax.nn.softmax(x, axis=attrs.get("axis", -1))
+
+
+@_op("Clip")
+def _clip(attrs, x, lo=None, hi=None):
+    jnp = _jnp()
+    if lo is not None:
+        x = jnp.maximum(x, lo)
+    if hi is not None:
+        x = jnp.minimum(x, hi)
+    return x
+
+
+@_op("Mod")
+def _mod(attrs, a, b):
+    jnp = _jnp()
+    if attrs.get("fmod", 0):
+        return jnp.fmod(a, b)
+    return jnp.mod(a, b)
+
+
+@_op("Identity")
+def _identity(attrs, x):
+    return x
+
+
+@_op("Cast")
+def _cast(attrs, x):
+    return x.astype(np_dtype(attrs["to"]))
+
+
+@_op("Where")
+def _where(attrs, cond, a, b):
+    return _jnp().where(cond, a, b)
+
+
+for _o, _f in [("Equal", "equal"), ("Less", "less"),
+               ("LessOrEqual", "less_equal"), ("Greater", "greater"),
+               ("GreaterOrEqual", "greater_equal"),
+               ("And", "logical_and"), ("Or", "logical_or"),
+               ("Xor", "logical_xor")]:
+    def _mk(f):
+        return lambda attrs, a, b: getattr(_jnp(), f)(a, b)
+    _OPS[_o] = _mk(_f)
+
+
+@_op("Not")
+def _not(attrs, x):
+    return _jnp().logical_not(x)
+
+
+# shape ---------------------------------------------------------------------
+
+@_op("Reshape")
+def _reshape(attrs, x, shape):
+    return _jnp().reshape(x, [int(d) for d in np.asarray(shape)])
+
+
+@_op("Transpose")
+def _transpose(attrs, x):
+    return _jnp().transpose(x, attrs.get("perm"))
+
+
+@_op("Squeeze")
+def _squeeze(attrs, x, axes=None):
+    ax = tuple(int(a) for a in np.asarray(axes)) if axes is not None else None
+    return _jnp().squeeze(x, axis=ax)
+
+
+@_op("Unsqueeze")
+def _unsqueeze(attrs, x, axes):
+    return _jnp().expand_dims(x, tuple(int(a) for a in np.asarray(axes)))
+
+
+@_op("Expand")
+def _expand(attrs, x, shape):
+    jnp = _jnp()
+    target = [int(d) for d in np.asarray(shape)]
+    # ONNX Expand uses numpy broadcasting vs the target shape
+    return jnp.broadcast_to(x, jnp.broadcast_shapes(tuple(target),
+                                                    x.shape))
+
+
+@_op("Concat")
+def _concat(attrs, *xs):
+    return _jnp().concatenate(xs, axis=attrs["axis"])
+
+
+@_op("Slice")
+def _slice(attrs, x, starts, ends, axes=None, steps=None):
+    starts = [int(v) for v in np.asarray(starts)]
+    ends = [int(v) for v in np.asarray(ends)]
+    axes = ([int(v) for v in np.asarray(axes)] if axes is not None
+            else list(range(len(starts))))
+    steps = ([int(v) for v in np.asarray(steps)] if steps is not None
+             else [1] * len(starts))
+    idx = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        # INT64_MIN end with negative step means "through element 0"
+        if sp < 0 and en <= -(2 ** 62):
+            en = None
+        idx[ax] = slice(st, en, sp)
+    return x[tuple(idx)]
+
+
+@_op("Pad")
+def _pad(attrs, x, pads, value=None):
+    jnp = _jnp()
+    pads = [int(v) for v in np.asarray(pads)]
+    rank = x.ndim
+    width = [(pads[i], pads[i + rank]) for i in range(rank)]
+    cv = 0 if value is None else np.asarray(value).item()
+    return jnp.pad(x, width, constant_values=cv)
+
+
+@_op("Range")
+def _range(attrs, start, limit, delta):
+    return _jnp().arange(np.asarray(start).item(), np.asarray(limit).item(),
+                         np.asarray(delta).item())
+
+
+@_op("CumSum")
+def _cumsum(attrs, x, axis):
+    r = _jnp().cumsum(x, axis=int(np.asarray(axis)))
+    if attrs.get("reverse", 0):
+        raise NotImplementedError("CumSum reverse")
+    return r
+
+
+# reductions ----------------------------------------------------------------
+
+def _reduce(fname):
+    def impl(attrs, x, axes=None):
+        jnp = _jnp()
+        # axes arrive as an input (opset 13+ ReduceSum / opset 18+ others)
+        # or as an attribute (older opsets); honor whichever is present
+        if axes is not None:
+            ax = tuple(int(a) for a in np.asarray(axes))
+        else:
+            ax = tuple(attrs["axes"]) if "axes" in attrs else None
+        return getattr(jnp, fname)(x, axis=ax,
+                                   keepdims=bool(attrs.get("keepdims", 1)))
+    return impl
+
+
+_OPS["ReduceSum"] = _reduce("sum")
+_OPS["ReduceMax"] = _reduce("max")
+_OPS["ReduceMin"] = _reduce("min")
+_OPS["ReduceProd"] = _reduce("prod")
+_OPS["ReduceMean"] = _reduce("mean")
+
+
+@_op("ArgMax")
+def _argmax(attrs, x):
+    r = _jnp().argmax(x, axis=attrs.get("axis", 0))
+    if attrs.get("keepdims", 1):
+        r = _jnp().expand_dims(r, attrs.get("axis", 0))
+    return r.astype(np.int64)
+
+
+@_op("ArgMin")
+def _argmin(attrs, x):
+    r = _jnp().argmin(x, axis=attrs.get("axis", 0))
+    if attrs.get("keepdims", 1):
+        r = _jnp().expand_dims(r, attrs.get("axis", 0))
+    return r.astype(np.int64)
+
+
+# matmul / conv / pooling ---------------------------------------------------
+
+@_op("MatMul")
+def _matmul(attrs, a, b):
+    return _jnp().matmul(a, b)
+
+
+@_op("Einsum")
+def _einsum(attrs, *xs):
+    return _jnp().einsum(attrs["equation"], *xs)
+
+
+@_op("Gemm")
+def _gemm(attrs, a, b, c=None):
+    jnp = _jnp()
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    if attrs.get("transA", 0):
+        a = a.T
+    if attrs.get("transB", 0):
+        b = b.T
+    out = alpha * jnp.matmul(a, b)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+@_op("Conv")
+def _conv(attrs, x, w, b=None):
+    import jax
+    jnp = _jnp()
+    nd = x.ndim - 2
+    strides = attrs.get("strides", [1] * nd)
+    dil = attrs.get("dilations", [1] * nd)
+    group = attrs.get("group", 1)
+    pads = attrs.get("pads", [0] * (2 * nd))
+    padding = [(pads[i], pads[i + nd]) for i in range(nd)]
+    if "kernel_shape" in attrs and attrs.get("auto_pad", "NOTSET") != "NOTSET":
+        raise NotImplementedError("Conv auto_pad")
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dil, feature_group_count=group)
+    if b is not None:
+        out = out + jnp.reshape(b, (1, -1) + (1,) * nd)
+    return out
+
+
+def _pool(reducer, init, x, attrs, average=False, count_include_pad=False):
+    import jax
+    kernel = attrs["kernel_shape"]
+    nd = len(kernel)
+    strides = attrs.get("strides", [1] * nd)
+    dil = attrs.get("dilations", [1] * nd)
+    pads = attrs.get("pads", [0] * (2 * nd))
+    padding = [(0, 0), (0, 0)] + [(pads[i], pads[i + nd]) for i in range(nd)]
+    window = (1, 1) + tuple(kernel)
+    stride = (1, 1) + tuple(strides)
+    dilation = (1, 1) + tuple(dil)
+    out = jax.lax.reduce_window(x, init, reducer, window, stride, padding,
+                                window_dilation=dilation)
+    if average:
+        if count_include_pad:
+            out = out / float(np.prod(kernel))
+        else:
+            ones = _jnp().ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        stride, padding,
+                                        window_dilation=dilation)
+            out = out / cnt
+    return out
+
+
+@_op("MaxPool")
+def _maxpool(attrs, x):
+    import jax
+    return _pool(jax.lax.max, -np.inf, x, attrs)
+
+
+@_op("AveragePool")
+def _avgpool(attrs, x):
+    import jax
+    return _pool(jax.lax.add, 0.0, x, attrs, average=True,
+                 count_include_pad=bool(attrs.get("count_include_pad", 0)))
+
+
+@_op("GlobalAveragePool")
+def _gap(attrs, x):
+    return _jnp().mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+# gather --------------------------------------------------------------------
+
+@_op("Gather")
+def _gather(attrs, x, idx):
+    return _jnp().take(x, idx, axis=attrs.get("axis", 0))
+
+
+@_op("GatherElements")
+def _gather_elements(attrs, x, idx):
+    return _jnp().take_along_axis(x, idx, axis=attrs.get("axis", 0))
+
+
+@_op("GatherND")
+def _gather_nd(attrs, x, idx):
+    if attrs.get("batch_dims", 0):
+        raise NotImplementedError("GatherND batch_dims")
+    depth = idx.shape[-1]
+    return x[tuple(_jnp().moveaxis(idx, -1, 0)[i] for i in range(depth))]
+
+
+@_op("Constant")
+def _constant(attrs):
+    if "value" in attrs:
+        return _jnp().asarray(attrs["value"])
+    raise NotImplementedError("Constant without tensor value")
+
+
+@_op("ConstantOfShape")
+def _constant_of_shape(attrs, shape):
+    val = attrs.get("value", np.zeros(1, np.float32))
+    return _jnp().full([int(d) for d in np.asarray(shape)],
+                       np.asarray(val).reshape(()).item(),
+                       dtype=np.asarray(val).dtype)
+
+
+# --------------------------------------------------------------------------
+
+def make_fn(model, weights_override=None):
+    """Build `fn(*inputs) -> list[jnp.ndarray]` from a ModelProto.
+
+    `weights_override` replaces initializer values by name (static —
+    folded into any jit of the returned fn, so shape-position
+    initializers keep working)."""
+    graph = model.graph
+    weights = {t.name: to_array(t) for t in graph.initializer}
+    for k, v in (weights_override or {}).items():
+        if k not in weights:
+            raise KeyError(f"no initializer named {k!r}")
+        weights[k] = np.asarray(v)
+    input_names = [vi.name for vi in graph.input
+                   if vi.name not in weights]
+    output_names = [vi.name for vi in graph.output]
+    nodes = [(n.op_type, list(n.input), list(n.output), node_attrs(n))
+             for n in graph.node]
+    for op_type, *_ in nodes:
+        if op_type not in _OPS:
+            raise NotImplementedError(f"ONNX op {op_type!r} unsupported")
+
+    def fn(*args, **kwargs):
+        jnp = _jnp()
+        # initializers stay as host numpy: shape/axes-position inputs must
+        # be static under jit; tensor-position uses are folded as constants
+        env = dict(weights)
+        bound = dict(zip(input_names, args))
+        bound.update(kwargs)
+        for k in input_names:
+            if k not in bound:
+                raise ValueError(f"missing graph input {k!r}")
+            env[k] = jnp.asarray(bound[k])
+        for op_type, ins, outs, attrs in nodes:
+            vals = [env[i] if i else None for i in ins]
+            res = _OPS[op_type](attrs, *vals)
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            for name, v in zip(outs, res):
+                env[name] = v
+        return [env[o] for o in output_names]
+
+    fn.input_names = input_names
+    fn.output_names = output_names
+    return fn

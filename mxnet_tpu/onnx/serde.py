@@ -1,0 +1,207 @@
+"""ONNX protobuf construction/readback helpers.
+
+Plays the role of the `onnx.helper` / `onnx.numpy_helper` surface the
+reference exporter leans on (reference:
+python/mxnet/onnx/mx2onnx/_export_onnx.py:33-60 builds NodeProto/
+TensorProto/GraphProto through onnx.helper).  Here the schema is compiled
+locally (onnx_mxtpu.proto, wire-compatible with upstream ONNX), so the
+framework has no dependency on the `onnx` package.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import onnx_mxtpu_pb2 as P
+
+TensorProto = P.TensorProto
+ModelProto = P.ModelProto
+GraphProto = P.GraphProto
+NodeProto = P.NodeProto
+AttributeProto = P.AttributeProto
+
+# numpy dtype name <-> TensorProto.DataType (public ONNX enum values).
+_NP2ONNX = {
+    "float32": P.TensorProto.FLOAT,
+    "uint8": P.TensorProto.UINT8,
+    "int8": P.TensorProto.INT8,
+    "uint16": P.TensorProto.UINT16,
+    "int16": P.TensorProto.INT16,
+    "int32": P.TensorProto.INT32,
+    "int64": P.TensorProto.INT64,
+    "bool": P.TensorProto.BOOL,
+    "float16": P.TensorProto.FLOAT16,
+    "float64": P.TensorProto.DOUBLE,
+    "uint32": P.TensorProto.UINT32,
+    "uint64": P.TensorProto.UINT64,
+    "bfloat16": P.TensorProto.BFLOAT16,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def onnx_dtype(np_dtype) -> int:
+    name = np.dtype(np_dtype).name if not isinstance(np_dtype, str) else np_dtype
+    # jax may hand us e.g. ml_dtypes.bfloat16 whose dtype name is 'bfloat16'
+    name = str(name)
+    if name not in _NP2ONNX:
+        raise ValueError(f"dtype {name!r} has no ONNX mapping")
+    return _NP2ONNX[name]
+
+
+def np_dtype(onnx_enum: int):
+    if onnx_enum not in _ONNX2NP:
+        raise ValueError(f"ONNX data_type {onnx_enum} unsupported")
+    name = _ONNX2NP[onnx_enum]
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def make_tensor(name: str, array) -> P.TensorProto:
+    """Serialize an array as a TensorProto with little-endian raw_data."""
+    arr = np.asarray(array)
+    if str(arr.dtype) == "bfloat16":
+        enum = P.TensorProto.BFLOAT16
+    else:
+        enum = onnx_dtype(arr.dtype)
+    t = P.TensorProto()
+    t.name = name
+    t.data_type = enum
+    t.dims.extend(arr.shape)
+    a = arr
+    if a.dtype.byteorder == ">":
+        a = a.byteswap()
+    t.raw_data = np.ascontiguousarray(a).tobytes()
+    return t
+
+
+def to_array(t: P.TensorProto) -> np.ndarray:
+    """TensorProto -> numpy array (raw_data or typed repeated fields)."""
+    dt = np_dtype(t.data_type)
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, np.float32).astype(dt).reshape(shape)
+    if t.int64_data:
+        return np.asarray(t.int64_data, np.int64).astype(dt).reshape(shape)
+    if t.int32_data:
+        # int32_data also carries f16/bf16/bool/int8/16 per the ONNX spec
+        return np.asarray(t.int32_data, np.int32).astype(dt).reshape(shape)
+    if t.double_data:
+        return np.asarray(t.double_data, np.float64).astype(dt).reshape(shape)
+    if t.uint64_data:
+        return np.asarray(t.uint64_data, np.uint64).astype(dt).reshape(shape)
+    return np.zeros(shape, dt)
+
+
+def _set_attr(a: P.AttributeProto, value):
+    if isinstance(value, bool):
+        a.type, a.i = P.AttributeProto.INT, int(value)
+    elif isinstance(value, (int, np.integer)):
+        a.type, a.i = P.AttributeProto.INT, int(value)
+    elif isinstance(value, (float, np.floating)):
+        a.type, a.f = P.AttributeProto.FLOAT, float(value)
+    elif isinstance(value, str):
+        a.type, a.s = P.AttributeProto.STRING, value.encode()
+    elif isinstance(value, bytes):
+        a.type, a.s = P.AttributeProto.STRING, value
+    elif isinstance(value, P.TensorProto):
+        a.type = P.AttributeProto.TENSOR
+        a.t.CopyFrom(value)
+    elif isinstance(value, P.GraphProto):
+        a.type = P.AttributeProto.GRAPH
+        a.g.CopyFrom(value)
+    elif isinstance(value, (list, tuple, np.ndarray)):
+        vals = list(value)
+        if all(isinstance(v, (int, np.integer)) for v in vals):
+            a.type = P.AttributeProto.INTS
+            a.ints.extend(int(v) for v in vals)
+        elif all(isinstance(v, (int, float, np.floating, np.integer))
+                 for v in vals):
+            a.type = P.AttributeProto.FLOATS
+            a.floats.extend(float(v) for v in vals)
+        elif all(isinstance(v, str) for v in vals):
+            a.type = P.AttributeProto.STRINGS
+            a.strings.extend(v.encode() for v in vals)
+        else:
+            raise TypeError(f"attr list {value!r} unsupported")
+    else:
+        raise TypeError(f"attr {value!r} unsupported")
+
+
+def make_node(op_type: str, inputs, outputs, name: str = "", **attrs):
+    n = P.NodeProto()
+    n.op_type = op_type
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    n.name = name or (outputs[0] if outputs else op_type)
+    for k, v in attrs.items():
+        if v is None:
+            continue
+        a = n.attribute.add()
+        a.name = k
+        _set_attr(a, v)
+    return n
+
+
+def attr_value(a: P.AttributeProto):
+    T = P.AttributeProto
+    if a.type == T.INT:
+        return a.i
+    if a.type == T.FLOAT:
+        return a.f
+    if a.type == T.STRING:
+        return a.s.decode()
+    if a.type == T.INTS:
+        return list(a.ints)
+    if a.type == T.FLOATS:
+        return list(a.floats)
+    if a.type == T.STRINGS:
+        return [s.decode() for s in a.strings]
+    if a.type == T.TENSOR:
+        return to_array(a.t)
+    if a.type == T.GRAPH:
+        return a.g
+    raise ValueError(f"attribute type {a.type} unsupported")
+
+
+def node_attrs(node: P.NodeProto) -> dict:
+    return {a.name: attr_value(a) for a in node.attribute}
+
+
+def make_value_info(name: str, dtype, shape) -> P.ValueInfoProto:
+    vi = P.ValueInfoProto()
+    vi.name = name
+    vi.type.tensor_type.elem_type = onnx_dtype(dtype)
+    sh = vi.type.tensor_type.shape
+    for d in shape:
+        dim = sh.dim.add()
+        if isinstance(d, str):
+            dim.dim_param = d
+        else:
+            dim.dim_value = int(d)
+    return vi
+
+
+def make_model(graph: P.GraphProto, opset: int = 17,
+               producer: str = "mxnet_tpu") -> P.ModelProto:
+    m = P.ModelProto()
+    m.ir_version = 8
+    m.producer_name = producer
+    m.graph.CopyFrom(graph)
+    m.opset_import.add(domain="", version=opset)
+    return m
+
+
+def save_model(model: P.ModelProto, path: str) -> str:
+    with open(path, "wb") as f:
+        f.write(model.SerializeToString())
+    return path
+
+
+def load_model(path: str) -> P.ModelProto:
+    m = P.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    return m

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Regenerate mxnet_tpu/onnx/onnx_mxtpu_pb2.py from the schema.
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=mxnet_tpu/onnx -I mxnet_tpu/onnx mxnet_tpu/onnx/onnx_mxtpu.proto
+echo "wrote mxnet_tpu/onnx/onnx_mxtpu_pb2.py"
