@@ -55,6 +55,7 @@ from . import symbol as sym
 from . import recordio
 from . import io
 from . import image
+from . import contrib
 try:
     from . import onnx
 except ImportError:  # protobuf missing: degrade the feature, not the package

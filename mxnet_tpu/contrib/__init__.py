@@ -1,0 +1,8 @@
+"""mx.contrib — experimental / auxiliary drivers.
+
+Reference parity: python/mxnet/contrib/ (quantization.py calibration
+driver, tensorboard.py logging bridge, plus onnx/tensorrt drivers whose
+roles live in mx.onnx and the XLA pipeline here).
+"""
+from . import quantization
+from . import tensorboard

@@ -1,0 +1,336 @@
+"""INT8 post-training quantization driver.
+
+Reference parity: python/mxnet/contrib/quantization.py — `quantize_net`
+(graph rewrite + calibration), `_LayerOutputMinMaxCollector` ('naive'
+mode) and `_LayerHistogramCollector` + `_get_optimal_threshold` (KL /
+'entropy' mode, the algorithm of src/operator/quantization/calibrate.cc).
+
+TPU-native design: instead of an NNVM graph-rewrite pass producing
+`quantized_conv`/`quantized_fully_connected` symbol nodes, target Gluon
+layers are replaced by Quantized blocks whose forwards call the
+npx.quantized_* ops (int8 MXU matmul with int32 accumulation, see
+mxnet_tpu/ops/quantization.py).  The reference's requantize-fusion passes
+are unnecessary: XLA fuses the scale arithmetic around the matmuls.
+
+    qnet = quantize_net(net, calib_data=batches, calib_mode='entropy')
+    y = qnet(x)          # conv/dense run int8 on the MXU
+"""
+from __future__ import annotations
+
+import copy
+import logging
+
+import numpy as onp
+
+from .. import numpy_extension as npx
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Constant
+from ..numpy.multiarray import ndarray
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv",
+           "optimal_threshold"]
+
+_INT8_MAX = 127.0
+
+
+# --------------------------------------------------------------------------
+# calibration collectors
+# --------------------------------------------------------------------------
+
+class _Stats:
+    """Per-layer input statistics: abs-max always; histogram for
+    entropy/percentile modes (reference: _LayerHistogramCollector)."""
+
+    def __init__(self, num_bins=2048):
+        self.num_bins = num_bins
+        self.abs_max = 0.0
+        self.hist = None
+        self.hist_edges = None
+
+    def update(self, arr: onp.ndarray, want_hist: bool):
+        a = onp.abs(arr.astype(onp.float32)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        if m > self.abs_max:
+            old_max = self.abs_max
+            self.abs_max = m
+            if self.hist is not None:
+                # re-bin the existing histogram into the wider range
+                old_centers = 0.5 * (self.hist_edges[:-1]
+                                     + self.hist_edges[1:])
+                new_hist, new_edges = onp.histogram(
+                    old_centers, bins=self.num_bins, range=(0, m),
+                    weights=self.hist)
+                self.hist, self.hist_edges = new_hist, new_edges
+        if want_hist:
+            h, edges = onp.histogram(a, bins=self.num_bins,
+                                     range=(0, self.abs_max or 1e-8))
+            if self.hist is None:
+                self.hist, self.hist_edges = h.astype(onp.float64), edges
+            else:
+                self.hist += h
+
+
+def _smooth_distribution(p, eps=0.0001):
+    """Move a little mass onto zero entries so KL is finite (reference:
+    contrib/quantization.py _smooth_distribution)."""
+    is_zeros = (p == 0).astype(onp.float64)
+    is_nonzeros = (p != 0).astype(onp.float64)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        raise ValueError("all-zero distribution")
+    eps1 = eps * float(n_zeros) / float(n_nonzeros)
+    return p.astype(onp.float64) + eps * is_zeros - eps1 * is_nonzeros
+
+
+def _kl(p, q):
+    p = p / p.sum()
+    q = q / q.sum()
+    mask = p > 0
+    return float((p[mask] * onp.log(p[mask] / q[mask])).sum())
+
+
+def optimal_threshold(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence-minimizing threshold (reference:
+    contrib/quantization.py _get_optimal_threshold /
+    src/operator/quantization/calibrate.cc).
+
+    `hist` is a histogram of |x| over [0, max].  For each candidate i the
+    first i bins are taken as the reference distribution P (outlier mass
+    clipped into the last bin) and Q is P merged down to
+    num_quantized_bins levels and re-expanded; the i minimizing KL(P||Q)
+    gives the threshold.
+    """
+    hist = onp.asarray(hist, onp.float64)
+    n = len(hist)
+    if hist.sum() == 0:
+        return float(hist_edges[-1])
+    best_kl, best_i = onp.inf, n
+    for i in range(num_quantized_bins, n + 1):
+        sliced = hist[:i]
+        p = sliced.copy()
+        p[i - 1] += hist[i:].sum()           # clip outliers into last bin
+        is_nonzero = sliced != 0
+        num_merged = i // num_quantized_bins
+        q = onp.zeros(i, onp.float64)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = i if j == num_quantized_bins - 1 \
+                else (j + 1) * num_merged
+            norm = is_nonzero[start:stop].sum()
+            if norm:
+                q[start:stop] = sliced[start:stop].sum() / norm
+        q[~is_nonzero] = 0
+        try:
+            p = _smooth_distribution(p)
+            q = _smooth_distribution(q)
+        except ValueError:
+            continue
+        kl = _kl(p, q)
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return float(hist_edges[best_i])
+
+
+def _percentile_threshold(hist, hist_edges, percentile=99.99):
+    c = onp.cumsum(hist)
+    if c[-1] == 0:
+        return float(hist_edges[-1])
+    idx = onp.searchsorted(c, c[-1] * percentile / 100.0)
+    return float(hist_edges[min(idx + 1, len(hist_edges) - 1)])
+
+
+# --------------------------------------------------------------------------
+# quantized layer blocks
+# --------------------------------------------------------------------------
+
+def _quantize_weight(w: onp.ndarray):
+    """Symmetric per-output-channel int8 (axis 0 = output channels)."""
+    flat = onp.abs(w.reshape(w.shape[0], -1)).max(axis=1)
+    scale = onp.maximum(flat, 1e-12) / _INT8_MAX
+    q = onp.clip(onp.round(w / scale.reshape((-1,) + (1,) * (w.ndim - 1))),
+                 -_INT8_MAX, _INT8_MAX).astype(onp.int8)
+    return q, scale.astype(onp.float32)
+
+
+class QuantizedDense(HybridBlock):
+    """int8 replacement for nn.Dense (reference:
+    quantized_fully_connected.cc as rewritten by quantize_net)."""
+
+    def __init__(self, dense: nn.Dense, threshold: float):
+        super().__init__()
+        w = dense.weight.data().asnumpy()
+        q, scale = _quantize_weight(w)
+        self.qweight = Constant(q, name="qweight")
+        self.w_scale = Constant(scale, name="w_scale")
+        self.bias_c = (Constant(dense.bias.data().asnumpy(), name="bias")
+                       if dense.bias is not None else None)
+        self.threshold = float(threshold)
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self.act = dense.act
+
+    def forward(self, x):
+        xq, mn, mx = npx.quantize_v2(x, -self.threshold, self.threshold)
+        out = npx.quantized_fully_connected(
+            xq, self.qweight.data(), self.threshold / _INT8_MAX,
+            self.w_scale.data(),
+            bias=self.bias_c.data() if self.bias_c is not None else None,
+            flatten=self._flatten)
+        return self.act(out) if self.act is not None else out
+
+    def __repr__(self):
+        return f"QuantizedDense({self._units}, T={self.threshold:.4g})"
+
+
+class QuantizedConv(HybridBlock):
+    """int8 replacement for nn.Conv (reference: quantized_conv.cc)."""
+
+    def __init__(self, conv, threshold: float):
+        super().__init__()
+        if conv._op_name != "convolution":
+            raise MXNetError("only forward convolutions quantize")
+        w = conv.weight.data().asnumpy()
+        q, scale = _quantize_weight(w)
+        self.qweight = Constant(q, name="qweight")
+        self.w_scale = Constant(scale, name="w_scale")
+        self.bias_c = (Constant(conv.bias.data().asnumpy(), name="bias")
+                       if conv.bias is not None else None)
+        self.threshold = float(threshold)
+        self._conv_cfg = dict(kernel=conv._kernel, stride=conv._strides,
+                              dilate=conv._dilation, pad=conv._padding,
+                              num_filter=conv._channels,
+                              num_group=conv._groups, layout=conv._layout)
+        self.act = conv.act
+
+    def forward(self, x):
+        xq, mn, mx = npx.quantize_v2(x, -self.threshold, self.threshold)
+        out = npx.quantized_conv(
+            xq, self.qweight.data(), self.threshold / _INT8_MAX,
+            self.w_scale.data(),
+            bias=self.bias_c.data() if self.bias_c is not None else None,
+            **self._conv_cfg)
+        return self.act(out) if self.act is not None else out
+
+    def __repr__(self):
+        cfg = self._conv_cfg
+        return (f"QuantizedConv({cfg['num_filter']}, "
+                f"kernel={cfg['kernel']}, T={self.threshold:.4g})")
+
+
+# --------------------------------------------------------------------------
+# quantize_net
+# --------------------------------------------------------------------------
+
+def _walk_layers(block, prefix=""):
+    """Yield (parent, child_key, structural_path, layer)."""
+    for key, child in list(block._children.items()):
+        path = f"{prefix}{key}"
+        yield block, key, path, child
+        yield from _walk_layers(child, path + ".")
+
+
+def _is_target(layer):
+    return isinstance(layer, nn.Dense) or (
+        isinstance(layer, nn.conv_layers._Conv)
+        and layer._op_name == "convolution")
+
+
+def _first_array(batch):
+    if isinstance(batch, (list, tuple)):
+        return batch[0]
+    return batch
+
+
+def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
+                 exclude_layers_match=None, calib_data=None,
+                 calib_mode="naive", num_calib_batches=None, logger=None):
+    """Quantize a Gluon network's Dense/Conv layers to int8.
+
+    Mirrors the reference `mx.contrib.quantization.quantize_net`
+    (python/mxnet/contrib/quantization.py): calibrates activation ranges
+    over `calib_data` (an iterable of input batches or (data, ...) tuples)
+    with `calib_mode` in {'naive', 'entropy', 'percentile'}, then returns
+    a **new** network (deep copy) whose targeted layers are replaced by
+    QuantizedDense/QuantizedConv.  The original network is untouched.
+    """
+    if quantized_dtype != "int8":
+        raise NotImplementedError("TPU path supports int8 only")
+    if calib_mode not in ("naive", "entropy", "percentile"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    if calib_data is None:
+        raise MXNetError("calib_data is required (post-training "
+                         "quantization calibrates activation ranges)")
+    log = logger or logging.getLogger(__name__)
+    exclude_layers = set(exclude_layers or [])
+
+    targets = {}
+    for parent, key, path, layer in _walk_layers(network):
+        if not _is_target(layer):
+            continue
+        if path in exclude_layers:
+            continue
+        if exclude_layers_match and any(m in path
+                                        for m in exclude_layers_match):
+            continue
+        targets[path] = layer
+
+    # -- calibration pass (eager, hooks collect layer-input stats) --------
+    want_hist = calib_mode in ("entropy", "percentile")
+    stats = {path: _Stats() for path in targets}
+    hooks = []
+    for path, layer in targets.items():
+        def mk(path):
+            def hook(block, args):
+                import jax
+                x = args[0]
+                raw = x._data if isinstance(x, ndarray) else x
+                if isinstance(raw, jax.core.Tracer):
+                    return  # hybridized trace pass: no concrete values
+                stats[path].update(onp.asarray(raw), want_hist)
+            return hook
+        h = mk(path)
+        layer.register_forward_pre_hook(h)
+        hooks.append((layer, h))
+    try:
+        for i, batch in enumerate(calib_data):
+            if num_calib_batches is not None and i >= num_calib_batches:
+                break
+            network(_first_array(batch))
+    finally:
+        for layer, h in hooks:
+            layer._forward_pre_hooks.remove(h)
+
+    thresholds = {}
+    for path, st in stats.items():
+        if st.abs_max == 0.0:
+            log.warning("layer %s saw no calibration data; skipping", path)
+            continue
+        if calib_mode == "naive":
+            thresholds[path] = st.abs_max
+        elif calib_mode == "entropy":
+            thresholds[path] = optimal_threshold(st.hist, st.hist_edges)
+        else:
+            thresholds[path] = _percentile_threshold(st.hist, st.hist_edges)
+        log.debug("calibrated %s: T=%.5g (absmax %.5g)", path,
+                  thresholds[path], st.abs_max)
+
+    # -- rewrite on a deep copy -------------------------------------------
+    qnet = copy.deepcopy(network)
+    replaced = 0
+    for parent, key, path, layer in list(_walk_layers(qnet)):
+        if path not in thresholds or not _is_target(layer):
+            continue
+        wrapper_cls = QuantizedDense if isinstance(layer, nn.Dense) \
+            else QuantizedConv
+        q = wrapper_cls(layer, thresholds[path])
+        q.initialize()
+        parent._children[key] = q
+        for attr, val in list(parent.__dict__.items()):
+            if val is layer:
+                object.__setattr__(parent, attr, q)
+        replaced += 1
+    log.info("quantized %d/%d target layers", replaced, len(targets))
+    return qnet

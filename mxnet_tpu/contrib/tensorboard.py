@@ -1,0 +1,45 @@
+"""TensorBoard logging bridge.
+
+Reference parity: python/mxnet/contrib/tensorboard.py — a thin
+LogMetricsCallback that forwards `mx.gluon.metric` values to a
+SummaryWriter.  Like the reference, the tensorboard package is imported
+lazily and a clear error is raised when it is not installed.
+"""
+from __future__ import annotations
+
+
+class LogMetricsCallback:
+    """Log metric values each time the callback fires.
+
+    Works as an epoch/batch-end callback: accepts either an object with
+    ``.eval_metric`` (estimator-style) or an EvalMetric directly via
+    ``__call__(metric)``.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        try:
+            from tensorboard.summary import Writer  # type: ignore
+            self.summary_writer = Writer(logging_dir)
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.summary_writer = SummaryWriter(logging_dir)
+            except ImportError as e:
+                raise ImportError(
+                    "LogMetricsCallback requires a tensorboard writer "
+                    "(pip install tensorboard, or torch with tensorboard "
+                    "support)") from e
+        self.step = 0
+
+    def __call__(self, param):
+        metric = getattr(param, "eval_metric", param)
+        if metric is None:
+            return
+        name_value = metric.get_name_value() \
+            if hasattr(metric, "get_name_value") else [metric]
+        self.step += 1
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -992,6 +992,10 @@ def constraint_check(data, msg="Constraint violated!"):
     return out
 
 
+from ..ops.quantization import (  # noqa: E402
+    quantize_v2, dequantize, quantized_fully_connected, quantized_conv)
+
+
 def nonzero(data):
     """Reference: _npx_nonzero — returns (N, ndim) int64 indices (unlike
     np.nonzero's tuple). Eager-only (data-dependent shape)."""

@@ -55,7 +55,6 @@ def export_model(net, path, args=None, input_names=None, opset=17,
         ex = tuple(_raw(a) for a in (args if isinstance(args, (tuple, list))
                                      else (args,)))
         params = functional.param_arrays(net)
-        names = list(params)
 
         def fwd(params, *inputs):
             out, _ = functional.functional_call(net, params, *inputs,
@@ -63,8 +62,7 @@ def export_model(net, path, args=None, input_names=None, opset=17,
             return out
 
         model = trace_to_onnx(
-            fwd, ex, param_args=(params,), param_names=names,
-            input_names=input_names,
+            fwd, ex, param_args=(params,), input_names=input_names,
             graph_name=graph_name or type(net).__name__, opset=opset)
     elif hasattr(net, "_eval_with"):  # mx.sym.Symbol
         if not isinstance(args, dict):
@@ -126,12 +124,15 @@ class ONNXBlock:
 
     def __call__(self, *args):
         import jax
-        snapshot = tuple(id(v) for v in self.params.values())
-        if self._jitted is None or snapshot != self._params_snapshot:
+        # snapshot holds references, so object identity can't be recycled
+        stale = (self._params_snapshot is None
+                 or any(self._params_snapshot.get(k) is not v
+                        for k, v in self.params.items()))
+        if self._jitted is None or stale:
             override = {k: onp.asarray(_raw(v))
                         for k, v in self.params.items()}
             self._jitted = jax.jit(make_fn(self.model, override))
-            self._params_snapshot = snapshot
+            self._params_snapshot = dict(self.params)
         outs = self._jitted(*[_raw(a) for a in args])
         outs = [_wrap(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs

@@ -312,16 +312,26 @@ def _pad(ctx, eqn, ins, out):
 
 @_reg("iota")
 def _iota(ctx, eqn, ins, out):
+    # Range (+ Reshape/Expand) instead of a baked constant: a broadcast
+    # iota over a large shape must not bloat the exported file
     p = eqn.params
-    arr = np.reshape(
-        np.broadcast_to(
-            np.arange(p["shape"][p["dimension"]], dtype=p["dtype"]).reshape(
-                [-1 if i == p["dimension"] else 1
-                 for i in range(len(p["shape"]))]),
-            p["shape"]),
-        p["shape"])
-    name = ctx.const(arr)
-    ctx.node("Identity", [name], out=out)
+    shape = tuple(p["shape"])
+    dim = p["dimension"]
+    dt = np.dtype(p["dtype"])
+    # Range supports numeric dtypes; generate in the target dtype when it
+    # is float/int, else in int64 then Cast
+    gen_dt = dt if dt.kind in "ifu" and dt.itemsize >= 4 else np.int64
+    r = ctx.node("Range", [ctx.const(np.asarray(0, gen_dt)),
+                           ctx.const(np.asarray(shape[dim], gen_dt)),
+                           ctx.const(np.asarray(1, gen_dt))])
+    if gen_dt != dt:
+        r = ctx.node("Cast", [r], to=onnx_dtype(dt))
+    if len(shape) > 1:
+        mid = [1] * len(shape)
+        mid[dim] = shape[dim]
+        r = ctx.node("Reshape", [r, ctx.i64(mid)])
+        r = ctx.node("Expand", [r, ctx.i64(shape)])
+    ctx.node("Identity", [r], out=out)
 
 
 @_reg("cumsum")
@@ -637,14 +647,30 @@ def _translate_jaxpr(ctx, jaxpr, consts, invar_names):
 # public entry
 # --------------------------------------------------------------------------
 
+def _leaf_names(tree, fallback_prefix):
+    """Flatten-order names for pytree leaves, from their key paths
+    (dict keys / field names), so names always align with tree_flatten
+    order — which for dicts is *sorted* key order, not insertion order."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for i, (path, _leaf) in enumerate(flat):
+        if path and hasattr(path[-1], "key"):
+            names.append(str(path[-1].key))
+        elif path:
+            names.append(jax.tree_util.keystr(path).strip("[]'\""))
+        else:
+            names.append(f"{fallback_prefix}_{i}")
+    return names
+
+
 def trace_to_onnx(fn, example_args, *, graph_name="mxnet_tpu",
-                  param_args=(), param_names=None, input_names=None,
-                  opset=17):
+                  param_args=(), input_names=None, opset=17):
     """Trace `fn(*param_args, *example_args)` and translate to a ModelProto.
 
     `param_args` leaves become graph initializers (weights baked into the
-    model, named by `param_names` when given); `example_args` leaves become
-    graph inputs.
+    model, named by their pytree key paths — e.g. dict keys);
+    `example_args` leaves become graph inputs.
     """
     import jax
 
@@ -654,13 +680,14 @@ def trace_to_onnx(fn, example_args, *, graph_name="mxnet_tpu",
     ctx = _Ctx()
     flat_params, _ = jax.tree_util.tree_flatten(list(param_args))
     flat_inputs, _ = jax.tree_util.tree_flatten(list(example_args))
+    param_names = _leaf_names(list(param_args), "param")
     n_params = len(flat_params)
 
     invar_names = []
     graph_inputs = []
     for i, var in enumerate(jaxpr.invars):
         if i < n_params:
-            name = (param_names[i] if param_names else f"param_{i}")
+            name = param_names[i]
             ctx.initializers[name] = make_tensor(
                 name, np.asarray(flat_params[i]))
             invar_names.append(name)

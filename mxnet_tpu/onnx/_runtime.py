@@ -306,8 +306,8 @@ def _conv(attrs, x, w, b=None):
     group = attrs.get("group", 1)
     pads = attrs.get("pads", [0] * (2 * nd))
     padding = [(pads[i], pads[i + nd]) for i in range(nd)]
-    if "kernel_shape" in attrs and attrs.get("auto_pad", "NOTSET") != "NOTSET":
-        raise NotImplementedError("Conv auto_pad")
+    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", "VALID"):
+        raise NotImplementedError("Conv auto_pad=SAME_*")
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dil, feature_group_count=group)
@@ -318,6 +318,10 @@ def _conv(attrs, x, w, b=None):
 
 def _pool(reducer, init, x, attrs, average=False, count_include_pad=False):
     import jax
+    if attrs.get("ceil_mode", 0):
+        raise NotImplementedError("pooling ceil_mode=1")
+    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", "VALID"):
+        raise NotImplementedError("pooling auto_pad=SAME_*")
     kernel = attrs["kernel_shape"]
     nd = len(kernel)
     strides = attrs.get("strides", [1] * nd)
@@ -344,7 +348,9 @@ def _pool(reducer, init, x, attrs, average=False, count_include_pad=False):
 @_op("MaxPool")
 def _maxpool(attrs, x):
     import jax
-    return _pool(jax.lax.max, -np.inf, x, attrs)
+    init = (-np.inf if np.issubdtype(x.dtype, np.floating)
+            else np.iinfo(x.dtype).min)
+    return _pool(jax.lax.max, init, x, attrs)
 
 
 @_op("AveragePool")

@@ -86,8 +86,12 @@ def to_array(t: P.TensorProto) -> np.ndarray:
     if t.int64_data:
         return np.asarray(t.int64_data, np.int64).astype(dt).reshape(shape)
     if t.int32_data:
-        # int32_data also carries f16/bf16/bool/int8/16 per the ONNX spec
-        return np.asarray(t.int32_data, np.int32).astype(dt).reshape(shape)
+        # int32_data also carries f16/bf16/bool/int8/16 per the ONNX spec;
+        # f16/bf16 are stored as raw 16-bit patterns, not values
+        raw32 = np.asarray(t.int32_data, np.int32)
+        if t.data_type in (P.TensorProto.FLOAT16, P.TensorProto.BFLOAT16):
+            return raw32.astype(np.uint16).view(dt).reshape(shape)
+        return raw32.astype(dt).reshape(shape)
     if t.double_data:
         return np.asarray(t.double_data, np.float64).astype(dt).reshape(shape)
     if t.uint64_data:

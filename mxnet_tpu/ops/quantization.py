@@ -1,0 +1,133 @@
+"""INT8 quantization ops.
+
+Reference parity: src/operator/quantization/ (quantize_v2-inl.h,
+dequantize-inl.h, quantized_fully_connected.cc, quantized_conv.cc, ~7.1k
+LoC of CPU/GPU kernels).  TPU-native design: int8 tensors feed
+``lax.dot_general`` / ``lax.conv_general_dilated`` with
+``preferred_element_type=int32`` — XLA lowers these to the MXU's native
+int8 matmul path — and the scale/zero-point arithmetic is plain jnp that
+XLA fuses around the matmul.  The reference's `requantize` op and its
+quantize/dequantize-elimination graph passes are subsumed by XLA fusion:
+we always dequantize to fp32 after accumulation and let the compiler fuse
+adjacent quantize(dequantize(x)) chains.
+
+Quantization scheme: symmetric int8 (zero-point 0), per-tensor for
+activations (calibrated range), per-output-channel for weights — the
+scheme the reference uses for its int8 conv/FC path with
+``MXNET_QUANTIZATION_*`` defaults.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..numpy.multiarray import _invoke
+
+__all__ = ["quantize_v2", "dequantize", "quantized_fully_connected",
+           "quantized_conv"]
+
+_INT8_MAX = 127.0
+
+
+def _scale_from_range(min_range, max_range):
+    return jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / _INT8_MAX
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """float32 -> (int8, min_range, max_range).
+
+    Reference: src/operator/quantization/quantize_v2-inl.h — when calib
+    ranges are given they are used directly; otherwise the runtime min/max
+    of `data` is used.  Symmetric: zero maps to zero.
+    """
+    if out_type != "int8":
+        raise NotImplementedError("TPU path quantizes to int8 only")
+
+    def fn(x):
+        if min_calib_range is None or max_calib_range is None:
+            mx_ = jnp.max(jnp.abs(x))
+            mn, mx = -mx_, mx_
+        else:
+            mn = jnp.asarray(min_calib_range, jnp.float32)
+            mx = jnp.asarray(max_calib_range, jnp.float32)
+        scale = _scale_from_range(mn, mx)
+        q = jnp.clip(jnp.round(x / scale), -_INT8_MAX, _INT8_MAX)
+        return q.astype(jnp.int8), mn, mx
+
+    return _invoke(fn, (data,), name="quantize_v2")
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8 -> float32 (reference: dequantize-inl.h)."""
+    def fn(q, mn, mx):
+        return q.astype(jnp.float32) * _scale_from_range(mn, mx)
+    return _invoke(fn, (data, min_range, max_range), name="dequantize")
+
+
+def quantized_fully_connected(data, weight, x_scale, w_scale, bias=None,
+                              flatten=True):
+    """int8 x int8 -> fp32 dense layer.
+
+    Reference: src/operator/quantization/quantized_fully_connected.cc.
+    TPU-native signature: instead of the reference's 9-input
+    (min/max per operand) form, scales are passed directly —
+    ``x_scale`` scalar, ``w_scale`` per-output-channel (units,) — and the
+    output is dequantized fp32 (accumulation in int32 on the MXU).
+    """
+    def fn(x, w, xs, ws, *rest):
+        b = rest[0] if rest else None
+        h = x.reshape(x.shape[0], -1) if flatten else x
+        acc = lax.dot_general(h, w, (((h.ndim - 1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (jnp.asarray(xs, jnp.float32) * ws)
+        if b is not None:
+            out = out + b
+        return out
+
+    args = (data, weight, x_scale, w_scale)
+    if bias is not None:
+        args += (bias,)
+    return _invoke(fn, args, name="quantized_fully_connected")
+
+
+def quantized_conv(data, weight, x_scale, w_scale, bias=None, kernel=None,
+                   stride=None, dilate=None, pad=None, num_filter=1,
+                   num_group=1, layout="NCHW"):
+    """int8 x int8 -> fp32 convolution.
+
+    Reference: src/operator/quantization/quantized_conv.cc (cuDNN int8
+    path, NHWC-only there; here any layout the fp conv supports).
+    Accumulates int32 on the MXU, dequantizes with per-channel w_scale.
+    """
+    nd = data.ndim - 2
+    spatial = "DHW"[3 - nd:]
+    lhs_spec = layout
+    rhs_spec = "OI" + spatial
+    out_spec = layout
+    strides = tuple(stride or (1,) * nd)
+    dilation = tuple(dilate or (1,) * nd)
+    padding = tuple((p, p) for p in (pad or (0,) * nd))
+    c_axis = layout.index("C")
+
+    def fn(x, w, xs, ws, *rest):
+        b = rest[0] if rest else None
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (lhs_spec, rhs_spec, out_spec))
+        acc = lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=num_group,
+            preferred_element_type=jnp.int32)
+        shape = [1] * acc.ndim
+        shape[c_axis] = -1
+        sc = jnp.asarray(xs, jnp.float32) * jnp.reshape(ws, shape)
+        out = acc.astype(jnp.float32) * sc
+        if b is not None:
+            out = out + jnp.reshape(b, shape)
+        return out
+
+    args = (data, weight, x_scale, w_scale)
+    if bias is not None:
+        args += (bias,)
+    return _invoke(fn, args, name="quantized_conv")

@@ -161,9 +161,13 @@ def test_exported_file_structure():
         m = serde.load_model(p)
     assert m.ir_version == 8
     assert m.opset_import[0].version == 17
-    names = {t.name for t in m.graph.initializer}
-    assert any("weight" in n for n in names), names
-    assert any("bias" in n for n in names), names
+    inits = {t.name: tuple(t.dims) for t in m.graph.initializer}
+    # names must be associated with the right values (tree_flatten of a
+    # dict is sorted-key order — regression: weight/bias were swapped)
+    wname = [n for n in inits if "weight" in n]
+    bname = [n for n in inits if "bias" in n]
+    assert wname and inits[wname[0]] == (4, 3), inits
+    assert bname and inits[bname[0]] == (4,), inits
     assert len(m.graph.input) == 1
     vi = m.graph.input[0]
     dims = [dd.dim_value for dd in vi.type.tensor_type.shape.dim]
@@ -218,6 +222,26 @@ def test_export_dynamic_slice_oob_clamp():
         mx.onnx.export_model(fn, p, args=(x, i))
         got = mx.onnx.run_model(p, [x, i])[0].asnumpy()
     onp.testing.assert_allclose(got, want)
+
+
+def test_export_iota_emits_range_not_constant():
+    """A large broadcast iota must not be baked as a dense initializer."""
+    import tempfile, os
+    import jax.numpy as jnp
+    from mxnet_tpu.onnx import serde
+
+    def fn(x):
+        pos = jnp.arange(x.shape[-1], dtype=jnp.float32)
+        return x + jnp.broadcast_to(pos, x.shape)
+
+    x = onp.zeros((8, 512), "float32")
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "f.onnx")
+        mx.onnx.export_model(fn, p, args=(x,))
+        assert os.path.getsize(p) < 4096, os.path.getsize(p)
+        got = mx.onnx.run_model(p, [x])[0].asnumpy()
+    onp.testing.assert_allclose(got, onp.broadcast_to(
+        onp.arange(512, dtype="float32"), (8, 512)))
 
 
 def test_runtime_reduce_axes_as_input():
