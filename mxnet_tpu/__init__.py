@@ -27,6 +27,7 @@ _ensure_distributed()
 
 from . import base
 from .base import MXNetError
+from . import config
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, device, num_gpus, num_tpus
 from . import engine
@@ -69,6 +70,9 @@ except ImportError:  # protobuf missing: degrade the feature, not the package
     onnx = _OnnxUnavailable("mxnet_tpu.onnx")
 
 kv = kvstore
+
+if config.get("profiler.autostart"):
+    profiler.set_state("run")
 
 
 def waitall():

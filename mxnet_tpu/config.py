@@ -1,0 +1,224 @@
+"""mx.config — unified typed configuration.
+
+Reference parity: the reference configures itself through three mechanisms
+(SURVEY §5 "Config / flag system"):
+
+1. ~72 environment variables read ad hoc via ``dmlc::GetEnv`` at use sites
+   (docs/static_site/src/pages/api/faq/env_var.md:43-238);
+2. ``dmlc::Parameter`` reflection structs declaring typed fields with
+   defaults, ranges and docs (pattern: src/imperative/cached_op.h:412-459
+   ``CachedOpConfig``);
+3. cmake feature flags surfaced at runtime via libinfo
+   (``mx.runtime.feature_list()`` — kept in runtime.py).
+
+This module unifies (1)+(2): every knob is declared once with type,
+default, doc and an env-var override; values are introspectable
+(``mx.config.describe()``) and settable at runtime (``mx.config.set``).
+``Params`` is the ``dmlc::Parameter`` analog for op/block config structs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .base import MXNetError
+
+__all__ = ["declare", "get", "set", "reset", "describe", "knobs",
+           "Field", "Params"]
+
+_lock = threading.Lock()
+_registry: dict[str, "_Knob"] = {}
+
+
+class _Knob:
+    __slots__ = ("name", "typ", "default", "env", "doc", "_value", "_set")
+
+    def __init__(self, name, typ, default, env, doc):
+        self.name = name
+        self.typ = typ
+        self.default = default
+        self.env = env
+        self.doc = doc
+        self._value = None
+        self._set = False
+
+    def _coerce(self, val):
+        if self.typ is bool and isinstance(val, str):
+            return val not in ("0", "false", "False", "")
+        return self.typ(val)
+
+    def value(self):
+        if self._set:
+            return self._value
+        if self.env:
+            raw = os.environ.get(self.env)
+            if raw is not None:
+                return self._coerce(raw)
+        return self.default
+
+
+def declare(name, typ=str, default=None, env=None, doc=""):
+    """Register a configuration knob (once, at module import)."""
+    with _lock:
+        if name in _registry:
+            return _registry[name]
+        knob = _Knob(name, typ, default, env, doc)
+        _registry[name] = knob
+        return knob
+
+
+def get(name):
+    knob = _registry.get(name)
+    if knob is None:
+        raise MXNetError(f"unknown config knob {name!r}; see "
+                         "mx.config.describe()")
+    return knob.value()
+
+
+def set(name, value):  # noqa: A001 - mirrors the reference's setter name
+    knob = _registry.get(name)
+    if knob is None:
+        raise MXNetError(f"unknown config knob {name!r}")
+    with _lock:
+        prev = knob.value()
+        knob._value = knob._coerce(value)
+        knob._set = True
+    return prev
+
+
+def reset(name=None):
+    """Drop runtime overrides (env/defaults apply again)."""
+    if name is not None and name not in _registry:
+        raise MXNetError(f"unknown config knob {name!r}; see "
+                         "mx.config.describe()")
+    with _lock:
+        for knob in ([_registry[name]] if name else _registry.values()):
+            knob._set = False
+            knob._value = None
+
+
+def knobs():
+    return dict(_registry)
+
+
+def describe():
+    """Human-readable table of every knob (env_var.md analog)."""
+    lines = []
+    for name in sorted(_registry):
+        k = _registry[name]
+        env = f" [env {k.env}]" if k.env else ""
+        lines.append(f"{name} ({k.typ.__name__}, default={k.default!r})"
+                     f"{env}: {k.doc}")
+    return "\n".join(lines)
+
+
+# -- the built-in knob set (the env_var.md surface that applies on TPU) ----
+
+declare("seed", int, 0, "MXNET_SEED",
+        "Global RNG seed (reference: mx.random.seed / MXNET_SEED).")
+declare("engine.type", str, "PJRT", "MXNET_ENGINE_TYPE",
+        "Engine selector; informational — PJRT async dispatch is the only "
+        "engine (reference: NaiveEngine/ThreadedEngine/PerDevice).")
+declare("engine.bulk_size", int, 15, "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN",
+        "Default op-bulking window for engine.bulk() scopes (reference: "
+        "threaded_engine.h:433 op bulking; XLA fuses under jit here).")
+declare("update_on_kvstore", bool, None, "MXNET_UPDATE_ON_KVSTORE",
+        "Force Trainer's update_on_kvstore choice (reference: trainer.py).")
+declare("profiler.autostart", bool, False, "MXNET_PROFILER_AUTOSTART",
+        "Start the profiler at import (reference: profiler env knob).")
+declare("native.build_dir", str, "", "MXNET_TPU_NATIVE_BUILD",
+        "Build/cache dir for native (C++) helper libraries "
+        "('' = <repo>/native/build).")
+declare("home", str, os.path.join("~", ".mxnet"), "MXNET_HOME",
+        "Cache root for datasets/pretrained weights (reference: base.py "
+        "data_dir).")
+
+
+# -- dmlc::Parameter analog -------------------------------------------------
+
+class Field:
+    """Typed field of a Params struct (DMLC_DECLARE_FIELD analog)."""
+
+    def __init__(self, typ, default=None, doc="", lower=None, upper=None,
+                 choices=None):
+        self.typ = typ
+        self.default = default
+        self.doc = doc
+        self.lower = lower
+        self.upper = upper
+        self.choices = choices
+        self.name = None  # set by Params.__init_subclass__
+
+    def validate(self, value):
+        if value is None:
+            return None
+        try:
+            value = (self.typ(value)
+                     if not isinstance(value, self.typ) else value)
+        except (TypeError, ValueError):
+            raise MXNetError(
+                f"{self.name}: expected {self.typ.__name__}, got {value!r}")
+        if self.lower is not None and value < self.lower:
+            raise MXNetError(f"{self.name}={value} below lower bound "
+                             f"{self.lower}")
+        if self.upper is not None and value > self.upper:
+            raise MXNetError(f"{self.name}={value} above upper bound "
+                             f"{self.upper}")
+        if self.choices is not None and value not in self.choices:
+            raise MXNetError(f"{self.name}={value!r} not in {self.choices}")
+        return value
+
+
+class Params:
+    """Typed config struct: declare fields as class attributes.
+
+    The analog of ``dmlc::Parameter<T>`` (reference:
+    src/imperative/cached_op.h:412-459):
+
+        class CachedOpConfig(Params):
+            inline_limit = Field(int, 2, "inline small graphs", lower=0)
+            static_alloc = Field(bool, False, "pre-allocate buffers")
+
+    Construction validates kwargs against the declared fields; unknown
+    keys raise.  ``describe()`` documents the struct.
+    """
+
+    _fields: dict[str, Field] = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        fields = dict(getattr(cls, "_fields", {}))
+        for key, val in list(vars(cls).items()):
+            if isinstance(val, Field):
+                val.name = key
+                fields[key] = val
+        cls._fields = fields
+
+    def __init__(self, **kwargs):
+        for key, field in self._fields.items():
+            setattr(self, key, field.validate(
+                kwargs.pop(key, field.default)))
+        if kwargs:
+            raise MXNetError(
+                f"{type(self).__name__}: unknown fields {sorted(kwargs)}; "
+                f"declared: {sorted(self._fields)}")
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._fields}
+
+    @classmethod
+    def describe(cls):
+        lines = [cls.__name__ + ":"]
+        for key, f in sorted(cls._fields.items()):
+            bounds = ""
+            if f.lower is not None or f.upper is not None:
+                bounds = f" range[{f.lower},{f.upper}]"
+            if f.choices is not None:
+                bounds += f" choices={sorted(f.choices)}"
+            lines.append(f"  {key} ({f.typ.__name__}, "
+                         f"default={f.default!r}){bounds}: {f.doc}")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._fields)
+        return f"{type(self).__name__}({inner})"

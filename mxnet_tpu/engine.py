@@ -56,7 +56,10 @@ def set_bulk_size(size):
 
 
 @contextlib.contextmanager
-def bulk(size):
+def bulk(size=None):
+    if size is None:
+        from . import config
+        size = config.get("engine.bulk_size")
     prev = set_bulk_size(size)
     try:
         yield

@@ -248,7 +248,15 @@ class _CachedGraph:
                           if self.params[n].grad_req != "null"]
         self.aux = [n for n in self.param_names
                     if self.params[n].grad_req == "null"]
-        self._jit = jax.jit(self._pure, static_argnames=("sig_key",))
+        pure = self._pure
+        if getattr(block, "_backend", None):
+            # subgraph backend transform (reference: optimize_for partition
+            # hook, block.py:1160; here a rewrite of the traced forward)
+            from .. import library
+            transform = library.subgraph_backend(block._backend)
+            pure = transform(pure, block,
+                             **(block._flags.get("backend_opts") or {}))
+        self._jit = jax.jit(pure, static_argnames=("sig_key",))
         self._signatures = {}  # sig_key -> (treedef, static_leaves)
         self._out_trees = {}   # sig_key -> output treedef (set at trace time)
 
@@ -302,13 +310,16 @@ class _CachedGraph:
             else:
                 static_leaves.append(l)
         from .. import amp as _amp
-        sig = (str(treedef),
-               tuple("A" if l is _ARR else repr(l) for l in static_leaves),
-               tuple((tuple(r.shape), str(r.dtype)) for r in input_raws),
-               # dtype policy is applied inside _invoke at trace time, so a
-               # policy change must invalidate the cached trace
-               (_amp.is_active(), str(_amp.target_dtype())))
-        sig_key = hash(sig)
+        # the full tuple (not its hash) is the key: equality comparison
+        # makes collisions impossible; jax.jit's own cache grows with the
+        # same signatures, so this adds no asymptotic memory
+        sig_key = (str(treedef),
+                   tuple("A" if l is _ARR else repr(l)
+                         for l in static_leaves),
+                   tuple((tuple(r.shape), str(r.dtype)) for r in input_raws),
+                   # dtype policy is applied inside _invoke at trace time, so
+                   # a policy change must invalidate the cached trace
+                   (_amp.is_active(), str(_amp.target_dtype())))
         self._signatures[sig_key] = (treedef, static_leaves)
 
         rng = _random._next_key()
@@ -397,12 +408,17 @@ class HybridBlock(Block):
         XLA buffer donation/compiled executables — both are automatic here;
         the flags are accepted for compatibility."""
         self._active = active
+        if backend is not None:
+            from .. import library
+            library.subgraph_backend(backend)  # fail fast on unknown names
         self._backend = backend
         self._flags = dict(static_alloc=static_alloc,
-                           static_shape=static_shape, **kwargs)
+                           static_shape=static_shape,
+                           backend_opts=backend_opts, **kwargs)
         if clear:
             self._cached_graphs = {}
-        super().hybridize(active, backend=backend, static_alloc=static_alloc,
+        super().hybridize(active, backend=backend, backend_opts=backend_opts,
+                          static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 
     def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
@@ -432,6 +448,17 @@ class HybridBlock(Block):
         if not self._active:
             return super().__call__(*args, **kwargs)
         if kwargs:
+            # keyword args are not part of the trace signature; warn once
+            # instead of silently never compiling (the reference's
+            # _build_cache has the same positional-only restriction)
+            if not getattr(self, "_warned_kwargs_eager", False):
+                import warnings
+                warnings.warn(
+                    f"{type(self).__name__} is hybridized but was called "
+                    "with keyword arguments; running eagerly (pass inputs "
+                    "positionally to use the compiled path)",
+                    stacklevel=2)
+                self._warned_kwargs_eager = True
             return super().__call__(*args, **kwargs)
         if self._ensure_init(*args):
             # first call: eager, triggers deferred init (the reference's

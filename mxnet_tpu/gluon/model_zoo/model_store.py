@@ -24,8 +24,8 @@ _model_sha1 = {}
 
 
 def data_dir():
-    return os.path.expanduser(
-        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet")))
+    from ... import config
+    return os.path.expanduser(config.get("home"))
 
 
 def _default_root():

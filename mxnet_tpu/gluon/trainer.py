@@ -160,7 +160,12 @@ class Trainer:
             if self._compression_params and hasattr(kv, "set_gradient_compression"):
                 kv.set_gradient_compression(self._compression_params)
             if self._update_on_kvstore is None:
-                self._update_on_kvstore = False
+                # env/config override first (reference: MXNET_UPDATE_ON_KVSTORE,
+                # trainer.py:36); default False — fused local update is faster
+                from .. import config
+                forced = config.get("update_on_kvstore")
+                self._update_on_kvstore = (bool(forced)
+                                           if forced is not None else False)
             if self._update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
             for i, p in enumerate(self._params):

@@ -40,3 +40,97 @@ def load(path, verbose=True):
         hook(registry)
     _loaded[path] = mod
     return mod
+
+
+# --------------------------------------------------------------------------
+# subgraph/partition backends
+# --------------------------------------------------------------------------
+#
+# Reference parity: the subgraph property API
+# (src/operator/subgraph/subgraph_property.h:88-252,
+# MXNET_REGISTER_SUBGRAPH_BACKEND) lets accelerator backends rewrite the
+# graph a CachedOp executes; HybridBlock.optimize_for / hybridize(backend=)
+# select one (python/mxnet/gluon/block.py:1160-1163).  TPU-native design:
+# a backend is a transform over the *pure traced forward* — it returns a
+# wrapped callable with the same signature that _CachedGraph jit-compiles,
+# so a backend can rematerialize, recast, shard, or otherwise rewrite the
+# computation XLA sees.
+
+_subgraph_backends = {}
+
+
+def register_subgraph_backend(name, transform=None):
+    """Register (or decorate) a subgraph backend.
+
+    ``transform(pure_fn, block, **opts) -> pure_fn`` wraps the traced
+    forward; the wrapped callable must keep the signature
+    ``(trainable, aux, inputs, rng_key, sig_key)``.
+    """
+    def deco(fn):
+        _subgraph_backends[name] = fn
+        return fn
+    return deco(transform) if transform is not None else deco
+
+
+def subgraph_backend(name):
+    if name not in _subgraph_backends:
+        raise MXNetError(
+            f"unknown subgraph backend {name!r}; registered: "
+            f"{sorted(_subgraph_backends)}")
+    return _subgraph_backends[name]
+
+
+def list_subgraph_backends():
+    return sorted(_subgraph_backends)
+
+
+@register_subgraph_backend("checkpoint")
+def _checkpoint_backend(pure_fn, block, **opts):
+    """Rematerialize the forward in backward (the reference's backward
+    mirroring, src/nnvm/gradient.cc:131 MXNET_BACKWARD_DO_MIRROR): trades
+    FLOPs for activation memory — on TPU, HBM is usually the binding
+    constraint."""
+    import jax
+    ck = jax.checkpoint(
+        lambda tr, aux, inp, rng, sig: pure_fn(tr, aux, inp, rng, sig),
+        static_argnums=(4,))
+
+    def wrapped(trainable, aux, inputs, rng_key, sig_key):
+        return ck(trainable, aux, inputs, rng_key, sig_key)
+    return wrapped
+
+
+@register_subgraph_backend("bf16")
+def _bf16_backend(pure_fn, block, **opts):
+    """Run the whole forward in bfloat16 (float32 params/inputs cast in,
+    float32 results cast back out) — the graph-rewrite analog of
+    amp.convert_hybrid_block (reference: src/nnvm/low_precision_pass.cc).
+    Natively-bfloat16 models pass through untouched: only values that
+    were float32 on the way in are cast back on the way out."""
+    import jax
+    import jax.numpy as jnp
+
+    def to_bf16(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if hasattr(a, "dtype") and a.dtype == jnp.float32 else a, tree)
+
+    def wrapped(trainable, aux, inputs, rng_key, sig_key):
+        was_f32 = any(
+            hasattr(a, "dtype") and a.dtype == jnp.float32
+            for a in jax.tree_util.tree_leaves((trainable, aux, inputs)))
+        aux_dtypes = {k: v.dtype for k, v in aux.items()}
+        out, mutated = pure_fn(to_bf16(trainable), to_bf16(aux),
+                               to_bf16(inputs), rng_key, sig_key)
+        # mutated aux must keep each param's original dtype invariant
+        mutated = {k: v.astype(aux_dtypes[k])
+                   if v.dtype == jnp.bfloat16
+                   and aux_dtypes[k] == jnp.float32 else v
+                   for k, v in mutated.items()}
+        if was_f32:
+            out = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32)
+                if hasattr(a, "dtype") and a.dtype == jnp.bfloat16 else a,
+                out)
+        return out, mutated
+    return wrapped

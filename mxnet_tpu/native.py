@@ -19,18 +19,21 @@ _libs = {}
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC_DIR = os.path.join(_ROOT, "native")
-_BUILD_DIR = os.environ.get("MXNET_TPU_NATIVE_BUILD",
-                            os.path.join(_SRC_DIR, "build"))
+
+
+def _build_dir():
+    from . import config
+    return config.get("native.build_dir") or os.path.join(_SRC_DIR, "build")
 
 
 def _build(name):
     src = os.path.join(_SRC_DIR, f"{name}.cc")
-    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    out = os.path.join(_build_dir(), f"lib{name}.so")
     if not os.path.exists(src):
         raise FileNotFoundError(src)
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
-    os.makedirs(_BUILD_DIR, exist_ok=True)
+    os.makedirs(_build_dir(), exist_ok=True)
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
            src, "-o", out]
     proc = subprocess.run(cmd, capture_output=True, text=True)

@@ -12,10 +12,10 @@ import threading
 
 import jax
 
-from .base import get_env
+from . import config
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(get_env("MXNET_SEED", 0, int))
+_key = jax.random.PRNGKey(config.get("seed"))
 _trace = threading.local()
 
 

@@ -83,3 +83,27 @@ def get_gpu_memory(gpu_dev_id=0):
         return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
     except Exception:
         return (0, 0)
+
+
+def int64_enabled():
+    """Whether 64-bit tensor sizes/dtypes are active.
+
+    Analog of the reference's MXNET_USE_INT64_TENSOR_SIZE build flag
+    (docs env_var.md; tests/nightly/test_large_array.py relies on it).
+    Here it maps to JAX's x64 mode.
+    """
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
+@contextlib.contextmanager
+def int64_tensor_size(active=True):
+    """Scope enabling true int64 dtypes/indices (jax x64 mode).
+
+    Arrays created inside the scope keep 64-bit dtypes; outside it JAX's
+    default 32-bit truncation applies (a startup-time choice in the
+    reference, a scope here).
+    """
+    import jax
+    with jax.enable_x64(active):
+        yield

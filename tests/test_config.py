@@ -1,0 +1,100 @@
+"""Typed config system tests (mx.config: knob registry + Params structs,
+the dmlc::GetEnv + dmlc::Parameter unification of SURVEY §5)."""
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+from mxnet_tpu.base import MXNetError
+
+
+def test_knob_default_env_and_set(monkeypatch):
+    config.declare("test.knob", int, 7, "MXNET_TEST_KNOB", "a test knob")
+    assert config.get("test.knob") == 7
+    monkeypatch.setenv("MXNET_TEST_KNOB", "42")
+    assert config.get("test.knob") == 42          # env override
+    prev = config.set("test.knob", 5)
+    assert prev == 42
+    assert config.get("test.knob") == 5           # runtime override wins
+    config.reset("test.knob")
+    assert config.get("test.knob") == 42          # back to env
+
+
+def test_bool_env_coercion(monkeypatch):
+    config.declare("test.flag", bool, False, "MXNET_TEST_FLAG", "flag")
+    monkeypatch.setenv("MXNET_TEST_FLAG", "0")
+    assert config.get("test.flag") is False
+    monkeypatch.setenv("MXNET_TEST_FLAG", "1")
+    assert config.get("test.flag") is True
+    config.reset("test.flag")
+
+
+def test_unknown_knob_raises():
+    with pytest.raises(MXNetError, match="unknown config knob"):
+        config.get("no.such.knob")
+
+
+def test_describe_lists_builtin_knobs():
+    text = config.describe()
+    assert "seed" in text and "MXNET_SEED" in text
+    assert "engine.bulk_size" in text
+
+
+def test_params_struct_validation():
+    class CachedOpConfig(config.Params):
+        inline_limit = config.Field(int, 2, "inline small graphs", lower=0)
+        static_alloc = config.Field(bool, False, "pre-allocate buffers")
+        backend = config.Field(str, "xla", "compile backend",
+                               choices=("xla", "eager"))
+
+    c = CachedOpConfig(inline_limit=5)
+    assert c.inline_limit == 5 and c.static_alloc is False
+    assert c.to_dict() == {"inline_limit": 5, "static_alloc": False,
+                           "backend": "xla"}
+    with pytest.raises(MXNetError, match="below lower bound"):
+        CachedOpConfig(inline_limit=-1)
+    with pytest.raises(MXNetError, match="not in"):
+        CachedOpConfig(backend="tvm")
+    with pytest.raises(MXNetError, match="unknown fields"):
+        CachedOpConfig(bogus=1)
+    assert "inline_limit" in CachedOpConfig.describe()
+
+
+def test_reset_unknown_raises_mxnet_error():
+    with pytest.raises(MXNetError, match="unknown config knob"):
+        config.reset("nope.nothing")
+
+
+def test_update_on_kvstore_knob_wired():
+    from mxnet_tpu import gluon
+    net = mx.gluon.nn.Dense(2)
+    net.initialize()
+    net(mx.np.ones((1, 3)))
+    prev = config.set("update_on_kvstore", True)
+    try:
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore="device")
+        tr._init_kvstore()
+        assert tr._update_on_kvstore is True
+    finally:
+        config.reset("update_on_kvstore")
+
+
+def test_native_build_dir_knob_wired(tmp_path):
+    from mxnet_tpu import native
+    prev = config.set("native.build_dir", str(tmp_path / "nb"))
+    try:
+        assert native._build_dir() == str(tmp_path / "nb")
+    finally:
+        config.reset("native.build_dir")
+
+
+def test_engine_bulk_uses_config_default():
+    from mxnet_tpu import engine
+    prev = config.set("engine.bulk_size", 31)
+    try:
+        with engine.bulk():
+            assert engine._bulk_size == 31
+    finally:
+        config.set("engine.bulk_size", prev)

@@ -1,0 +1,89 @@
+"""Large-tensor / int64 support suite.
+
+Analog of the reference's tests/nightly/test_large_array.py and
+test_np_large_array.py (tensors beyond 2**32 elements, int64 indexing).
+The >4-billion-element cases allocate gigabytes, so — like the
+reference's nightly gating — they only run when MXNET_TEST_LARGE_TENSOR=1.
+The always-on cases lock the int64-shape arithmetic paths (size/indexing
+math must not overflow int32) at small memory cost.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+LARGE = os.environ.get("MXNET_TEST_LARGE_TENSOR", "0") == "1"
+large_only = pytest.mark.skipif(
+    not LARGE, reason="set MXNET_TEST_LARGE_TENSOR=1 (allocates >4GB)")
+
+
+def test_size_arithmetic_is_int64():
+    """shape/size math must use python ints (arbitrary precision), not
+    int32 — a (2**16, 2**16) array's size overflows int32."""
+    x = mx.np.zeros((1, 1))
+    big_shape = (2 ** 16, 2 ** 16)
+    # metadata-level checks only: no allocation of the big array
+    assert int(onp.prod(big_shape, dtype=onp.int64)) == 2 ** 32
+    y = mx.np.zeros((3, 5))
+    assert isinstance(y.size, int) and y.size == 15
+
+
+def test_int64_indices_on_moderate_array():
+    x = mx.np.arange(1_000_000, dtype="float32")
+    idx = mx.np.array([0, 999_999], dtype="int64")
+    out = x[idx].asnumpy()
+    onp.testing.assert_allclose(out, [0.0, 999_999.0])
+
+
+def test_reduction_does_not_overflow_with_int64_scope():
+    # 70k * 70k overflows int32; inside the int64 scope (the analog of
+    # the reference's MXNET_USE_INT64_TENSOR_SIZE flag) it must not
+    from mxnet_tpu import util
+    n = 70_000
+    with util.int64_tensor_size():
+        x = mx.np.full((n,), 70_000, dtype="int64")
+        assert x.dtype == onp.int64
+        total = int(x.sum().asnumpy())
+    assert total == n * 70_000  # 4.9e9 > 2**32
+    assert not util.int64_enabled()  # scope restored
+
+
+@large_only
+def test_elementwise_over_2_32_elements():
+    from mxnet_tpu import util
+    n = 2 ** 32 + 8
+    with util.int64_tensor_size():   # >int32 indices need the int64 mode
+        x = mx.np.zeros((n,), dtype="int8")
+        y = x + 1
+        assert y.shape == (n,)
+        assert int(y[n - 1].asnumpy()) == 1
+        del x, y
+    mx.waitall()
+
+
+@large_only
+def test_indexing_beyond_2_32():
+    from mxnet_tpu import util
+    n = 2 ** 32 + 8
+    with util.int64_tensor_size():
+        x = mx.np.zeros((n,), dtype="int8")
+        idx = n - 2
+        x[idx] = 7
+        assert int(x[idx].asnumpy()) == 7
+        del x
+    mx.waitall()
+
+
+@large_only
+def test_large_first_dim_slice():
+    from mxnet_tpu import util
+    n = 2 ** 31 + 2
+    with util.int64_tensor_size():
+        x = mx.np.zeros((n, 2), dtype="int8")
+        assert x.shape[0] == n
+        s = x[n - 1]
+        assert tuple(s.shape) == (2,)
+        del x
+    mx.waitall()

@@ -1,0 +1,97 @@
+"""Subgraph/partition backend tests (optimize_for + registered transforms
+over the traced forward — the analog of the reference's
+MXNET_REGISTER_SUBGRAPH_BACKEND property API, subgraph_property.h:88)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, library
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    return net
+
+
+def test_builtin_backends_registered():
+    names = library.list_subgraph_backends()
+    assert "checkpoint" in names and "bf16" in names
+
+
+def test_unknown_backend_fails_fast():
+    net = _mlp()
+    with pytest.raises(MXNetError, match="unknown subgraph backend"):
+        net.hybridize(backend="tensorrt")
+
+
+def test_bf16_backend_changes_compute_dtype():
+    net = _mlp()
+    x = mx.np.array(
+        onp.random.RandomState(0).randn(4, 16).astype("float32"))
+    want = net(x).asnumpy()
+    net.hybridize(backend="bf16")
+    got = net(x)
+    assert got.dtype == onp.float32           # cast back at the boundary
+    gotn = got.asnumpy()
+    # bf16 mantissa is 8 bits: close to fp32 but not bit-identical
+    onp.testing.assert_allclose(gotn, want, rtol=3e-2, atol=3e-2)
+    assert not onp.array_equal(gotn, want)
+
+
+def test_checkpoint_backend_preserves_forward_and_grads():
+    net = _mlp()
+    x = mx.np.array(
+        onp.random.RandomState(1).randn(4, 16).astype("float32"))
+    def run():
+        for p in net.collect_params().values():
+            p.grad_req = "write"   # (re)attaches a zeroed grad buffer
+            p.zero_grad()
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        g = {n: p.grad().asnumpy().copy()
+             for n, p in net.collect_params().items()}
+        return y.asnumpy().copy(), g
+
+    y0, g0 = run()
+    net.hybridize(backend="checkpoint")
+    y1, g1 = run()
+    onp.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-5)
+    for n in g0:
+        onp.testing.assert_allclose(g1[n], g0[n], rtol=1e-5, atol=1e-5,
+                                    err_msg=n)
+
+
+def test_custom_backend_transform_applied():
+    calls = []
+
+    @library.register_subgraph_backend("test-double")
+    def double(pure_fn, block, **opts):
+        calls.append(type(block).__name__)
+
+        def wrapped(tr, aux, inputs, rng_key, sig_key):
+            out, mutated = pure_fn(tr, aux, inputs, rng_key, sig_key)
+            return [o * 2 for o in out], mutated
+        return wrapped
+
+    net = nn.Dense(4)
+    net.initialize()
+    x = mx.np.ones((2, 3))
+    want = net(x).asnumpy()
+    net.hybridize(backend="test-double")
+    got = net(x).asnumpy()
+    onp.testing.assert_allclose(got, want * 2, rtol=1e-6)
+    assert calls  # transform ran at compile time
+
+
+def test_optimize_for_compiles_and_runs():
+    net = _mlp()
+    x = mx.np.ones((2, 16))
+    out = net.optimize_for(x, backend="checkpoint")
+    assert out.shape == (2, 8)
+    assert net._backend == "checkpoint"
