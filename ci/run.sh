@@ -1,0 +1,44 @@
+#!/bin/sh
+# CI entrypoint (the role of the reference's ci/build.py stages,
+# minus docker: sanity -> unit tests -> driver contracts).
+#
+# Stages:
+#   sanity     - compile-check every python file, regen proto drift check
+#   unit       - pytest tests/ on a virtual 8-device CPU mesh
+#   contracts  - __graft_entry__.py (jit entry + multichip dryrun), bench
+#                smoke on CPU
+#
+# Usage: ci/run.sh [sanity|unit|contracts|all]
+set -e
+cd "$(dirname "$0")/.."
+stage="${1:-all}"
+
+sanity() {
+    echo "== sanity: python compile-check =="
+    python -m compileall -q mxnet_tpu tools example tests bench.py __graft_entry__.py
+    echo "== sanity: onnx proto gencode up to date =="
+    tmp=$(mktemp -d)
+    protoc --python_out="$tmp" -I mxnet_tpu/onnx mxnet_tpu/onnx/onnx_mxtpu.proto
+    diff -q "$tmp/onnx_mxtpu_pb2.py" mxnet_tpu/onnx/onnx_mxtpu_pb2.py
+    rm -rf "$tmp"
+}
+
+unit() {
+    echo "== unit: pytest (virtual 8-device CPU mesh via tests/conftest.py) =="
+    python -m pytest tests/ -q
+}
+
+contracts() {
+    echo "== contracts: driver entrypoints =="
+    python __graft_entry__.py
+    echo "== contracts: bench smoke (CPU shapes) =="
+    JAX_PLATFORMS=cpu python bench.py
+}
+
+case "$stage" in
+    sanity) sanity ;;
+    unit) unit ;;
+    contracts) contracts ;;
+    all) sanity; unit; contracts ;;
+    *) echo "unknown stage $stage"; exit 2 ;;
+esac

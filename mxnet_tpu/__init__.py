@@ -57,6 +57,7 @@ from . import recordio
 from . import io
 from . import image
 from . import contrib
+from . import serialization
 try:
     from . import onnx
 except ImportError:  # protobuf missing: degrade the feature, not the package

@@ -154,13 +154,18 @@ class Block:
     # -- save / load -------------------------------------------------------
     def save_parameters(self, filename, deduplicate=False):
         """npz of structural-name -> value (reference: block.py:340 over
-        src/serialization/cnpy.cc)."""
+        src/serialization/cnpy.cc); ``.safetensors`` filenames write the
+        portable safetensors format (mxnet_tpu.serialization)."""
         import numpy as onp
         params = self.collect_params()
         arrays = {}
         for name, p in params.items():
             if p._data is not None:
                 arrays[name] = p.data().asnumpy()
+        if filename.endswith(".safetensors"):
+            from .. import serialization
+            serialization.save_safetensors(filename, arrays)
+            return
         onp.savez(filename, **arrays)
         if not filename.endswith(".npz") and not os.path.exists(filename):
             os.rename(filename + ".npz", filename)
@@ -172,8 +177,12 @@ class Block:
         import numpy as onp
         from ..numpy import array
         path = filename if os.path.exists(filename) else filename + ".npz"
-        with onp.load(path, allow_pickle=False) as data:
-            loaded = {k: data[k] for k in data.files}
+        if path.endswith(".safetensors"):
+            from .. import serialization
+            loaded = serialization.load_safetensors(path)
+        else:
+            with onp.load(path, allow_pickle=False) as data:
+                loaded = {k: data[k] for k in data.files}
         params = self.collect_params()
         for name, p in params.items():
             if name in loaded:

@@ -222,3 +222,198 @@ class PrefetchingIter(DataIter):
         return batch
 
     __next__ = next
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference: src/io/iter_csv.cc, exposed as
+    mx.io.CSVIter).  Loads the csv eagerly (host memory) and batches;
+    `round_batch` wraps the tail batch with rows from the start, like the
+    reference's default behavior."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        import numpy as onp
+        self.data_shape = tuple(data_shape)
+        self.label_shape = tuple(label_shape)
+        self._data = onp.loadtxt(data_csv, delimiter=",",
+                                 dtype=dtype, ndmin=2)
+        n = len(self._data)
+        self._data = self._data.reshape((n,) + self.data_shape)
+        if label_csv is not None:
+            self._label = onp.loadtxt(label_csv, delimiter=",",
+                                      dtype="float32", ndmin=2)
+            self._label = self._label.reshape((n,) + self.label_shape)
+        else:
+            self._label = onp.zeros((n,) + self.label_shape, "float32")
+        self._round = round_batch
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [("label", (self.batch_size,) + self.label_shape)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        import numpy as onp
+        from ..numpy import array
+        n = len(self._data)
+        if self._cursor >= n:
+            raise StopIteration
+        idx = onp.arange(self._cursor, self._cursor + self.batch_size)
+        self._cursor += self.batch_size
+        pad = int(max(0, idx[-1] + 1 - n))
+        if pad and not self._round:
+            # short tail batch with no padding rows present
+            idx, pad = idx[idx < n], 0
+        idx = idx % n
+        return DataBatch([array(self._data[idx])],
+                         [array(self._label[idx])], pad=pad)
+
+    __next__ = next
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse-format iterator (reference: src/io/iter_libsvm.cc).
+    Yields CSR batches via mxnet_tpu.ndarray.sparse.CSRNDArray, matching
+    the reference's CSR storage for the data field."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        import numpy as onp
+        self.data_shape = tuple(data_shape)
+        indptr, indices, values, labels = [0], [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    indices.append(int(k))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        self._indptr = onp.asarray(indptr, "int64")
+        self._indices = onp.asarray(indices, "int64")
+        self._values = onp.asarray(values, "float32")
+        self._labels = onp.asarray(labels, "float32")
+        self._round = round_batch
+        self._cursor = 0
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        import numpy as onp
+        from ..ndarray import sparse as _sp
+        from ..numpy import array
+        n = len(self._labels)
+        if self._cursor >= n:
+            raise StopIteration
+        rows = onp.arange(self._cursor, self._cursor + self.batch_size)
+        self._cursor += self.batch_size
+        pad = int(max(0, rows[-1] + 1 - n))
+        if pad and not self._round:
+            # short tail batch with no wrapped rows
+            rows, pad = rows[rows < n], 0
+        rows = rows % n
+        ptr = [0]
+        idxs, vals = [], []
+        for r in rows:
+            lo, hi = self._indptr[r], self._indptr[r + 1]
+            idxs.append(self._indices[lo:hi])
+            vals.append(self._values[lo:hi])
+            ptr.append(ptr[-1] + (hi - lo))
+        data = _sp.csr_matrix(
+            (onp.concatenate(vals) if vals else onp.zeros(0, "float32"),
+             onp.concatenate(idxs) if idxs else onp.zeros(0, "int64"),
+             onp.asarray(ptr, "int64")),
+            shape=(len(rows),) + self.data_shape)
+        return DataBatch([data], [array(self._labels[rows])], pad=pad)
+
+    __next__ = next
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (reference: src/io/iter_mnist.cc).
+    Reads local `image` / `label` idx(.gz) files."""
+
+    def __init__(self, image, label, batch_size=1, shuffle=False,
+                 flat=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import struct as _struct
+        import numpy as onp
+
+        def read_idx(path):
+            op = gzip.open if path.endswith(".gz") else open
+            with op(path, "rb") as f:
+                raw = f.read()
+            magic, = _struct.unpack(">I", raw[:4])
+            ndim = magic & 0xFF
+            dims = _struct.unpack(">" + "I" * ndim, raw[4:4 + 4 * ndim])
+            return onp.frombuffer(raw, onp.uint8,
+                                  offset=4 + 4 * ndim).reshape(dims)
+
+        self._images = read_idx(image).astype("float32") / 255.0
+        self._labels = read_idx(label).astype("float32")
+        if flat:
+            self._images = self._images.reshape(len(self._images), -1)
+        else:
+            self._images = self._images[:, None, :, :]  # NCHW
+        self._order = onp.arange(len(self._images))
+        self._shuffle = shuffle
+        self._rng = onp.random.RandomState(seed)
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+
+    def next(self):
+        from ..numpy import array
+        n = len(self._order)
+        if self._cursor + self.batch_size > n:
+            raise StopIteration
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        return DataBatch([array(self._images[idx])],
+                         [array(self._labels[idx])], pad=0)
+
+    __next__ = next
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
+                    shuffle=False, label_width=1, resize=0, rand_crop=False,
+                    rand_mirror=False, mean_r=0, mean_g=0, mean_b=0,
+                    std_r=0, std_g=0, std_b=0, preprocess_threads=0,
+                    **kwargs):
+    """RecordIO image iterator with the C++ iterator's kwargs surface
+    (reference: src/io/iter_image_recordio_2.cc, registered as
+    mx.io.ImageRecordIter).  Maps decode -> augment -> batch onto
+    image.ImageIter + CreateAugmenter; the native RecordIO reader
+    (native/mxtpu_io.cc) provides the mmap + prefetch underneath."""
+    import numpy as onp
+    from .. import image as img_mod
+    mean = (onp.array([mean_r, mean_g, mean_b], "float32")
+            if (mean_r or mean_g or mean_b) else None)
+    std = (onp.array([std_r, std_g, std_b], "float32")
+           if (std_r or std_g or std_b) else None)
+    aug = img_mod.CreateAugmenter(
+        data_shape, resize=resize, rand_crop=rand_crop,
+        rand_mirror=rand_mirror, mean=mean, std=std)
+    return img_mod.ImageIter(batch_size, data_shape,
+                             label_width=label_width,
+                             path_imgrec=path_imgrec, shuffle=shuffle,
+                             aug_list=aug, **kwargs)

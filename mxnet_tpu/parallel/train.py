@@ -85,6 +85,32 @@ class FunctionalOptimizer:
         return new_p, new_s
 
 
+def scan_steps(step_fn, n_state):
+    """Fuse K training steps into one compiled program with ``lax.scan``.
+
+    ``step_fn(*state, *batch) -> (*state', metric)`` becomes
+    ``loop(*state, *stacked) -> (*state', metric_mean)`` where each array
+    in ``stacked`` carries a leading steps axis.  One executable launch
+    then performs K steps — amortizing per-launch dispatch latency, the
+    step-level analog of the reference engine's op bulking
+    (src/engine/threaded_engine.h:433; there ops are batched into one
+    engine op, here whole steps into one XLA program).
+    """
+    from jax import lax
+
+    def loop(*args):
+        state, batches = args[:n_state], args[n_state:]
+
+        def body(carry, xs):
+            out = step_fn(*carry, *xs)
+            return tuple(out[:n_state]), out[-1]
+
+        state, metrics = lax.scan(body, tuple(state), tuple(batches))
+        return (*state, jnp.mean(metrics))
+
+    return loop
+
+
 class ShardedTrainStep:
     """Compiled data/tensor/sequence-parallel training step for a Block.
 
@@ -99,7 +125,8 @@ class ShardedTrainStep:
     """
 
     def __init__(self, block, loss_fn, optimizer, mesh, batch_specs,
-                 n_labels=1, param_specs=None, donate=True):
+                 n_labels=1, param_specs=None, donate=True,
+                 steps_per_call=1):
         from ..optimizer import optimizer as opt_mod
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer)
@@ -168,6 +195,25 @@ class ShardedTrainStep:
                                                   lr=lr)
             return new_tr, {**aux, **mutated}, new_states, loss
 
+        self.steps_per_call = int(steps_per_call)
+        if self.steps_per_call > 1:
+            from jax import lax
+            inner = step
+
+            def step(trainable, aux, states, rng, lr, *batches):
+                # batches carry a leading steps axis; one launch = K steps
+                def body(carry, xs):
+                    tr, ax, st, i = carry
+                    rngi = jax.random.fold_in(rng, i)
+                    tr, ax, st, loss = inner(tr, ax, st, rngi, lr, *xs)
+                    return (tr, ax, st, i + 1), loss
+                (trainable, aux, states, _), losses = lax.scan(
+                    body, (trainable, aux, states, 0), tuple(batches))
+                return trainable, aux, states, jnp.mean(losses)
+
+            self.batch_shardings = tuple(
+                sh(P(None, *s)) for s in batch_specs)
+
         donate_argnums = (0, 1, 2) if donate else ()
         self._step = jax.jit(
             step,
@@ -188,7 +234,7 @@ class ShardedTrainStep:
         lr = jnp.asarray(self.fopt.opt.learning_rate, jnp.float32)
         self.trainable, self.aux, self.states, loss = self._step(
             self.trainable, self.aux, self.states, rng, lr, *raws)
-        self._n_step += 1
+        self._n_step += self.steps_per_call
         return _wrap(loss)
 
     def sync_to_block(self):

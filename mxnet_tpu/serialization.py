@@ -1,0 +1,101 @@
+"""Tensor-dict serialization formats.
+
+Reference parity: src/serialization/cnpy.{h,cc} (npy/npz save/load of
+NDArray dicts — the format behind Block.save_parameters) plus the legacy
+NDArray binary format (src/ndarray/ndarray.cc Save/Load).  TPU-native
+additions: the **safetensors** format (zero-copy, mmap-friendly,
+framework-portable — the modern replacement for the legacy binary
+format), implemented directly against the public spec: an 8-byte
+little-endian header length, a JSON header mapping tensor name ->
+{dtype, shape, data_offsets}, then raw little-endian buffers.
+
+    mx.serialization.save_safetensors(path, {"w": arr, ...})
+    tensors = mx.serialization.load_safetensors(path)
+
+Block.save_parameters/load_parameters route here when the filename ends
+in ``.safetensors``.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["save_safetensors", "load_safetensors"]
+
+# safetensors dtype tags <-> numpy
+_DTYPES = {
+    "F64": "float64", "F32": "float32", "F16": "float16", "BF16": "bfloat16",
+    "I64": "int64", "I32": "int32", "I16": "int16", "I8": "int8",
+    "U64": "uint64", "U32": "uint32", "U16": "uint16", "U8": "uint8",
+    "BOOL": "bool",
+}
+_NP2TAG = {v: k for k, v in _DTYPES.items()}
+
+
+def _np_dtype(tag):
+    if tag not in _DTYPES:
+        raise MXNetError(f"safetensors dtype {tag!r} unsupported")
+    name = _DTYPES[tag]
+    if name == "bfloat16":
+        import ml_dtypes
+        return onp.dtype(ml_dtypes.bfloat16)
+    return onp.dtype(name)
+
+
+def _as_numpy(v):
+    if hasattr(v, "asnumpy"):
+        return v.asnumpy()
+    return onp.asarray(v)
+
+
+def save_safetensors(path, tensors, metadata=None):
+    """Write a dict name -> array (mx ndarray / numpy / jax) to `path`."""
+    arrays = {}
+    header = {}
+    offset = 0
+    for name in sorted(tensors):
+        arr = onp.ascontiguousarray(_as_numpy(tensors[name]))
+        if arr.dtype.byteorder == ">":
+            arr = arr.byteswap().view(arr.dtype.newbyteorder("<"))
+        tag = _NP2TAG.get(str(arr.dtype))
+        if tag is None:
+            raise MXNetError(f"{name}: dtype {arr.dtype} has no "
+                             "safetensors mapping")
+        n = arr.nbytes
+        header[name] = {"dtype": tag, "shape": list(arr.shape),
+                        "data_offsets": [offset, offset + n]}
+        arrays[name] = arr
+        offset += n
+    if metadata:
+        header["__metadata__"] = {str(k): str(v)
+                                  for k, v in metadata.items()}
+    blob = json.dumps(header, separators=(",", ":")).encode()
+    pad = (8 - len(blob) % 8) % 8          # spec: align data to 8 bytes
+    blob += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for name in sorted(arrays):
+            f.write(arrays[name].tobytes())
+    return path
+
+
+def load_safetensors(path, return_metadata=False):
+    """Read a safetensors file -> dict name -> numpy array."""
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+        data = f.read()
+    metadata = header.pop("__metadata__", {})
+    out = {}
+    for name, info in header.items():
+        lo, hi = info["data_offsets"]
+        arr = onp.frombuffer(data[lo:hi], dtype=_np_dtype(info["dtype"]))
+        out[name] = arr.reshape(info["shape"]).copy()
+    if return_metadata:
+        return out, metadata
+    return out

@@ -101,3 +101,112 @@ def test_pack_unpack_header():
     h3, payload = recordio.unpack(buf)
     assert payload == b"x"
     onp.testing.assert_allclose(h3.label, [1.0, 2.0, 3.0])
+
+
+def test_csv_iter(tmp_path):
+    import numpy as onp
+    data = onp.arange(20, dtype="float32").reshape(10, 2)
+    labels = onp.arange(10, dtype="float32").reshape(10, 1)
+    dp, lp = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    onp.savetxt(dp, data, delimiter=",")
+    onp.savetxt(lp, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dp, data_shape=(2,), label_csv=lp,
+                       batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(), labels[:4])
+    # round_batch wraps the tail
+    assert batches[2].pad == 2
+    onp.testing.assert_allclose(batches[2].data[0].asnumpy()[-1], data[1])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_libsvm_iter(tmp_path):
+    import numpy as onp
+    p = str(tmp_path / "t.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:0.5\n")
+        f.write("1 2:3.0 3:1.0\n")
+        f.write("0 0:2.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=2)
+    b = next(it)
+    dense = b.data[0].tostype('default').asnumpy()
+    onp.testing.assert_allclose(dense,
+                                [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    onp.testing.assert_allclose(b.label[0].asnumpy(), [1.0, 0.0])
+    b2 = next(it)
+    onp.testing.assert_allclose(b2.data[0].tostype('default').asnumpy(),
+                                [[0, 0, 3.0, 1.0], [2.5, 0, 0, 0]])
+
+
+def test_csv_iter_no_round_batch(tmp_path):
+    import numpy as onp
+    data = onp.arange(10, dtype="float32").reshape(5, 2)
+    dp = str(tmp_path / "d.csv")
+    onp.savetxt(dp, data, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dp, data_shape=(2,), batch_size=2,
+                       round_batch=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].data[0].shape == (1, 2)   # short tail, no wrap
+    assert batches[-1].pad == 0
+    onp.testing.assert_allclose(batches[-1].data[0].asnumpy(), data[4:])
+
+
+def test_libsvm_iter_no_round_batch(tmp_path):
+    p = str(tmp_path / "t.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.0\n0 1:2.0\n1 2:3.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=2,
+                          round_batch=False)
+    b1, b2 = list(it)
+    assert b2.data[0].shape == (1, 3)
+    assert b2.pad == 0
+    onp.testing.assert_allclose(b2.data[0].tostype('default').asnumpy(),
+                                [[0, 0, 3.0]])
+
+
+def test_mnist_iter(tmp_path):
+    import numpy as onp
+    import struct
+    rs = onp.random.RandomState(0)
+    imgs = rs.randint(0, 255, (6, 4, 4)).astype(onp.uint8)
+    labels = rs.randint(0, 10, (6,)).astype(onp.uint8)
+    ip, lp = str(tmp_path / "imgs-idx3"), str(tmp_path / "labels-idx1")
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", 6, 4, 4))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", 6))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=ip, label=lp, batch_size=3)
+    b = next(it)
+    assert b.data[0].shape == (3, 1, 4, 4)
+    onp.testing.assert_allclose(b.data[0].asnumpy(),
+                                imgs[:3, None] / 255.0, rtol=1e-6)
+    onp.testing.assert_allclose(b.label[0].asnumpy(), labels[:3])
+    flat = mx.io.MNISTIter(image=ip, label=lp, batch_size=2, flat=True)
+    assert next(flat).data[0].shape == (2, 16)
+
+
+def test_image_record_iter(tmp_path):
+    import numpy as onp
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rs = onp.random.RandomState(0)
+    for i in range(5):
+        img = rs.randint(0, 255, (10, 12, 3)).astype(onp.uint8)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write(recordio.pack_img(header, img, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                               batch_size=2)
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 8, 8)
+    assert b.label[0].shape in ((2,), (2, 1))

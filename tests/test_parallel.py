@@ -294,3 +294,75 @@ def test_moe_top2_oracle():
             ref[t] += (probs[t, e] / denom) * (h @ wo[e])
     onp.testing.assert_allclose(out.asnumpy().reshape(-1, 8), ref,
                                 atol=1e-4)
+
+
+def test_scan_steps_matches_sequential():
+    """K fused steps (one executable) must equal K sequential step calls."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import scan_steps
+
+    def step(w, m, x, y):
+        g = 2 * (w * x - y) * x
+        m = 0.9 * m + g
+        w = w - 0.1 * m
+        return w, m, jnp.mean((w * x - y) ** 2)
+
+    w0 = jnp.asarray(0.5)
+    m0 = jnp.zeros(())
+    xs = jnp.asarray([1.0, 2.0, 0.5, 1.5])
+    ys = jnp.asarray([2.0, 4.0, 1.0, 3.0])
+
+    # sequential oracle
+    w, m = w0, m0
+    losses = []
+    for x, y in zip(xs, ys):
+        w, m, l = step(w, m, x, y)
+        losses.append(float(l))
+
+    loop = jax.jit(scan_steps(step, n_state=2))
+    w2, m2, lmean = loop(w0, m0, xs, ys)
+    onp.testing.assert_allclose(float(w2), float(w), rtol=1e-6)
+    onp.testing.assert_allclose(float(m2), float(m), rtol=1e-6)
+    onp.testing.assert_allclose(float(lmean), onp.mean(losses), rtol=1e-6)
+
+
+def test_sharded_train_step_steps_per_call():
+    """steps_per_call=K over stacked batches matches K single-step calls."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ShardedTrainStep, make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    def build():
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        return net
+
+    rs = onp.random.RandomState(0)
+    xs = rs.randn(2, 8, 8).astype("float32")   # K=2 stacked batches
+    ys = rs.randn(2, 8, 4).astype("float32")
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    mesh = make_mesh({"dp": min(2, len(jax.devices()))})
+
+    mx.random.seed(7)
+    a = build()
+    s1 = ShardedTrainStep(a, loss_fn, "sgd", mesh, (P("dp"), P("dp")))
+    for i in range(2):
+        s1(xs[i], ys[i])
+
+    mx.random.seed(7)   # same init as `a`
+    b = build()
+    s2 = ShardedTrainStep(b, loss_fn, "sgd", mesh, (P("dp"), P("dp")),
+                          steps_per_call=2)
+    s2(xs, ys)
+
+    for n in s1.trainable:
+        onp.testing.assert_allclose(
+            onp.asarray(s2.trainable[n]), onp.asarray(s1.trainable[n]),
+            rtol=1e-5, atol=1e-6, err_msg=n)

@@ -1,0 +1,87 @@
+"""Serialization tests: safetensors format + Block round-trips
+(reference: src/serialization/cnpy.cc territory; safetensors is the
+TPU-native portable replacement for the legacy NDArray binary format)."""
+import struct, json, os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serialization as ser
+from mxnet_tpu.gluon import nn
+
+
+def test_safetensors_roundtrip(tmp_path):
+    rs = onp.random.RandomState(0)
+    tensors = {
+        "a": rs.randn(3, 4).astype("float32"),
+        "b": rs.randint(0, 100, (5,)).astype("int64"),
+        "c": onp.asarray(True),
+        "d": rs.randn(2, 2).astype("float16"),
+    }
+    p = str(tmp_path / "t.safetensors")
+    ser.save_safetensors(p, tensors, metadata={"framework": "mxnet_tpu"})
+    back, meta = ser.load_safetensors(p, return_metadata=True)
+    assert meta["framework"] == "mxnet_tpu"
+    assert set(back) == set(tensors)
+    for k in tensors:
+        assert back[k].dtype == tensors[k].dtype
+        onp.testing.assert_array_equal(back[k], tensors[k])
+
+
+def test_safetensors_bf16(tmp_path):
+    import ml_dtypes
+    arr = onp.arange(6, dtype=onp.float32).reshape(2, 3).astype(
+        ml_dtypes.bfloat16)
+    p = str(tmp_path / "b.safetensors")
+    ser.save_safetensors(p, {"w": arr})
+    back = ser.load_safetensors(p)["w"]
+    assert back.dtype == arr.dtype
+    onp.testing.assert_array_equal(back, arr)
+
+
+def test_safetensors_wire_format(tmp_path):
+    """The on-disk layout must follow the public spec: u64 header length,
+    JSON header with dtype/shape/data_offsets, raw LE buffers."""
+    x = onp.asarray([[1.5, -2.0]], "float32")
+    p = str(tmp_path / "w.safetensors")
+    ser.save_safetensors(p, {"x": x})
+    raw = open(p, "rb").read()
+    (hlen,) = struct.unpack("<Q", raw[:8])
+    header = json.loads(raw[8:8 + hlen])
+    assert header["x"]["dtype"] == "F32"
+    assert header["x"]["shape"] == [1, 2]
+    lo, hi = header["x"]["data_offsets"]
+    vals = onp.frombuffer(raw[8 + hlen + lo:8 + hlen + hi], "<f4")
+    onp.testing.assert_array_equal(vals, [1.5, -2.0])
+
+
+def test_block_save_load_safetensors(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.np.ones((2, 5))
+    want = net(x).asnumpy()
+    p = str(tmp_path / "model.safetensors")
+    net.save_parameters(p)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net2.initialize()
+    net2(x)
+    net2.load_parameters(p)
+    onp.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_block_save_load_npz_still_works(tmp_path):
+    net = nn.Dense(4)
+    net.initialize()
+    x = mx.np.ones((1, 3))
+    want = net(x).asnumpy()
+    p = str(tmp_path / "m.params")
+    net.save_parameters(p)
+    net2 = nn.Dense(4)
+    net2.initialize()
+    net2(x)
+    net2.load_parameters(p)
+    onp.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
