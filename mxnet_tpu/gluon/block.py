@@ -176,11 +176,12 @@ class Block:
         """Reference: block.py:378."""
         import numpy as onp
         from ..numpy import array
-        path = filename if os.path.exists(filename) else filename + ".npz"
-        if path.endswith(".safetensors"):
+        if filename.endswith(".safetensors"):
             from .. import serialization
-            loaded = serialization.load_safetensors(path)
+            loaded = serialization.load_safetensors(filename)
         else:
+            path = filename if os.path.exists(filename) \
+                else filename + ".npz"
             with onp.load(path, allow_pickle=False) as data:
                 loaded = {k: data[k] for k in data.files}
         params = self.collect_params()

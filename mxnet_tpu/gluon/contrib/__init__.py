@@ -1,3 +1,4 @@
 """gluon.contrib (reference: python/mxnet/gluon/contrib/)."""
 from . import nn  # noqa: F401
 from . import estimator  # noqa: F401
+from . import data  # noqa: F401

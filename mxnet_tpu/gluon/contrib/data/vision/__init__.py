@@ -1,0 +1,7 @@
+"""gluon.contrib.data.vision (reference:
+python/mxnet/gluon/contrib/data/vision/__init__.py)."""
+from .transforms import bbox  # noqa: F401
+from .transforms.bbox import (  # noqa: F401
+    bbox_crop, bbox_flip, bbox_resize, bbox_translate,
+    ImageBboxRandomFlipLeftRight, ImageBboxCrop, ImageBboxResize,
+)

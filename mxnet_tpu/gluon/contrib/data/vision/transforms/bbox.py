@@ -1,0 +1,123 @@
+"""Joint image+bbox transforms.
+
+Reference parity: python/mxnet/gluon/contrib/data/vision/transforms/bbox/
+(bbox.py ImageBboxRandomFlipLeftRight/ImageBboxCrop/ImageBboxResize and
+utils.py bbox_crop/bbox_flip/bbox_resize/bbox_translate).  Host-side
+numpy transforms for detection pipelines; boxes are (N, 4+) corner format
+``[x1, y1, x2, y2, ...extra columns preserved]``.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ......base import MXNetError
+from ......numpy.multiarray import ndarray
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
+
+
+def bbox_crop(bbox, crop_box=None, allow_outside_center=True):
+    """Crop boxes to a (x, y, w, h) window, translating to its origin;
+    boxes whose center leaves the window are dropped when
+    allow_outside_center=False (reference: utils.py bbox_crop)."""
+    bbox = _np(bbox).copy()
+    if crop_box is None:
+        return bbox
+    if len(crop_box) != 4:
+        raise MXNetError("crop_box must be (x, y, w, h)")
+    x, y, w, h = crop_box
+    lim = onp.asarray([x, y, x + w, y + h], bbox.dtype)
+    if not allow_outside_center:
+        centers = (bbox[:, :2] + bbox[:, 2:4]) / 2
+        mask = ((centers >= lim[:2]) & (centers <= lim[2:])).all(axis=1)
+        bbox = bbox[mask]
+    bbox[:, :2] = onp.maximum(bbox[:, :2], lim[:2])
+    bbox[:, 2:4] = onp.minimum(bbox[:, 2:4], lim[2:])
+    bbox[:, :2] -= lim[:2]
+    bbox[:, 2:4] -= lim[:2]
+    keep = ((bbox[:, 2] > bbox[:, 0]) & (bbox[:, 3] > bbox[:, 1]))
+    return bbox[keep]
+
+
+def bbox_flip(bbox, size, flip_x=False, flip_y=False):
+    """Flip boxes within an image of (width, height) = size
+    (reference: utils.py bbox_flip)."""
+    bbox = _np(bbox).copy()
+    w, h = size
+    if flip_x:
+        x1 = bbox[:, 0].copy()
+        bbox[:, 0] = w - bbox[:, 2]
+        bbox[:, 2] = w - x1
+    if flip_y:
+        y1 = bbox[:, 1].copy()
+        bbox[:, 1] = h - bbox[:, 3]
+        bbox[:, 3] = h - y1
+    return bbox
+
+
+def bbox_resize(bbox, in_size, out_size):
+    """Rescale boxes from in_size=(w,h) to out_size=(w,h)
+    (reference: utils.py bbox_resize)."""
+    bbox = _np(bbox).astype("float32").copy()
+    sx = out_size[0] / in_size[0]
+    sy = out_size[1] / in_size[1]
+    bbox[:, [0, 2]] *= sx
+    bbox[:, [1, 3]] *= sy
+    return bbox
+
+
+def bbox_translate(bbox, x_offset=0, y_offset=0):
+    bbox = _np(bbox).copy()
+    bbox[:, [0, 2]] += x_offset
+    bbox[:, [1, 3]] += y_offset
+    return bbox
+
+
+class ImageBboxRandomFlipLeftRight:
+    """Random horizontal flip of (image, bbox) pairs
+    (reference: bbox.py ImageBboxRandomFlipLeftRight)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, img, bbox):
+        arr = _np(img)
+        if onp.random.rand() < self.p:
+            arr = arr[:, ::-1]
+            bbox = bbox_flip(bbox, (arr.shape[1], arr.shape[0]),
+                             flip_x=True)
+        return arr, _np(bbox)
+
+
+class ImageBboxCrop:
+    """Fixed crop of (image, bbox) (reference: bbox.py ImageBboxCrop);
+    crop is (x, y, w, h) in pixels."""
+
+    def __init__(self, crop, allow_outside_center=False):
+        self.crop = crop
+        self.allow = allow_outside_center
+
+    def __call__(self, img, bbox):
+        arr = _np(img)
+        x, y, w, h = self.crop
+        return (arr[y:y + h, x:x + w],
+                bbox_crop(bbox, self.crop, self.allow))
+
+
+class ImageBboxResize:
+    """Resize image to (width, height) and rescale boxes
+    (reference: bbox.py ImageBboxResize)."""
+
+    def __init__(self, width, height, interp=1):
+        self.size = (width, height)
+        self.interp = interp
+
+    def __call__(self, img, bbox):
+        from ...... import image as img_mod
+        arr = _np(img)
+        in_size = (arr.shape[1], arr.shape[0])
+        out = img_mod.imresize(arr, self.size[0], self.size[1],
+                               interp=self.interp)
+        return _np(out), bbox_resize(bbox, in_size, self.size)

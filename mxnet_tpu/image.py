@@ -105,7 +105,8 @@ def random_crop(src, size, interp=2):
 
 
 def color_normalize(src, mean, std=None):
-    src = src - mean
+    if mean is not None:
+        src = src - mean
     if std is not None:
         src = src / std
     return src

@@ -309,6 +309,14 @@ class LibSVMIter(DataIter):
         self._round = round_batch
         self._cursor = 0
 
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [("label", (self.batch_size,))]
+
     def reset(self):
         self._cursor = 0
 
@@ -372,9 +380,18 @@ class MNISTIter(DataIter):
             self._images = self._images[:, None, :, :]  # NCHW
         self._order = onp.arange(len(self._images))
         self._shuffle = shuffle
+        self._sample_shape = self._images.shape[1:]
         self._rng = onp.random.RandomState(seed)
         self._cursor = 0
         self.reset()
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self._sample_shape)]
+
+    @property
+    def provide_label(self):
+        return [("label", (self.batch_size,))]
 
     def reset(self):
         self._cursor = 0
@@ -408,7 +425,8 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
     from .. import image as img_mod
     mean = (onp.array([mean_r, mean_g, mean_b], "float32")
             if (mean_r or mean_g or mean_b) else None)
-    std = (onp.array([std_r, std_g, std_b], "float32")
+    # unset std channels default to 1 (reference defaults), never 0
+    std = (onp.array([std_r or 1.0, std_g or 1.0, std_b or 1.0], "float32")
            if (std_r or std_g or std_b) else None)
     aug = img_mod.CreateAugmenter(
         data_shape, resize=resize, rand_crop=rand_crop,

@@ -994,6 +994,8 @@ def constraint_check(data, msg="Constraint violated!"):
 
 from ..ops.quantization import (  # noqa: E402
     quantize_v2, dequantize, quantized_fully_connected, quantized_conv)
+from ..ops.bbox import (  # noqa: E402
+    box_iou, box_nms, box_encode, box_decode, bipartite_matching)
 
 
 def nonzero(data):

@@ -1,0 +1,190 @@
+"""Bounding-box ops.
+
+Reference parity: src/operator/contrib/bounding_box.cc (_contrib_box_iou,
+_contrib_box_nms, _contrib_box_encode, _contrib_box_decode,
+_contrib_bipartite_matching) — the op layer under the reference's
+detection models and gluon/contrib/data/vision bbox transforms.
+
+TPU-native design: every op is static-shaped and jit/vmap-friendly.  NMS
+returns the reference's in-place convention (suppressed boxes keep their
+slot with score -1) instead of a data-dependent-size output, which is
+exactly what maps onto XLA: an O(N^2) IoU matrix plus a
+``lax.fori_loop`` greedy pass over sorted candidates.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..numpy.multiarray import _invoke
+
+__all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
+           "bipartite_matching"]
+
+
+def _corner(boxes, fmt):
+    """-> (x1, y1, x2, y2)."""
+    if fmt == "corner":
+        return boxes
+    # center: (cx, cy, w, h)
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+def _iou_impl(lhs, rhs):
+    """(..., N, 4) x (..., M, 4) corner boxes -> (..., N, M) IoU."""
+    lt = jnp.maximum(lhs[..., :, None, :2], rhs[..., None, :, :2])
+    rb = jnp.minimum(lhs[..., :, None, 2:], rhs[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = jnp.prod(jnp.maximum(lhs[..., 2:] - lhs[..., :2], 0), axis=-1)
+    area_r = jnp.prod(jnp.maximum(rhs[..., 2:] - rhs[..., :2], 0), axis=-1)
+    union = area_l[..., :, None] + area_r[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002 - reference kwarg name
+    """Pairwise IoU (reference: _contrib_box_iou)."""
+    def fn(a, b):
+        return _iou_impl(_corner(a, format), _corner(b, format))
+    return _invoke(fn, (lhs, rhs), name="box_iou")
+
+
+def _nms_one(data, overlap_thresh, valid_thresh, topk, coord_start,
+             score_index, id_index, force_suppress, in_format):
+    """NMS over one (N, K) box set, matching the reference output
+    convention (src/operator/contrib/bounding_box-inl.h BoxNMSForward):
+    rows sorted by descending score, suppressed/invalid rows entirely
+    filled with -1."""
+    n = data.shape[0]
+    scores = data[:, score_index]
+    valid = scores > valid_thresh
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    sorted_data = data[order]
+    boxes = _corner(sorted_data[:, coord_start:coord_start + 4], in_format)
+    iou = _iou_impl(boxes, boxes)
+    if id_index >= 0 and not force_suppress:
+        ids = sorted_data[:, id_index]
+        same = ids[:, None] == ids[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    valid_sorted = valid[order]
+    if topk > 0:
+        valid_sorted = valid_sorted & (jnp.arange(n) < topk)
+
+    def body(i, keep):
+        # suppress j>i overlapping box i, if box i itself is kept
+        sup = (iou[i] > overlap_thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, n, body, valid_sorted)
+    return jnp.where(keep[:, None], sorted_data, -1.0)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner"):
+    """Non-maximum suppression (reference: _contrib_box_nms).
+
+    data: (..., N, K) where each row holds [.., score, .., x1,y1,x2,y2 ..]
+    per ``score_index``/``coord_start``.  Output follows the reference:
+    rows sorted by descending score with suppressed/invalid rows filled
+    with -1 (static output shape — TPU/jit friendly).
+    """
+    def fn(d):
+        flat = d.reshape((-1,) + d.shape[-2:])
+        out = jax.vmap(lambda one: _nms_one(
+            one, overlap_thresh, valid_thresh, topk, coord_start,
+            score_index, id_index, force_suppress, in_format))(flat)
+        return out.reshape(d.shape)
+    return _invoke(fn, (data,), name="box_nms")
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """SSD-style target encoding (reference: _contrib_box_encode):
+    corner anchors/refs -> normalized (dx, dy, dw, dh) targets + masks.
+
+    samples: (B, N) in {+1 pos, -1 neg, 0 ignore}; matches: (B, N)
+    indices into refs; anchors (B, N, 4), refs (B, M, 4) corner format.
+    """
+    def fn(s, m, a, r):
+        ref = jnp.take_along_axis(r, m[..., None].astype(jnp.int32), axis=1)
+        ax1, ay1, ax2, ay2 = jnp.split(a, 4, -1)
+        rx1, ry1, rx2, ry2 = jnp.split(ref, 4, -1)
+        aw, ah = ax2 - ax1, ay2 - ay1
+        acx, acy = ax1 + aw / 2, ay1 + ah / 2
+        rw, rh = rx2 - rx1, ry2 - ry1
+        rcx, rcy = rx1 + rw / 2, ry1 + rh / 2
+        t = jnp.concatenate([
+            ((rcx - acx) / aw - means[0]) / stds[0],
+            ((rcy - acy) / ah - means[1]) / stds[1],
+            (jnp.log(rw / aw) - means[2]) / stds[2],
+            (jnp.log(rh / ah) - means[3]) / stds[3]], axis=-1)
+        mask = (s > 0.5)[..., None].astype(t.dtype) * jnp.ones_like(t)
+        return jnp.where(mask > 0, t, 0.0), mask
+    return _invoke(fn, (samples, matches, anchors, refs), name="box_encode")
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="center"):  # noqa: A002
+    """Decode (dx,dy,dw,dh) predictions against anchors (reference:
+    _contrib_box_decode); anchors given in `format`, output corner."""
+    def fn(d, a):
+        if format == "corner":
+            x1, y1, x2, y2 = jnp.split(a, 4, -1)
+            aw, ah = x2 - x1, y2 - y1
+            acx, acy = x1 + aw / 2, y1 + ah / 2
+        else:
+            acx, acy, aw, ah = jnp.split(a, 4, -1)
+        dx, dy, dw, dh = jnp.split(d, 4, -1)
+        cx = dx * std0 * aw + acx
+        cy = dy * std1 * ah + acy
+        # the reference clips the scaled log-delta BEFORE exp
+        # (bounding_box-inl.h BoxDecode; GluonCV NormalizedBoxCenterDecoder)
+        dw_s, dh_s = dw * std2, dh * std3
+        lim = clip if clip > 0 else 10.0
+        w = jnp.exp(jnp.minimum(dw_s, lim)) * aw
+        h = jnp.exp(jnp.minimum(dh_s, lim)) * ah
+        return jnp.concatenate(
+            [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    return _invoke(fn, (data, anchors), name="box_decode")
+
+
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a (..., N, M) affinity matrix
+    (reference: _contrib_bipartite_matching): each round picks the global
+    best pair, removing its row and column.  Returns (row_match, col_match)
+    where row_match[i] = matched column or -1.
+    """
+    def one(mat):
+        n, m = mat.shape
+        k = min(n, m) if topk <= 0 else min(topk, n, m)
+        big = jnp.inf if is_ascend else -jnp.inf
+
+        def body(_, carry):
+            work, rows, cols = carry
+            flat = jnp.argmin(work) if is_ascend else jnp.argmax(work)
+            i, j = flat // m, flat % m
+            val = work[i, j]
+            good = (val < threshold) if is_ascend else (val > threshold)
+            rows = jnp.where(good, rows.at[i].set(j.astype(jnp.float32)),
+                             rows)
+            cols = jnp.where(good, cols.at[j].set(i.astype(jnp.float32)),
+                             cols)
+            work = work.at[i, :].set(big)
+            work = work.at[:, j].set(big)
+            return work, rows, cols
+
+        rows = jnp.full((n,), -1.0)
+        cols = jnp.full((m,), -1.0)
+        _, rows, cols = lax.fori_loop(0, k, body, (mat, rows, cols))
+        return rows, cols
+
+    def fn(d):
+        flat = d.reshape((-1,) + d.shape[-2:])
+        rows, cols = jax.vmap(one)(flat)
+        return (rows.reshape(d.shape[:-1]),
+                cols.reshape(d.shape[:-2] + (d.shape[-1],)))
+    return _invoke(fn, (data,), name="bipartite_matching")

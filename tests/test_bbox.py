@@ -1,0 +1,174 @@
+"""Bounding-box op tests with numpy brute-force oracles
+(reference: tests of src/operator/contrib/bounding_box.cc ops in
+tests/python/unittest/test_contrib_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import npx
+
+
+def _iou_np(a, b):
+    x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+    inter = max(0, x2 - x1) * max(0, y2 - y1)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_iou_oracle():
+    rs = onp.random.RandomState(0)
+    a = rs.rand(5, 4).astype("float32"); a[:, 2:] += a[:, :2]
+    b = rs.rand(7, 4).astype("float32"); b[:, 2:] += b[:, :2]
+    got = npx.box_iou(mx.np.array(a), mx.np.array(b)).asnumpy()
+    want = onp.array([[_iou_np(x, y) for y in b] for x in a])
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_center_format():
+    a = onp.array([[0.5, 0.5, 1.0, 1.0]], "float32")     # center form
+    b = onp.array([[0.0, 0.0, 1.0, 1.0]], "float32")     # corner form of same
+    got = npx.box_iou(mx.np.array(a), mx.np.array(a), format="center")
+    onp.testing.assert_allclose(got.asnumpy(), [[1.0]], rtol=1e-6)
+    got2 = npx.box_iou(mx.np.array(b), mx.np.array(b), format="corner")
+    onp.testing.assert_allclose(got2.asnumpy(), [[1.0]], rtol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [cls_id, score, x1, y1, x2, y2]
+    data = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.05, 1.05],   # overlaps the first -> suppressed
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],       # far away -> kept
+        [1, 0.6, 0.0, 0.0, 1.0, 1.0],       # other class -> kept
+    ], "float32")
+    out = npx.box_nms(mx.np.array(data), overlap_thresh=0.5,
+                      id_index=0).asnumpy()
+    # reference convention: rows sorted by score desc; suppressed rows
+    # entirely -1
+    assert out[0, 1] == pytest.approx(0.9)
+    onp.testing.assert_allclose(out[1], -onp.ones(6))   # suppressed row
+    assert out[2, 1] == pytest.approx(0.7)
+    assert out[3, 1] == pytest.approx(0.6)
+    onp.testing.assert_allclose(out[0, 2:], data[0, 2:])  # coords intact
+    # force_suppress ignores class ids
+    out2 = npx.box_nms(mx.np.array(data), overlap_thresh=0.5, id_index=0,
+                       force_suppress=True).asnumpy()
+    onp.testing.assert_allclose(out2[3], -onp.ones(6))
+
+
+def test_box_nms_valid_thresh_and_topk():
+    data = onp.array([
+        [0.9, 0.0, 0.0, 1.0, 1.0],
+        [0.5, 2.0, 2.0, 3.0, 3.0],
+        [0.05, 4.0, 4.0, 5.0, 5.0],          # below valid_thresh
+    ], "float32")
+    out = npx.box_nms(mx.np.array(data), overlap_thresh=0.5,
+                      valid_thresh=0.1, topk=2, coord_start=1,
+                      score_index=0).asnumpy()
+    assert out[0, 0] == pytest.approx(0.9)
+    assert out[1, 0] == pytest.approx(0.5)
+    onp.testing.assert_allclose(out[2], -onp.ones(5))
+
+
+def test_box_nms_sorts_by_score():
+    """Unsorted input comes back score-sorted (reference convention) so
+    the post-NMS `slice first k` pattern works."""
+    data = onp.array([
+        [0.2, 5.0, 5.0, 6.0, 6.0],
+        [0.9, 0.0, 0.0, 1.0, 1.0],
+        [0.5, 2.0, 2.0, 3.0, 3.0],
+    ], "float32")
+    out = npx.box_nms(mx.np.array(data), overlap_thresh=0.5,
+                      coord_start=1, score_index=0).asnumpy()
+    onp.testing.assert_allclose(out[:, 0], [0.9, 0.5, 0.2])
+    onp.testing.assert_allclose(out[0, 1:], data[1, 1:])
+
+
+def test_box_decode_clips_in_log_space():
+    """clip applies to the scaled log-delta before exp (reference
+    BoxDecode), not to the decoded width."""
+    anchors = onp.array([[[0.0, 0.0, 1.0, 1.0]]], "float32")
+    pred = onp.array([[[0.0, 0.0, 30.0, 0.0]]], "float32")  # dw*std2 = 6
+    out = npx.box_decode(mx.np.array(pred), mx.np.array(anchors),
+                         clip=2.0, format="corner").asnumpy()
+    w = out[0, 0, 2] - out[0, 0, 0]
+    onp.testing.assert_allclose(w, onp.exp(2.0), rtol=1e-5)
+
+
+def test_box_nms_batched():
+    rs = onp.random.RandomState(1)
+    data = rs.rand(2, 3, 10, 6).astype("float32")
+    data[..., 2:4] *= 0.5
+    data[..., 4:] = data[..., 2:4] + 0.5
+    out = npx.box_nms(mx.np.array(data), overlap_thresh=0.9)
+    assert out.shape == data.shape
+
+
+def test_box_encode_decode_roundtrip():
+    anchors = onp.array([[[0.0, 0.0, 1.0, 1.0],
+                          [1.0, 1.0, 3.0, 2.0]]], "float32")
+    refs = onp.array([[[0.1, 0.1, 1.2, 0.9],
+                       [1.1, 0.8, 2.9, 2.2]]], "float32")
+    samples = onp.ones((1, 2), "float32")
+    matches = onp.array([[0, 1]], "float32")
+    targets, masks = npx.box_encode(
+        mx.np.array(samples), mx.np.array(matches),
+        mx.np.array(anchors), mx.np.array(refs))
+    assert masks.asnumpy().min() == 1.0
+    decoded = npx.box_decode(targets, mx.np.array(anchors),
+                             format="corner").asnumpy()
+    onp.testing.assert_allclose(decoded, refs, rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_matching():
+    score = onp.array([[0.9, 0.2, 0.1],
+                       [0.8, 0.7, 0.3]], "float32")
+    rows, cols = npx.bipartite_matching(mx.np.array(score), threshold=0.05)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    onp.testing.assert_allclose(rows.asnumpy(), [0.0, 1.0])
+    onp.testing.assert_allclose(cols.asnumpy(), [0.0, 1.0, -1.0])
+
+
+def test_bipartite_matching_threshold_blocks_weak():
+    score = onp.array([[0.9, 0.0], [0.0, 0.01]], "float32")
+    rows, cols = npx.bipartite_matching(mx.np.array(score), threshold=0.05)
+    onp.testing.assert_allclose(rows.asnumpy(), [0.0, -1.0])
+    onp.testing.assert_allclose(cols.asnumpy(), [0.0, -1.0])
+
+
+def test_bbox_transform_utils():
+    from mxnet_tpu.gluon.contrib.data.vision import (
+        bbox_crop, bbox_flip, bbox_resize)
+    boxes = onp.array([[10, 10, 30, 40, 1.0],
+                       [50, 60, 90, 100, 2.0]], "float32")
+    # flip x within a 100x120 image
+    flipped = bbox_flip(boxes, (100, 120), flip_x=True)
+    onp.testing.assert_allclose(flipped[0, :4], [70, 10, 90, 40])
+    assert flipped[0, 4] == 1.0  # extra columns preserved
+    # crop to window (0,0,60,80): second box clipped, translated
+    cropped = bbox_crop(boxes, (0, 0, 60, 80))
+    onp.testing.assert_allclose(cropped[1, :4], [50, 60, 60, 80])
+    # crop dropping outside-center boxes
+    tight = bbox_crop(boxes, (0, 0, 35, 45), allow_outside_center=False)
+    assert len(tight) == 1
+    # resize from 100x120 to 50x60 halves coordinates
+    resized = bbox_resize(boxes, (100, 120), (50, 60))
+    onp.testing.assert_allclose(resized[0, :4], [5, 5, 15, 20])
+
+
+def test_image_bbox_transforms():
+    from mxnet_tpu.gluon.contrib.data.vision import (
+        ImageBboxCrop, ImageBboxResize, ImageBboxRandomFlipLeftRight)
+    rs = onp.random.RandomState(0)
+    img = rs.randint(0, 255, (40, 60, 3)).astype(onp.uint8)
+    boxes = onp.array([[10, 10, 30, 30]], "float32")
+    ci, cb = ImageBboxCrop((5, 5, 30, 30))(img, boxes)
+    assert ci.shape == (30, 30, 3)
+    onp.testing.assert_allclose(cb[0], [5, 5, 25, 25])
+    ri, rb = ImageBboxResize(30, 20)(img, boxes)
+    assert ri.shape[:2] == (20, 30)
+    onp.testing.assert_allclose(rb[0], [5, 5, 15, 15])
+    fi, fb = ImageBboxRandomFlipLeftRight(p=1.0)(img, boxes)
+    onp.testing.assert_allclose(fb[0], [30, 10, 50, 30])
+    onp.testing.assert_array_equal(fi, img[:, ::-1])

@@ -210,3 +210,42 @@ def test_image_record_iter(tmp_path):
     b = next(it)
     assert b.data[0].shape == (2, 3, 8, 8)
     assert b.label[0].shape in ((2,), (2, 1))
+
+
+def test_iterators_provide_data_label(tmp_path):
+    import numpy as onp
+    p = str(tmp_path / "t.libsvm")
+    with open(p, "w") as f:
+        f.write("1 0:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(3,), batch_size=2)
+    assert it.provide_data[0][1] == (2, 3)
+    assert it.provide_label[0][1] == (2,)
+
+
+def test_image_record_iter_partial_std(tmp_path):
+    """Specifying one std channel must not zero-divide the others."""
+    import numpy as onp
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "i.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    img = onp.full((8, 8, 3), 128, onp.uint8)
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img,
+                                img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                               batch_size=1, std_b=2.0)
+    arr = next(it).data[0].asnumpy()
+    assert onp.isfinite(arr).all()
+
+
+def test_load_parameters_missing_safetensors_error(tmp_path):
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2)
+    net.initialize()
+    net(mx.np.ones((1, 2)))
+    missing = str(tmp_path / "nope.safetensors")
+    try:
+        net.load_parameters(missing)
+        assert False, "expected FileNotFoundError"
+    except FileNotFoundError as e:
+        assert "nope.safetensors" in str(e) and ".npz" not in str(e)
