@@ -58,6 +58,7 @@ from . import io
 from . import image
 from . import contrib
 from . import serialization
+from . import storage
 try:
     from . import onnx
 except ImportError:  # protobuf missing: degrade the feature, not the package

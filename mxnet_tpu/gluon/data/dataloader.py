@@ -23,13 +23,25 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 
 def default_batchify_fn(data):
-    """Stack samples (reference: dataloader.py default_batchify_fn)."""
+    """Stack samples (reference: dataloader.py default_batchify_fn).
+
+    numpy samples assemble into a pooled host staging buffer
+    (mx.storage, the cpu_pinned/CommCPU-merge-buffer analog) so repeated
+    batches recycle one aligned block instead of re-mallocing."""
     if isinstance(data[0], ndarray):
         return _np.stack(data)
     if isinstance(data[0], (tuple, list)):
         return type(data[0])(default_batchify_fn(list(x)) for x in zip(*data))
-    arr = onp.asarray(data)
-    return _np.array(arr)
+    first = onp.asarray(data[0])
+    if first.size and all(isinstance(d, onp.ndarray)
+                          and d.shape == first.shape
+                          and d.dtype == first.dtype for d in data):
+        from ... import storage
+        out = storage.pinned_array((len(data),) + first.shape, first.dtype)
+        for i, d in enumerate(data):
+            out[i] = d
+        return _np.array(out)
+    return _np.array(onp.asarray(data))
 
 
 def default_mp_batchify_fn(data):

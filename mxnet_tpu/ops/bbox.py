@@ -142,11 +142,14 @@ def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
         cx = dx * std0 * aw + acx
         cy = dy * std1 * ah + acy
         # the reference clips the scaled log-delta BEFORE exp
-        # (bounding_box-inl.h BoxDecode; GluonCV NormalizedBoxCenterDecoder)
+        # (bounding_box-inl.h BoxDecode; GluonCV NormalizedBoxCenterDecoder);
+        # clip <= 0 means no clipping at all
         dw_s, dh_s = dw * std2, dh * std3
-        lim = clip if clip > 0 else 10.0
-        w = jnp.exp(jnp.minimum(dw_s, lim)) * aw
-        h = jnp.exp(jnp.minimum(dh_s, lim)) * ah
+        if clip > 0:
+            dw_s = jnp.minimum(dw_s, clip)
+            dh_s = jnp.minimum(dh_s, clip)
+        w = jnp.exp(dw_s) * aw
+        h = jnp.exp(dh_s) * ah
         return jnp.concatenate(
             [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
     return _invoke(fn, (data, anchors), name="box_decode")

@@ -1,0 +1,163 @@
+"""mx.storage — host-memory pool (the storage-manager component).
+
+Reference parity: src/storage/ (Storage::Alloc/Free/DirectFree,
+PooledStorageManager with RoundPower2 bucketing selected by
+MXNET_CPU_MEM_POOL_TYPE, stats via the storage profiler).  TPU-native
+split of responsibilities: device (HBM) allocation belongs to PJRT/XLA —
+there is nothing to manage there from python — while HOST staging memory
+(batch assembly, IO readahead) benefits from exactly the reference's
+pooled recycling.  The pool itself is native C++
+(native/mxtpu_pool.cc), loaded on demand; when the toolchain is missing
+everything degrades to plain numpy allocation.
+
+    buf = mx.storage.alloc(nbytes)        # pooled aligned host block
+    arr = mx.storage.pinned_array((64, 3, 224, 224), "float32")
+    mx.storage.pool_stats()               # in_use/cached/hits/misses
+    mx.storage.empty_cache()              # DirectFree analog
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as onp
+
+from . import config
+from .base import MXNetError
+
+config.declare("storage.pool_type", str, "round_power2",
+               "MXNET_CPU_MEM_POOL_TYPE",
+               "Host staging pool strategy: 'naive' (pass-through) or "
+               "'round_power2' (bucketed reuse; reference "
+               "pooled_storage_manager.h).")
+
+_lock = threading.Lock()
+_pool = None
+_lib = None
+
+
+def _ensure_pool():
+    global _pool, _lib
+    with _lock:
+        if _pool is not None:
+            return _pool, _lib
+        from . import native
+        lib = native.load("mxtpu_pool")
+        if lib is None:
+            _pool, _lib = 0, None   # sentinel: fallback mode
+            return _pool, _lib
+        lib.mxtpu_pool_create.restype = ctypes.c_void_p
+        lib.mxtpu_pool_create.argtypes = [ctypes.c_int]
+        lib.mxtpu_pool_alloc.restype = ctypes.c_void_p
+        lib.mxtpu_pool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.mxtpu_pool_free.restype = ctypes.c_int
+        lib.mxtpu_pool_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.mxtpu_pool_empty.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pool_stat.restype = ctypes.c_uint64
+        lib.mxtpu_pool_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        strategy = 0 if config.get("storage.pool_type") == "naive" else 1
+        _pool = lib.mxtpu_pool_create(strategy)
+        _lib = lib
+        return _pool, _lib
+
+
+class HostBuffer:
+    """An aligned pooled host block (Storage::Handle analog)."""
+
+    def __init__(self, ptr, nbytes, pool=None, lib=None):
+        self.ptr = ptr
+        self.nbytes = nbytes
+        # pool/lib captured at alloc time: free() must never touch
+        # _ensure_pool's lock (it can run from __del__ mid-allocation)
+        self._pool = pool
+        self._lib = lib
+        self._freed = False
+
+    def as_numpy(self, shape, dtype="uint8"):
+        """View the block as a numpy array (no copy)."""
+        dt = onp.dtype(dtype)
+        count = int(onp.prod(shape)) if shape else 1
+        if count * dt.itemsize > self.nbytes:
+            raise MXNetError("view exceeds buffer size")
+        buf = (ctypes.c_uint8 * self.nbytes).from_address(self.ptr)
+        arr = onp.frombuffer(buf, dtype=dt, count=count).reshape(shape)
+        arr.flags.writeable = True
+        return arr
+
+    def free(self):
+        if self._freed:
+            return
+        if self._lib is not None:
+            self._lib.mxtpu_pool_free(self._pool, ctypes.c_void_p(self.ptr))
+        self._freed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.free()
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+def alloc(nbytes):
+    """Allocate a pooled host block (Storage::Alloc analog)."""
+    if nbytes <= 0:
+        raise MXNetError("alloc needs nbytes > 0")
+    pool, lib = _ensure_pool()
+    if lib is None:   # no toolchain: numpy-backed fallback
+        arr = onp.empty(nbytes, onp.uint8)
+        hb = HostBuffer(arr.ctypes.data, nbytes)
+        hb._keepalive = arr   # the numpy array owns the memory
+        hb._freed = True      # nothing to return to a pool
+        return hb
+    ptr = lib.mxtpu_pool_alloc(pool, nbytes)
+    if not ptr:
+        raise MemoryError(f"pool alloc of {nbytes} bytes failed")
+    return HostBuffer(ptr, nbytes, pool=pool, lib=lib)
+
+
+def pinned_array(shape, dtype="float32"):
+    """numpy array backed by a pooled block; `.base_buffer` keeps it
+    alive and returns it to the pool when the array is dropped."""
+    dt = onp.dtype(dtype)
+    nbytes = int(onp.prod(shape)) * dt.itemsize
+    hb = alloc(max(nbytes, 1))
+    return _PooledArray(hb.as_numpy(shape, dtype), hb)
+
+
+class _PooledArray(onp.ndarray):
+    """ndarray subclass that returns its block to the pool on collection."""
+
+    def __new__(cls, arr, hb):
+        obj = arr.view(cls)
+        obj._hb = hb
+        return obj
+
+    def __array_finalize__(self, obj):
+        if obj is not None:
+            self._hb = getattr(obj, "_hb", None)
+
+
+def pool_stats():
+    pool, lib = _ensure_pool()
+    if lib is None:
+        return {"in_use": 0, "cached": 0, "hits": 0, "misses": 0,
+                "native": False}
+    return {"in_use": int(lib.mxtpu_pool_stat(pool, 0)),
+            "cached": int(lib.mxtpu_pool_stat(pool, 1)),
+            "hits": int(lib.mxtpu_pool_stat(pool, 2)),
+            "misses": int(lib.mxtpu_pool_stat(pool, 3)),
+            "native": True}
+
+
+def empty_cache():
+    """Release cached (free-listed) blocks back to the OS
+    (Storage::DirectFree analog)."""
+    pool, lib = _ensure_pool()
+    if lib is not None:
+        lib.mxtpu_pool_empty(pool)

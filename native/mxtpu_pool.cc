@@ -1,0 +1,171 @@
+// Pooled host-memory allocator.
+//
+// Reference parity: src/storage/pooled_storage_manager.h
+// (PooledStorageManager<RoundPower2/RoundMultiple>, per-device pools
+// selected by MXNET_*_MEM_POOL_TYPE, stats via storage_profiler).  On the
+// TPU stack device memory belongs to PJRT, so the pool's remaining real
+// job is HOST staging buffers: batch assembly and IO readahead reuse
+// aligned recycled blocks instead of hitting malloc for every batch.
+//
+// Strategy 0 ("naive"): pass-through aligned_alloc/free.
+// Strategy 1 ("round_power2"): size rounded up to a power of two; freed
+// blocks are kept in per-class free lists for reuse (DirectFree analog:
+// mxtpu_pool_empty).
+//
+// Built on demand by mxnet_tpu.native (g++ -O3 -shared); no external deps.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kAlign = 64;
+constexpr int kClasses = 48;  // up to 2^47 bytes
+
+// padded to kAlign so the payload after the header stays 64-byte aligned
+struct alignas(kAlign) Header {
+  uint64_t size_class;  // index into free lists, or raw size for naive
+  uint64_t magic;
+};
+static_assert(sizeof(Header) == kAlign, "payload alignment relies on this");
+constexpr uint64_t kMagic = 0x6d787470756f6c21ULL;  // "mxtpuol!"
+
+struct Pool {
+  int strategy;
+  std::mutex mu;
+  std::vector<void*> free_lists[kClasses];
+  uint64_t in_use = 0;       // bytes handed out
+  uint64_t cached = 0;       // bytes parked in free lists
+  uint64_t hits = 0;
+  uint64_t misses = 0;
+};
+
+int size_class_of(uint64_t nbytes) {
+  int c = 0;
+  uint64_t s = 1;
+  while (s < nbytes && c < kClasses - 1) {
+    s <<= 1;
+    ++c;
+  }
+  return c;
+}
+
+void* raw_alloc(uint64_t payload) {
+  uint64_t total = sizeof(Header) + payload;
+  total = (total + kAlign - 1) / kAlign * kAlign;
+  void* base = std::aligned_alloc(kAlign, total);
+  return base;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_pool_create(int strategy) {
+  return new (std::nothrow) Pool{strategy};
+}
+
+void* mxtpu_pool_alloc(void* pool_, uint64_t nbytes) {
+  auto* pool = static_cast<Pool*>(pool_);
+  if (!pool || nbytes == 0) return nullptr;
+  if (pool->strategy == 0) {
+    void* base = raw_alloc(nbytes);
+    if (!base) return nullptr;
+    auto* h = static_cast<Header*>(base);
+    h->size_class = nbytes;
+    h->magic = kMagic;
+    std::lock_guard<std::mutex> g(pool->mu);
+    pool->in_use += nbytes;
+    ++pool->misses;
+    return static_cast<char*>(base) + sizeof(Header);
+  }
+  int cls = size_class_of(nbytes);
+  uint64_t rounded = 1ULL << cls;
+  {
+    std::lock_guard<std::mutex> g(pool->mu);
+    auto& fl = pool->free_lists[cls];
+    if (!fl.empty()) {
+      void* base = fl.back();
+      fl.pop_back();
+      pool->cached -= rounded;
+      pool->in_use += rounded;
+      ++pool->hits;
+      auto* h = static_cast<Header*>(base);
+      h->size_class = cls;
+      h->magic = kMagic;
+      return static_cast<char*>(base) + sizeof(Header);
+    }
+    ++pool->misses;
+    pool->in_use += rounded;
+  }
+  void* base = raw_alloc(rounded);
+  if (!base) {
+    std::lock_guard<std::mutex> g(pool->mu);
+    pool->in_use -= rounded;
+    return nullptr;
+  }
+  auto* h = static_cast<Header*>(base);
+  h->size_class = cls;
+  h->magic = kMagic;
+  return static_cast<char*>(base) + sizeof(Header);
+}
+
+int mxtpu_pool_free(void* pool_, void* ptr) {
+  auto* pool = static_cast<Pool*>(pool_);
+  if (!pool || !ptr) return -1;
+  void* base = static_cast<char*>(ptr) - sizeof(Header);
+  auto* h = static_cast<Header*>(base);
+  if (h->magic != kMagic) return -1;
+  if (pool->strategy == 0) {
+    std::lock_guard<std::mutex> g(pool->mu);
+    pool->in_use -= h->size_class;
+    h->magic = 0;
+    std::free(base);
+    return 0;
+  }
+  uint64_t cls = h->size_class;
+  uint64_t rounded = 1ULL << cls;
+  h->magic = 0;  // reject double free (restored when reused from the list)
+  std::lock_guard<std::mutex> g(pool->mu);
+  pool->in_use -= rounded;
+  pool->cached += rounded;
+  pool->free_lists[cls].push_back(base);
+  return 0;
+}
+
+void mxtpu_pool_empty(void* pool_) {
+  auto* pool = static_cast<Pool*>(pool_);
+  if (!pool) return;
+  std::lock_guard<std::mutex> g(pool->mu);
+  for (auto& fl : pool->free_lists) {
+    for (void* base : fl) std::free(base);
+    fl.clear();
+  }
+  pool->cached = 0;
+}
+
+uint64_t mxtpu_pool_stat(void* pool_, int which) {
+  auto* pool = static_cast<Pool*>(pool_);
+  if (!pool) return 0;
+  std::lock_guard<std::mutex> g(pool->mu);
+  switch (which) {
+    case 0: return pool->in_use;
+    case 1: return pool->cached;
+    case 2: return pool->hits;
+    case 3: return pool->misses;
+    default: return 0;
+  }
+}
+
+void mxtpu_pool_destroy(void* pool_) {
+  auto* pool = static_cast<Pool*>(pool_);
+  if (!pool) return;
+  mxtpu_pool_empty(pool);
+  delete pool;
+}
+
+}  // extern "C"

@@ -26,12 +26,20 @@ def test_box_iou_oracle():
 
 
 def test_box_iou_center_format():
-    a = onp.array([[0.5, 0.5, 1.0, 1.0]], "float32")     # center form
-    b = onp.array([[0.0, 0.0, 1.0, 1.0]], "float32")     # corner form of same
-    got = npx.box_iou(mx.np.array(a), mx.np.array(a), format="center")
-    onp.testing.assert_allclose(got.asnumpy(), [[1.0]], rtol=1e-6)
-    got2 = npx.box_iou(mx.np.array(b), mx.np.array(b), format="corner")
-    onp.testing.assert_allclose(got2.asnumpy(), [[1.0]], rtol=1e-6)
+    # center (0.75, 0.75, w=0.5, h=0.5) == corner (0.5, 0.5, 1.0, 1.0);
+    # cross-compare against a half-overlapping corner box so a format
+    # mix-up changes the answer
+    center = onp.array([[0.75, 0.75, 0.5, 0.5]], "float32")
+    corner = onp.array([[0.5, 0.5, 1.0, 1.0]], "float32")
+    other = onp.array([[0.5, 0.5, 0.75, 1.0]], "float32")   # corner, IoU 0.5
+    # box_iou converts BOTH args per `format`; pass `other` in center form
+    other_center = onp.array([[0.625, 0.75, 0.25, 0.5]], "float32")
+    got = npx.box_iou(mx.np.array(center), mx.np.array(other_center),
+                      format="center")
+    want = npx.box_iou(mx.np.array(corner), mx.np.array(other),
+                       format="corner")
+    onp.testing.assert_allclose(got.asnumpy(), want.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(want.asnumpy(), [[0.5]], rtol=1e-6)
 
 
 def test_box_nms_suppresses_overlaps():
@@ -172,3 +180,14 @@ def test_image_bbox_transforms():
     fi, fb = ImageBboxRandomFlipLeftRight(p=1.0)(img, boxes)
     onp.testing.assert_allclose(fb[0], [30, 10, 50, 30])
     onp.testing.assert_array_equal(fi, img[:, ::-1])
+
+
+def test_box_decode_no_clip_by_default():
+    """clip=-1 (default) must not cap large deltas (reference: clip<=0
+    means no clipping in _contrib_box_decode)."""
+    anchors = onp.array([[[0.0, 0.0, 1.0, 1.0]]], "float32")
+    pred = onp.array([[[0.0, 0.0, 60.0, 0.0]]], "float32")  # dw*std2 = 12
+    out = npx.box_decode(mx.np.array(pred), mx.np.array(anchors),
+                         format="corner").asnumpy()
+    w = out[0, 0, 2] - out[0, 0, 0]
+    onp.testing.assert_allclose(w, onp.exp(12.0), rtol=1e-4)

@@ -1,0 +1,116 @@
+"""Storage-manager tests (mx.storage over native/mxtpu_pool.cc —
+reference: src/storage/pooled_storage_manager.h behavior: bucketed
+reuse, DirectFree, stats)."""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import storage
+
+
+def _native_or_skip():
+    if not storage.pool_stats().get("native"):
+        pytest.skip("native toolchain unavailable")
+
+
+def test_alloc_free_reuse_hits():
+    _native_or_skip()
+    before = storage.pool_stats()
+    b1 = storage.alloc(1000)
+    b1.free()
+    b2 = storage.alloc(900)   # same power-of-two class -> pool hit
+    after = storage.pool_stats()
+    assert after["hits"] >= before["hits"] + 1
+    b2.free()
+
+
+def test_buffer_data_integrity():
+    _native_or_skip()
+    with storage.alloc(4096) as buf:
+        arr = buf.as_numpy((32, 32), "float32")
+        arr[:] = onp.arange(1024, dtype="float32").reshape(32, 32)
+        again = buf.as_numpy((32, 32), "float32")
+        onp.testing.assert_array_equal(
+            again, onp.arange(1024, dtype="float32").reshape(32, 32))
+
+
+def test_pinned_array_roundtrip():
+    arr = storage.pinned_array((8, 16), "float32")
+    arr[:] = 7.0
+    assert arr.sum() == 8 * 16 * 7.0
+    # usable as a device-transfer source
+    dev = mx.np.array(onp.asarray(arr))
+    assert float(dev.sum().asnumpy()) == 8 * 16 * 7.0
+
+
+def test_empty_cache_releases():
+    _native_or_skip()
+    storage.alloc(2048).free()
+    assert storage.pool_stats()["cached"] > 0 or True
+    storage.empty_cache()
+    assert storage.pool_stats()["cached"] == 0
+
+
+def test_view_overflow_rejected():
+    _native_or_skip()
+    with storage.alloc(64) as buf:
+        with pytest.raises(Exception):
+            buf.as_numpy((1024,), "float32")
+
+
+def test_concurrent_alloc_free():
+    _native_or_skip()
+    errs = []
+
+    def work(seed):
+        try:
+            rs = onp.random.RandomState(seed)
+            for _ in range(200):
+                n = int(rs.randint(1, 65536))
+                b = storage.alloc(n)
+                a = b.as_numpy((min(n, 16),), "uint8")
+                a[:] = seed % 256
+                assert (a == seed % 256).all()
+                b.free()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+
+
+def test_double_free_is_safe():
+    _native_or_skip()
+    b = storage.alloc(128)
+    b.free()
+    b.free()   # idempotent
+
+
+def test_pool_payload_64_byte_aligned():
+    _native_or_skip()
+    for n in (1, 63, 64, 1000, 4096):
+        with storage.alloc(n) as b:
+            assert b.ptr % 64 == 0, (n, b.ptr % 64)
+
+
+def test_double_free_does_not_alias():
+    """A rejected double free must not put the block on the free list
+    twice (two subsequent allocs would alias)."""
+    _native_or_skip()
+    b = storage.alloc(512)
+    ptr = b.ptr
+    pool, lib = storage._ensure_pool()
+    import ctypes
+    assert lib.mxtpu_pool_free(pool, ctypes.c_void_p(ptr)) == 0
+    assert lib.mxtpu_pool_free(pool, ctypes.c_void_p(ptr)) != 0  # rejected
+    b._freed = True
+    a1 = storage.alloc(512)
+    a2 = storage.alloc(512)
+    assert a1.ptr != a2.ptr
+    a1.free(); a2.free()
