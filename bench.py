@@ -38,6 +38,9 @@ BASELINE_INFER_IMG_S = 1076.81  # reference V100 bs=32 ResNet-50 inference fp32
 RESNET50_MACS_PER_IMG = 4.089e9          # fvcore count at 224x224
 RESNET50_INFER_FLOPS_PER_IMG = 2 * RESNET50_MACS_PER_IMG
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * RESNET50_INFER_FLOPS_PER_IMG  # fwd+2xbwd
+INCEPTION3_MACS_PER_IMG = 5.73e9         # fvcore count at 299x299
+INCEPTION3_TRAIN_FLOPS_PER_IMG = 3 * 2 * INCEPTION3_MACS_PER_IMG
+BASELINE_INCEPTION_IMG_S = 214.48        # reference V100 bs=32 (BASELINE.md)
 
 # bf16 peak FLOP/s by device_kind substring (public TPU specs).
 PEAK_BF16 = {
@@ -180,6 +183,62 @@ def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=8):
     return row
 
 
+def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=8):
+    """Inception-v3 training (BASELINE.md row 3: 214.48 img/s on V100)."""
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import functional
+    from mxnet_tpu.gluon.model_zoo.vision import inception_v3
+    from mxnet_tpu.parallel import scan_steps
+
+    bs, size, nclass = (32, 299, 1000) if not on_cpu else (2, 75, 10)
+    if on_cpu:
+        k_steps = 2
+    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    net = inception_v3(classes=nclass)
+    net.initialize()
+    net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
+    trainable, aux = functional.split_params(net)
+    momenta = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+
+    def train_step(trainable, aux, momenta, x, y):
+        def loss_fn(tr):
+            logits, mutated = functional.functional_call(
+                net, {**_cast_tree(tr, cdtype), **aux},
+                x.astype(cdtype), train=True)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+            return loss, mutated
+        (loss, mutated), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        momenta = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g.astype(m.dtype), momenta, grads)
+        trainable = jax.tree_util.tree_map(
+            lambda w, m: w - 0.05 * m, trainable, momenta)
+        return trainable, {**aux, **mutated}, momenta, loss
+
+    step = jax.jit(scan_steps(train_step, n_state=3),
+                   donate_argnums=(0, 1, 2))
+    key = jax.random.PRNGKey(0)
+    xs = jax.random.normal(key, (k_steps, bs, 3, size, size), jnp.float32)
+    ys = jax.random.randint(key, (k_steps, bs), 0, nclass)
+    step, xla_flops = _compile(
+        step, trainable, aux, momenta,
+        jax.ShapeDtypeStruct(xs.shape, xs.dtype),
+        jax.ShapeDtypeStruct(ys.shape, ys.dtype))
+    sec, _ = _measure(step, (trainable, aux, momenta, xs, ys), n_state=3)
+    sec /= k_steps
+    flops = bs * INCEPTION3_TRAIN_FLOPS_PER_IMG * (size / 299.0) ** 2
+    row = _row(f"inception_v3_train_bs{bs}_{precision}", sec, bs, flops,
+               precision, peak, xla_flops=xla_flops)
+    row["steps_per_call"] = k_steps
+    row["vs_v100_baseline"] = round(bs / sec / BASELINE_INCEPTION_IMG_S, 2)
+    return row
+
+
 def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=8):
     import jax
     import jax.numpy as jnp
@@ -290,6 +349,7 @@ def main():
         (bench_resnet50_train, dict(precision="bf16")),   # headline
         (bench_resnet50_train, dict(precision="fp32")),
         (bench_resnet50_infer, dict(precision="bf16")),
+        (bench_inception_train, dict(precision="bf16")),
         (bench_bert_train, dict(precision="bf16", bs=32)),
         (bench_bert_train, dict(precision="bf16", bs=64)),
     ]:

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Export a model-zoo network to ONNX and verify the round trip
+(reference: example/onnx usage of mx.onnx.export_model).
+
+    python example/onnx_export.py [--model resnet18_v1] [--out model.onnx]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--out", default=None)
+    p.add_argument("--shape", type=int, nargs=4, default=(1, 3, 224, 224))
+    args = p.parse_args()
+
+    net = vision.get_model(args.model)
+    net.initialize()
+    x = mx.np.array(
+        onp.random.RandomState(0).rand(*args.shape).astype("float32"))
+    want = net(x).asnumpy()
+
+    out = args.out or f"{args.model}.onnx"
+    mx.onnx.export_model(net, out, args=(x,))
+    print(f"wrote {out} ({os.path.getsize(out)/1e6:.1f} MB)")
+
+    loaded = mx.onnx.import_model(out)
+    got = loaded(x).asnumpy()
+    err = onp.abs(got - want).max()
+    print(f"reimport max abs err: {err:.2e} "
+          f"(argmax agree: {(got.argmax(-1) == want.argmax(-1)).all()})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Post-training INT8 quantization of a model-zoo network
+(reference: example/quantization/imagenet_gen_qsym_onedns.py workflow,
+using mx.contrib.quantization.quantize_net).
+
+    python example/quantize_int8.py [--model resnet18_v1] [--mode entropy]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.contrib import quantization as qz  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--mode", default="entropy",
+                   choices=["naive", "entropy", "percentile"])
+    p.add_argument("--calib-batches", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--size", type=int, default=112)
+    args = p.parse_args()
+
+    net = vision.get_model(args.model)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    shape = (args.batch_size, 3, args.size, args.size)
+    calib = [mx.np.array(rs.rand(*shape).astype("float32"))
+             for _ in range(args.calib_batches)]
+    net(calib[0])
+
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode=args.mode)
+    qnet.hybridize()
+
+    x = mx.np.array(rs.rand(*shape).astype("float32"))
+    want = net(x).asnumpy()
+    got = qnet(x).asnumpy()
+    agree = (want.argmax(-1) == got.argmax(-1)).mean()
+    print(f"{args.model} int8 ({args.mode}): "
+          f"argmax agreement {agree:.3f} on random data")
+
+    shown = 0
+    for _parent, _key, path, layer in qz._walk_layers(qnet):
+        if isinstance(layer, (qz.QuantizedConv, qz.QuantizedDense)):
+            print("  ", path, "->", repr(layer))
+            shown += 1
+            if shown >= 4:
+                break
+
+
+if __name__ == "__main__":
+    main()

@@ -90,9 +90,53 @@ def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None,
     return block
 
 
-def convert_symbol(sym, **kwargs):
-    raise NotImplementedError(
-        "legacy symbol AMP conversion: use convert_hybrid_block")
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, **kwargs):
+    """Mixed-precision graph rewrite of a Symbol DAG.
+
+    Reference parity: amp.convert_symbol over the ReducePrecision NNVM
+    pass (src/nnvm/low_precision_pass.cc:152): inputs of MXU-bound ops
+    (lists.TARGET_DTYPE_OPS) are cast to the target dtype, inputs of
+    numerically sensitive ops (lists.FP32_OPS) back to float32; all other
+    ops run in whatever dtype flows in (XLA fuses the casts).
+    Returns a NEW symbol; the input graph is untouched.
+    """
+    from ..symbol.symbol import Symbol, Group
+    from . import lists as _lists
+
+    target_ops = set(target_dtype_ops if target_dtype_ops is not None
+                     else _lists.TARGET_DTYPE_OPS)
+    f32_ops = set(fp32_ops if fp32_ops is not None else _lists.FP32_OPS)
+
+    memo = {}
+    cast_memo = {}
+
+    def cast_node(s, dtype):
+        key = (id(s), dtype)
+        if key not in cast_memo:  # one cast per (producer, dtype) edge
+            cast_memo[key] = Symbol("amp_cast", [s], {"dtype": dtype},
+                                    name=f"{s.name}_amp_{dtype}")
+        return cast_memo[key]
+
+    def rebuild(s):
+        if id(s) in memo:
+            return memo[id(s)]
+        if isinstance(s, Group):
+            out = Group([rebuild(h) for h in s.symbols])
+            memo[id(s)] = out
+            return out
+        new_inputs = [rebuild(i) for i in s._inputs]
+        if s._op in target_ops:
+            new_inputs = [cast_node(i, str(target_dtype))
+                          for i in new_inputs]
+        elif s._op in f32_ops:
+            new_inputs = [cast_node(i, "float32") for i in new_inputs]
+        out = Symbol(s._op, new_inputs, dict(s._kwargs), s.name,
+                     s._num_outputs, s._output_index)
+        memo[id(s)] = out
+        return out
+
+    return rebuild(sym)
 
 
 def scale_loss(loss, trainer):

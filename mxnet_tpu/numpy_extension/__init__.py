@@ -992,6 +992,33 @@ def constraint_check(data, msg="Constraint violated!"):
     return out
 
 
+def amp_cast(data, dtype=None):
+    """Reference: amp_cast op (src/operator/tensor/amp_cast.cc) — dtype
+    cast inserted by the AMP graph rewrite (amp.convert_symbol)."""
+    dt = np_dtype(dtype)
+
+    def fn(x):
+        return x.astype(dt) if x.dtype != dt else x
+    return _invoke(fn, (data,), name="amp_cast")
+
+
+def amp_multicast(*data, num_outputs=None):
+    """Reference: amp_multicast — cast all inputs to the widest
+    *floating* dtype among them (integer inputs never win, so float data
+    is not truncated)."""
+    import numpy as onp
+    widest = None
+    for d in data:
+        dt = onp.dtype(str(d.dtype))
+        if dt.kind != "f" and str(dt) != "bfloat16":
+            continue
+        if widest is None or dt.itemsize > widest.itemsize:
+            widest = dt
+    if widest is None:
+        return tuple(data)
+    return tuple(amp_cast(d, dtype=widest) for d in data)
+
+
 from ..ops.quantization import (  # noqa: E402
     quantize_v2, dequantize, quantized_fully_connected, quantized_conv)
 from ..ops.bbox import (  # noqa: E402

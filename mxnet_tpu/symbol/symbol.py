@@ -139,6 +139,23 @@ class Symbol:
         out = self._eval_with(kwargs)
         return out if isinstance(out, (list, tuple)) else [out]
 
+    def optimize_for(self, backend, **kwargs):
+        """Backend graph rewrite (reference: symbol.py optimize_for over
+        the subgraph property API).  'bf16'/'fp16' apply the AMP
+        ReducePrecision rewrite (amp.convert_symbol); 'xla' is the
+        identity (XLA subsumes partitioning)."""
+        if backend in ("bf16", "bfloat16"):
+            from .. import amp
+            return amp.convert_symbol(self, target_dtype="bfloat16",
+                                      **kwargs)
+        if backend in ("fp16", "float16"):
+            from .. import amp
+            return amp.convert_symbol(self, target_dtype="float16",
+                                      **kwargs)
+        if backend in ("xla", None, "default"):
+            return self
+        raise MXNetError(f"unknown symbol backend {backend!r}")
+
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              **kwargs):
         return Executor(self, args or {}, args_grad, grad_req)

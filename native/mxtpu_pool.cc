@@ -119,18 +119,18 @@ int mxtpu_pool_free(void* pool_, void* ptr) {
   if (!pool || !ptr) return -1;
   void* base = static_cast<char*>(ptr) - sizeof(Header);
   auto* h = static_cast<Header*>(base);
+  // check-and-clear of the double-free guard must be atomic with the
+  // free-list push, so the whole body runs under the pool mutex
+  std::lock_guard<std::mutex> g(pool->mu);
   if (h->magic != kMagic) return -1;
+  h->magic = 0;  // restored when reused from the list
   if (pool->strategy == 0) {
-    std::lock_guard<std::mutex> g(pool->mu);
     pool->in_use -= h->size_class;
-    h->magic = 0;
     std::free(base);
     return 0;
   }
   uint64_t cls = h->size_class;
   uint64_t rounded = 1ULL << cls;
-  h->magic = 0;  // reject double free (restored when reused from the list)
-  std::lock_guard<std::mutex> g(pool->mu);
   pool->in_use -= rounded;
   pool->cached += rounded;
   pool->free_lists[cls].push_back(base);

@@ -149,3 +149,44 @@ def test_scale_loss_scope():
     loss = mx.np.ones((2,))
     with amp.scale_loss(loss, tr) as scaled:
         assert float(scaled.sum()) == pytest.approx(2 * tr._amp_loss_scaler.loss_scale)
+
+
+def test_convert_symbol_casts_matmul_inputs():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = mx.sym.matmul(a, b) + 1.0
+    lp = amp.convert_symbol(out, target_dtype="bfloat16")
+
+    xa = mx.np.array(onp.random.RandomState(0).rand(8, 8).astype("float32"))
+    xb = mx.np.array(onp.random.RandomState(1).rand(8, 8).astype("float32"))
+    ref = out.eval(a=xa, b=xb)[0].asnumpy()
+    got = lp.eval(a=xa, b=xb)[0]
+    # matmul ran in bf16: close to fp32 but not bit-identical
+    onp.testing.assert_allclose(got.asnumpy().astype("float32"), ref,
+                                rtol=3e-2, atol=3e-2)
+    assert not onp.array_equal(got.asnumpy().astype("float32"), ref)
+    # original symbol untouched
+    ref2 = out.eval(a=xa, b=xb)[0].asnumpy()
+    onp.testing.assert_array_equal(ref2, ref)
+
+
+def test_convert_symbol_fp32_ops_stay_fp32():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    # matmul (bf16) feeding softmax (fp32): softmax input must be cast back
+    net = mx.sym.softmax(mx.sym.matmul(a, b))
+    lp = amp.convert_symbol(net, target_dtype="bfloat16")
+    xa = mx.np.array(onp.random.RandomState(0).rand(4, 4).astype("float32"))
+    xb = mx.np.array(onp.random.RandomState(1).rand(4, 4).astype("float32"))
+    got = lp.eval(a=xa, b=xb)[0]
+    assert str(got.dtype) == "float32"
+    onp.testing.assert_allclose(got.asnumpy().sum(-1), onp.ones(4),
+                                rtol=1e-3)

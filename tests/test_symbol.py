@@ -110,3 +110,20 @@ def test_group_json_roundtrip():
     outs = g2.eval(a=x, b=y)
     onp.testing.assert_allclose(outs[0].asnumpy(), [6.0, 8.0])
     onp.testing.assert_allclose(outs[1].asnumpy(), [8.0, 15.0])
+
+
+def test_symbol_optimize_for_bf16():
+    import numpy as onp
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    net = mx.sym.matmul(a, b)
+    lp = net.optimize_for("bf16")
+    xa = mx.np.array(onp.random.RandomState(0).rand(4, 4).astype("float32"))
+    xb = mx.np.array(onp.random.RandomState(1).rand(4, 4).astype("float32"))
+    got = lp.eval(a=xa, b=xb)[0]
+    assert str(got.dtype) == "bfloat16"
+    assert net.optimize_for("xla") is net
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        net.optimize_for("tensorrt")
