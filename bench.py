@@ -127,21 +127,28 @@ def _row(name, sec_per_step, items_per_step, model_flops_per_step,
     return row
 
 
-def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=8):
+def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
+                     precision, on_cpu, peak, k_steps=8, tpu_cfg=(32, None),
+                     cpu_cfg=(4, 64, 100), nclass_tpu=1000,
+                     baseline_img_s=None):
+    """Shared CNN training bench: momentum-SGD step fused K-per-launch."""
     import jax
     import jax.numpy as jnp
 
     import mxnet_tpu as mx
     from mxnet_tpu import functional
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.parallel import scan_steps
 
-    bs, size, nclass = (32, 224, 1000) if not on_cpu else (4, 64, 100)
     if on_cpu:
+        bs, size, nclass = cpu_cfg
         k_steps = 2
+    else:
+        bs = tpu_cfg[0]
+        size = tpu_cfg[1] or native_size
+        nclass = nclass_tpu
     cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
-    net = resnet50_v1(classes=nclass)
+    net = model_ctor(classes=nclass)
     net.initialize()
     net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
     trainable, aux = functional.split_params(net)
@@ -164,79 +171,40 @@ def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=8):
             lambda w, m: w - 0.05 * m, trainable, momenta)
         return trainable, {**aux, **mutated}, momenta, loss
 
-    loop = scan_steps(train_step, n_state=3)
-    step = jax.jit(loop, donate_argnums=(0, 1, 2))
-    key = jax.random.PRNGKey(0)
-    xs = jax.random.normal(key, (k_steps, bs, 3, size, size), jnp.float32)
-    ys = jax.random.randint(key, (k_steps, bs), 0, nclass)
-
+    step = jax.jit(scan_steps(train_step, n_state=3),
+                   donate_argnums=(0, 1, 2))
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    xs = jax.random.normal(kx, (k_steps, bs, 3, size, size), jnp.float32)
+    ys = jax.random.randint(ky, (k_steps, bs), 0, nclass)
     step, xla_flops = _compile(
         step, trainable, aux, momenta,
         jax.ShapeDtypeStruct(xs.shape, xs.dtype),
         jax.ShapeDtypeStruct(ys.shape, ys.dtype))
     sec, _ = _measure(step, (trainable, aux, momenta, xs, ys), n_state=3)
     sec /= k_steps
-    flops = bs * RESNET50_TRAIN_FLOPS_PER_IMG * (size / 224.0) ** 2
-    row = _row(f"resnet50_train_bs{bs}_{precision}", sec, bs, flops,
+    flops = bs * 3 * 2 * macs_per_img * (size / native_size) ** 2
+    row = _row(f"{name}_train_bs{bs}_{precision}", sec, bs, flops,
                precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    if baseline_img_s:
+        row["vs_v100_baseline"] = round(bs / sec / baseline_img_s, 2)
     return row
+
+
+def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=8):
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    return _bench_cnn_train(resnet50_v1, "resnet50", RESNET50_MACS_PER_IMG,
+                            224, precision, on_cpu, peak, k_steps,
+                            baseline_img_s=BASELINE_TRAIN_IMG_S)
 
 
 def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=8):
     """Inception-v3 training (BASELINE.md row 3: 214.48 img/s on V100)."""
-    import jax
-    import jax.numpy as jnp
-
-    import mxnet_tpu as mx
-    from mxnet_tpu import functional
     from mxnet_tpu.gluon.model_zoo.vision import inception_v3
-    from mxnet_tpu.parallel import scan_steps
-
-    bs, size, nclass = (32, 299, 1000) if not on_cpu else (2, 75, 10)
-    if on_cpu:
-        k_steps = 2
-    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
-
-    net = inception_v3(classes=nclass)
-    net.initialize()
-    net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
-    trainable, aux = functional.split_params(net)
-    momenta = jax.tree_util.tree_map(jnp.zeros_like, trainable)
-
-    def train_step(trainable, aux, momenta, x, y):
-        def loss_fn(tr):
-            logits, mutated = functional.functional_call(
-                net, {**_cast_tree(tr, cdtype), **aux},
-                x.astype(cdtype), train=True)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
-            return loss, mutated
-        (loss, mutated), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(trainable)
-        momenta = jax.tree_util.tree_map(
-            lambda m, g: 0.9 * m + g.astype(m.dtype), momenta, grads)
-        trainable = jax.tree_util.tree_map(
-            lambda w, m: w - 0.05 * m, trainable, momenta)
-        return trainable, {**aux, **mutated}, momenta, loss
-
-    step = jax.jit(scan_steps(train_step, n_state=3),
-                   donate_argnums=(0, 1, 2))
-    key = jax.random.PRNGKey(0)
-    xs = jax.random.normal(key, (k_steps, bs, 3, size, size), jnp.float32)
-    ys = jax.random.randint(key, (k_steps, bs), 0, nclass)
-    step, xla_flops = _compile(
-        step, trainable, aux, momenta,
-        jax.ShapeDtypeStruct(xs.shape, xs.dtype),
-        jax.ShapeDtypeStruct(ys.shape, ys.dtype))
-    sec, _ = _measure(step, (trainable, aux, momenta, xs, ys), n_state=3)
-    sec /= k_steps
-    flops = bs * INCEPTION3_TRAIN_FLOPS_PER_IMG * (size / 299.0) ** 2
-    row = _row(f"inception_v3_train_bs{bs}_{precision}", sec, bs, flops,
-               precision, peak, xla_flops=xla_flops)
-    row["steps_per_call"] = k_steps
-    row["vs_v100_baseline"] = round(bs / sec / BASELINE_INCEPTION_IMG_S, 2)
-    return row
+    return _bench_cnn_train(inception_v3, "inception_v3",
+                            INCEPTION3_MACS_PER_IMG, 299, precision, on_cpu,
+                            peak, k_steps, cpu_cfg=(2, 75, 10),
+                            baseline_img_s=BASELINE_INCEPTION_IMG_S)
 
 
 def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=8):

@@ -994,10 +994,15 @@ def constraint_check(data, msg="Constraint violated!"):
 
 def amp_cast(data, dtype=None):
     """Reference: amp_cast op (src/operator/tensor/amp_cast.cc) — dtype
-    cast inserted by the AMP graph rewrite (amp.convert_symbol)."""
+    cast inserted by the AMP graph rewrite (amp.convert_symbol).  Like
+    the reference op, non-floating inputs pass through unchanged (an AMP
+    rewrite must not alter integer/bool semantics)."""
     dt = np_dtype(dtype)
 
     def fn(x):
+        if not (jnp.issubdtype(x.dtype, jnp.floating)
+                or x.dtype == jnp.bfloat16):
+            return x
         return x.astype(dt) if x.dtype != dt else x
     return _invoke(fn, (data,), name="amp_cast")
 

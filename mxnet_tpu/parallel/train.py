@@ -243,3 +243,45 @@ class ShardedTrainStep:
         params = self.block.collect_params()
         for n, v in {**self.trainable, **self.aux}.items():
             params[n]._data._rebind(v)
+
+    # -- checkpoint / resume ------------------------------------------------
+    def save_states(self, fname):
+        """Checkpoint weights + optimizer state + step count to one
+        safetensors file (reference: Trainer.save_states, trainer.py:482;
+        sharded arrays are gathered to host — the resume side re-shards
+        them).  safetensors rather than npz so bfloat16 params/state
+        round-trip exactly."""
+        import numpy as onp
+        from .. import serialization
+        arrays = {}
+        for n, v in self.trainable.items():
+            arrays[f"trainable/{n}"] = onp.asarray(v)
+        for n, v in self.aux.items():
+            arrays[f"aux/{n}"] = onp.asarray(v)
+        for n, s in self.states.items():
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(s)):
+                arrays[f"state/{n}/{i}"] = onp.asarray(leaf)
+        return serialization.save_safetensors(
+            fname, arrays, metadata={"n_step": self._n_step})
+
+    def load_states(self, fname):
+        """Resume from save_states: values re-sharded per param_specs
+        (reference: Trainer.load_states, trainer.py:511)."""
+        from .. import serialization
+        loaded, meta = serialization.load_safetensors(
+            fname, return_metadata=True)
+        self._n_step = int(meta.get("n_step", 0))
+
+        def sh(n):
+            return NamedSharding(self.mesh, self.param_specs.get(n, P()))
+
+        for n in self.trainable:
+            self.trainable[n] = jax.device_put(
+                loaded[f"trainable/{n}"], sh(n))
+        for n in self.aux:
+            self.aux[n] = jax.device_put(loaded[f"aux/{n}"], sh(n))
+        for n, s in self.states.items():
+            leaves, treedef = jax.tree_util.tree_flatten(s)
+            new = [jax.device_put(loaded[f"state/{n}/{i}"], sh(n))
+                   for i in range(len(leaves))]
+            self.states[n] = jax.tree_util.tree_unflatten(treedef, new)

@@ -366,3 +366,89 @@ def test_sharded_train_step_steps_per_call():
         onp.testing.assert_allclose(
             onp.asarray(s2.trainable[n]), onp.asarray(s1.trainable[n]),
             rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_sharded_train_step_checkpoint_resume(tmp_path):
+    """save_states/load_states must make interrupted == uninterrupted
+    training (reference: Trainer save/load_states round-trip)."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ShardedTrainStep, make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    rs = onp.random.RandomState(3)
+    xs = [rs.randn(8, 6).astype("float32") for _ in range(3)]
+    ys = [rs.randn(8, 4).astype("float32") for _ in range(3)]
+
+    def loss_fn(out, y):
+        return jnp.mean((out - y) ** 2)
+
+    mesh = make_mesh({"dp": 2})
+
+    def build():
+        mx.random.seed(11)
+        net = nn.Dense(4, in_units=6)
+        net.initialize()
+        return ShardedTrainStep(net, loss_fn, "adam", mesh,
+                                (P("dp"), P("dp")))
+
+    # uninterrupted: 3 steps
+    s_full = build()
+    for i in range(3):
+        s_full(xs[i], ys[i])
+
+    # interrupted: 2 steps -> save -> fresh object -> load -> 1 step
+    s_a = build()
+    for i in range(2):
+        s_a(xs[i], ys[i])
+    ckpt = str(tmp_path / "step")
+    s_a.save_states(ckpt)
+    s_b = build()
+    s_b.load_states(ckpt)
+    assert s_b._n_step == 2
+    s_b(xs[2], ys[2])
+
+    for n in s_full.trainable:
+        onp.testing.assert_allclose(
+            onp.asarray(s_b.trainable[n]), onp.asarray(s_full.trainable[n]),
+            rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_batchnorm_is_sync_under_dp_mesh():
+    """BatchNorm over a dp-sharded batch reduces over the GLOBAL batch
+    (GSPMD one-program semantics) — the free SyncBatchNorm: running
+    stats after a sharded step equal the single-device full-batch run."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ShardedTrainStep, make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    rs = onp.random.RandomState(5)
+    x = (rs.randn(16, 6) * 3 + 1).astype("float32")
+    y = rs.randn(16, 4).astype("float32")
+
+    def loss_fn(out, yy):
+        return jnp.mean((out - yy) ** 2)
+
+    def build():
+        mx.random.seed(13)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(4, in_units=6), nn.BatchNorm())
+        net.initialize()
+        net(mx.np.array(x))   # materialize BN params
+        return net
+
+    outs = {}
+    for name, axes in [("sharded", {"dp": 8}), ("single", {"dp": 1})]:
+        net = build()
+        step = ShardedTrainStep(net, loss_fn, "sgd", make_mesh(axes),
+                                (P("dp"), P("dp")))
+        step(x, y)
+        outs[name] = {n: onp.asarray(v) for n, v in step.aux.items()}
+    for n in outs["single"]:
+        onp.testing.assert_allclose(outs["sharded"][n], outs["single"][n],
+                                    rtol=1e-5, atol=1e-6, err_msg=n)
