@@ -176,9 +176,20 @@ class Block:
         """Reference: block.py:378."""
         import numpy as onp
         from ..numpy import array
+        from .. import serialization
         if filename.endswith(".safetensors"):
-            from .. import serialization
             loaded = serialization.load_safetensors(filename)
+        elif os.path.exists(filename) \
+                and serialization.is_legacy_params(filename):
+            # a .params file written by Apache MXNet (legacy binary);
+            # 1.x prefixes names with 'arg:'/'aux:' — strip them
+            loaded = serialization.load_legacy_params(filename)
+            if isinstance(loaded, list):
+                raise MXNetError(
+                    f"{filename} holds unnamed arrays; parameters need "
+                    "names to load into a Block (save with a dict)")
+            loaded = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                       else k): v for k, v in loaded.items()}
         else:
             path = filename if os.path.exists(filename) \
                 else filename + ".npz"

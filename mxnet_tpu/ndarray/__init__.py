@@ -23,11 +23,31 @@ def waitall():
 
 
 def save(fname, data):
-    from .. import numpy_extension as npx
-    npx.save(fname, data)
+    """mx.nd.save writes the 1.x legacy NDArray binary format
+    (reference: ndarray.py save over NDArray::Save, ndarray.cc:2125) —
+    files interchange with Apache MXNet. Use npx.save for npz."""
+    from .. import serialization
+    from ..base import MXNetError
+    if isinstance(data, NDArray):
+        data = [data]
+    if not isinstance(data, (dict, list, tuple)):
+        # a raw numpy/jax array would be iterated row-by-row; reject like
+        # the reference (ndarray.py save raises ValueError)
+        raise MXNetError(
+            "nd.save expects an NDArray, a list of NDArrays, or a "
+            f"dict of str->NDArray, got {type(data).__name__}")
+    serialization.save_legacy_params(fname, data)
 
 
 def load(fname):
+    """mx.nd.load reads both the legacy binary format and npz
+    (reference: ndarray.py load)."""
+    from .. import serialization
+    if serialization.is_legacy_params(fname):
+        loaded = serialization.load_legacy_params(fname)
+        if isinstance(loaded, list):
+            return [array(v) for v in loaded]
+        return {k: array(v) for k, v in loaded.items()}
     from .. import numpy_extension as npx
     return npx.load(fname)
 

@@ -99,3 +99,164 @@ def load_safetensors(path, return_metadata=False):
     if return_metadata:
         return out, metadata
     return out
+
+
+# ---------------------------------------------------------------------------
+# legacy MXNet NDArray binary format (.params files)
+# ---------------------------------------------------------------------------
+#
+# Reference: src/ndarray/ndarray.cc NDArray::Save/Load (list container at
+# :2123 kMXAPINDArrayListMagic=0x112; per-array V1/V2/V3 records at
+# :1851-1864) over dmlc::Stream. Byte-level layout (little-endian):
+#
+#   u64 0x112, u64 reserved,
+#   u64 n_arrays, then per array:
+#     u32 magic (V2=0xF993FAC9 | V3=0xF993FACA | V1=0xF993FAC8 | ndim),
+#     [V2/V3] i32 stype (0=dense; sparse adds a storage TShape),
+#     TShape: i32 ndim + ndim*i64 dims,
+#     i32 dev_type, i32 dev_id,
+#     i32 mshadow type_flag, raw data bytes
+#   u64 n_names, then per name: u64 len + bytes
+#
+# Implementing this independently gives real interop: `.params` files
+# written by Apache MXNet load here, and vice versa.
+
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+_V3_MAGIC = 0xF993FACA
+
+# mshadow type flags (3rdparty/mshadow/mshadow/base.h:352-364)
+_TYPE_FLAGS = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+               4: "int32", 5: "int8", 6: "int64", 7: "bool"}
+_FLAG_OF = {v: k for k, v in _TYPE_FLAGS.items()}
+_BF16_FLAG = 12
+
+
+def _np_from_flag(flag):
+    if flag == _BF16_FLAG:
+        import ml_dtypes
+        return onp.dtype(ml_dtypes.bfloat16)
+    if flag not in _TYPE_FLAGS:
+        raise MXNetError(f"legacy type_flag {flag} unsupported")
+    return onp.dtype(_TYPE_FLAGS[flag])
+
+
+def _flag_of(dtype):
+    name = str(onp.dtype(dtype)) if str(dtype) != "bfloat16" else "bfloat16"
+    if name == "bfloat16":
+        return _BF16_FLAG
+    if name not in _FLAG_OF:
+        raise MXNetError(f"dtype {name} has no legacy type_flag")
+    return _FLAG_OF[name]
+
+
+def save_legacy_params(path, tensors):
+    """Write arrays in the Apache MXNet .params binary format (loadable
+    by `mxnet.nd.load`).  `tensors` is a name->array dict (names stored)
+    or a list (no names, loads back as a list — reference behavior)."""
+    if isinstance(tensors, dict):
+        names = list(tensors)
+        values = [tensors[n] for n in names]
+    else:
+        names = []
+        values = list(tensors)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(values)))
+        for v in values:
+            arr = onp.ascontiguousarray(_as_numpy(v))
+            # V3 for 0-d (np-shape semantics); V2 otherwise (1.x compat)
+            magic = _V3_MAGIC if arr.ndim == 0 else _V2_MAGIC
+            f.write(struct.pack("<I", magic))
+            f.write(struct.pack("<i", 0))                    # dense stype
+            f.write(struct.pack("<i", arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack("<q", d))
+            f.write(struct.pack("<ii", 1, 0))                # cpu(0)
+            f.write(struct.pack("<i", _flag_of(arr.dtype)))
+            f.write(arr.tobytes())
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+    return path
+
+
+def load_legacy_params(path):
+    """Read an Apache MXNet .params binary file -> dict name->numpy.
+
+    Handles V1/V2/V3 records plus the pre-V1 layout where the magic
+    field is the ndim of a uint32 shape (ndarray.cc LegacyTShapeLoad).
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        vals = struct.unpack_from("<" + fmt, data, off)
+        off += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    header, _reserved = take("QQ")
+    if header != _LIST_MAGIC:
+        raise MXNetError(f"{path} is not a legacy NDArray file "
+                         f"(magic {header:#x})")
+    n = take("Q")
+    arrays = []
+    for _ in range(n):
+        magic = take("I")
+        if magic in (_V2_MAGIC, _V3_MAGIC):
+            stype = take("i")
+            if stype != 0:
+                raise MXNetError("sparse records in legacy files are not "
+                                 "supported; re-save densely")
+            ndim = take("i")
+            shape = [take("q") for _ in range(ndim)]
+            if magic == _V2_MAGIC and ndim == 0:
+                arrays.append(onp.zeros(0, "float32"))
+                continue
+        elif magic == _V1_MAGIC:
+            ndim = take("i")
+            shape = [take("q") for _ in range(ndim)]
+            if ndim == 0:
+                arrays.append(onp.zeros(0, "float32"))
+                continue
+        else:  # pre-V1: magic is ndim, dims are uint32
+            ndim = magic
+            shape = [take("I") for _ in range(ndim)]
+            if ndim == 0:
+                arrays.append(onp.zeros(0, "float32"))
+                continue
+        take("ii")                                   # context
+        flag = take("i")
+        dt = _np_from_flag(flag)
+        count = 1
+        for d in shape:
+            count *= d
+        nbytes = count * dt.itemsize
+        arr = onp.frombuffer(data, dt, count=count,
+                             offset=off).reshape(shape).copy()
+        off += nbytes
+        arrays.append(arr)
+    n_names = take("Q")
+    names = []
+    for _ in range(n_names):
+        ln = take("Q")
+        names.append(data[off:off + ln].decode())
+        off += ln
+    if names and len(names) != len(arrays):
+        raise MXNetError("corrupt legacy file: name/array count mismatch")
+    if not names:
+        return arrays   # unnamed save -> list (reference load behavior)
+    return dict(zip(names, arrays))
+
+
+def is_legacy_params(path):
+    try:
+        with open(path, "rb") as f:
+            return struct.unpack("<Q", f.read(8))[0] == _LIST_MAGIC
+    except (OSError, struct.error):
+        return False

@@ -85,3 +85,107 @@ def test_block_save_load_npz_still_works(tmp_path):
     net2(x)
     net2.load_parameters(p)
     onp.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-6)
+
+
+def test_legacy_params_roundtrip(tmp_path):
+    rs = onp.random.RandomState(0)
+    tensors = {
+        "arg:weight": rs.randn(4, 3).astype("float32"),
+        "arg:bias": rs.randn(4).astype("float64"),
+        "aux:mean": rs.randint(0, 9, (2, 2)).astype("int64"),
+        "scalar": onp.float32(2.5).reshape(()),   # 0-d -> V3 record
+    }
+    p = str(tmp_path / "legacy.params")
+    ser.save_legacy_params(p, tensors)
+    back = ser.load_legacy_params(p)
+    assert set(back) == set(tensors)
+    for k in tensors:
+        assert back[k].dtype == tensors[k].dtype, k
+        onp.testing.assert_array_equal(back[k], tensors[k])
+
+
+def test_legacy_params_wire_layout(tmp_path):
+    """Byte-level check against the reference layout
+    (ndarray.cc: 0x112 header, V2 magic, stype, i32 ndim + i64 dims,
+    context, type_flag, raw data, then names)."""
+    x = onp.asarray([[1.0, 2.0]], "float32")
+    p = str(tmp_path / "w.params")
+    ser.save_legacy_params(p, {"x": x})
+    raw = open(p, "rb").read()
+    header, reserved, count = struct.unpack_from("<QQQ", raw, 0)
+    assert header == 0x112 and reserved == 0 and count == 1
+    off = 24
+    magic, stype, ndim = struct.unpack_from("<Iii", raw, off)
+    assert magic == 0xF993FAC9 and stype == 0 and ndim == 2
+    off += 12
+    dims = struct.unpack_from("<qq", raw, off)
+    assert dims == (1, 2)
+    off += 16
+    dev_type, dev_id, type_flag = struct.unpack_from("<iii", raw, off)
+    assert dev_type == 1 and type_flag == 0       # cpu, float32
+    off += 12
+    onp.testing.assert_array_equal(
+        onp.frombuffer(raw, "<f4", count=2, offset=off), [1.0, 2.0])
+    off += 8
+    n_names, = struct.unpack_from("<Q", raw, off)
+    assert n_names == 1
+    ln, = struct.unpack_from("<Q", raw, off + 8)
+    assert raw[off + 16:off + 16 + ln] == b"x"
+
+
+def test_nd_save_load_list_and_dict(tmp_path):
+    import mxnet_tpu as mx
+    a = mx.np.array([[1.0, 2.0]])
+    b = mx.np.arange(4)
+    p1 = str(tmp_path / "list.params")
+    mx.nd.save(p1, [a, b])
+    back = mx.nd.load(p1)
+    assert isinstance(back, list) and len(back) == 2
+    onp.testing.assert_array_equal(back[0].asnumpy(), a.asnumpy())
+    p2 = str(tmp_path / "dict.params")
+    mx.nd.save(p2, {"a": a, "b": b})
+    back2 = mx.nd.load(p2)
+    onp.testing.assert_array_equal(back2["b"].asnumpy(), b.asnumpy())
+
+
+def test_block_loads_mxnet1x_style_params(tmp_path):
+    """A legacy .params with arg:/aux: prefixes loads into a Block."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    x = mx.np.ones((1, 2))
+    net(x)
+    w = net.weight.data().asnumpy()
+    legacy = {
+        "arg:weight": (w * 2).astype("float32"),
+        "arg:bias": onp.ones(3, "float32"),
+    }
+    p = str(tmp_path / "net.params")
+    ser.save_legacy_params(p, legacy)
+    net.load_parameters(p)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), w * 2)
+    onp.testing.assert_allclose(net.bias.data().asnumpy(), onp.ones(3))
+
+
+def test_nd_save_rejects_raw_array(tmp_path):
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    import pytest
+    with pytest.raises(MXNetError, match="nd.save expects"):
+        mx.nd.save(str(tmp_path / "x.params"),
+                   onp.array([1.0, 2.0, 3.0], "float32"))
+
+
+def test_block_load_unnamed_legacy_raises(tmp_path):
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon import nn
+    import pytest
+    p = str(tmp_path / "u.params")
+    ser.save_legacy_params(p, [onp.ones((2, 2), "float32")])
+    net = nn.Dense(2)
+    net.initialize()
+    net(mx.np.ones((1, 2)))
+    with pytest.raises(MXNetError, match="unnamed"):
+        net.load_parameters(p)
