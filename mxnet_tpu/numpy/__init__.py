@@ -60,6 +60,11 @@ def _make_op(fn, name):
     return op
 
 
+# jnp.fix is deprecated (slated for removal in jax 0.10); np.fix is
+# round-toward-zero == trunc, so bind it explicitly
+fix = _make_op(jnp.trunc, "fix")
+
+
 def __getattr__(name):
     """Lazy op generation (analog of ndarray/register.py _init_op_module +
     numpy/fallback.py)."""

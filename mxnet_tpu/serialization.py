@@ -24,7 +24,8 @@ import numpy as onp
 
 from .base import MXNetError
 
-__all__ = ["save_safetensors", "load_safetensors"]
+__all__ = ["save_safetensors", "load_safetensors",
+           "save_legacy_params", "load_legacy_params", "is_legacy_params"]
 
 # safetensors dtype tags <-> numpy
 _DTYPES = {
@@ -196,7 +197,12 @@ def load_legacy_params(path):
 
     def take(fmt):
         nonlocal off
-        vals = struct.unpack_from("<" + fmt, data, off)
+        try:
+            vals = struct.unpack_from("<" + fmt, data, off)
+        except struct.error as e:
+            raise MXNetError(
+                f"{path}: truncated/corrupt legacy NDArray file "
+                f"(at byte {off}): {e}") from e
         off += struct.calcsize("<" + fmt)
         return vals if len(vals) > 1 else vals[0]
 
@@ -237,6 +243,9 @@ def load_legacy_params(path):
         for d in shape:
             count *= d
         nbytes = count * dt.itemsize
+        if len(data) - off < nbytes:
+            raise MXNetError(f"{path}: truncated legacy NDArray file "
+                             f"(record needs {nbytes} bytes at {off})")
         arr = onp.frombuffer(data, dt, count=count,
                              offset=off).reshape(shape).copy()
         off += nbytes
@@ -245,6 +254,8 @@ def load_legacy_params(path):
     names = []
     for _ in range(n_names):
         ln = take("Q")
+        if len(data) - off < ln:
+            raise MXNetError(f"{path}: truncated name section")
         names.append(data[off:off + ln].decode())
         off += ln
     if names and len(names) != len(arrays):

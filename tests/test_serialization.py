@@ -189,3 +189,16 @@ def test_block_load_unnamed_legacy_raises(tmp_path):
     net(mx.np.ones((1, 2)))
     with pytest.raises(MXNetError, match="unnamed"):
         net.load_parameters(p)
+
+
+def test_truncated_legacy_file_raises_mxnet_error(tmp_path):
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    p = str(tmp_path / "t.params")
+    ser.save_legacy_params(p, {"w": onp.ones((4, 4), "float32")})
+    raw = open(p, "rb").read()
+    for cut in (20, 40, len(raw) - 3):
+        bad = str(tmp_path / f"cut{cut}.params")
+        open(bad, "wb").write(raw[:cut])
+        with pytest.raises(MXNetError, match="truncated"):
+            ser.load_legacy_params(bad)
