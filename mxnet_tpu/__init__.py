@@ -59,6 +59,19 @@ from . import image
 from . import contrib
 from . import serialization
 from . import storage
+from . import callback
+from . import model
+from . import operator
+from . import name
+from . import attribute
+from . import error
+from . import dlpack
+from . import libinfo
+from . import rtc
+from . import executor
+from . import visualization
+
+viz = visualization
 try:
     from . import onnx
 except ImportError:  # protobuf missing: degrade the feature, not the package

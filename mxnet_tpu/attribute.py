@@ -1,0 +1,47 @@
+"""mx.attribute — symbol attribute scopes.
+
+Reference parity: python/mxnet/attribute.py (AttrScope: with-scoped
+attribute dicts attached to symbols created inside the scope).
+"""
+from __future__ import annotations
+
+import threading
+
+_local = threading.local()
+
+
+class AttrScope:
+    """`with AttrScope(ctx_group='dev1'):` attaches attrs to symbols
+    created in scope (reference: attribute.py AttrScope)."""
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope values must be strings")
+        self._attr = kwargs
+        self._old = None
+
+    def get(self, attr=None):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        self._old = current()
+        merged = dict(self._old._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        _local.scope = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.scope = self._old
+
+
+def current():
+    scope = getattr(_local, "scope", None)
+    if scope is None:
+        scope = AttrScope()
+        _local.scope = scope
+    return scope

@@ -1,0 +1,74 @@
+"""mx.callback — training callbacks.
+
+Reference parity: python/mxnet/callback.py (Speedometer:91,
+do_checkpoint, LogValidationMetricsCallback, ProgressBar).  Callbacks
+receive BatchEndParam-style objects with epoch/nbatch/eval_metric
+attributes — the estimator and 1.x-style loops both produce them.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from collections import namedtuple
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+class Speedometer:
+    """Log samples/sec + metrics every `frequent` batches
+    (reference: callback.py:91)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if not self.init:
+            self.init = True
+            self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        msg = f"Epoch[{param.epoch}] Batch [{count}]\tSpeed: " \
+              f"{speed:.2f} samples/sec"
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                msg += f"\t{name}={value:f}"
+            if self.auto_reset:
+                param.eval_metric.reset()
+        logging.getLogger(__name__).info(msg)
+        self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving `prefix`-NNNN checkpoints
+    (reference: callback.py do_checkpoint over model.save_checkpoint)."""
+    from . import model as _model
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            _model.save_checkpoint(prefix, iter_no + 1, sym,
+                                   arg or {}, aux or {})
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    """Epoch-end callback logging validation metrics (reference:
+    callback.py LogValidationMetricsCallback)."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.getLogger(__name__).info(
+                "Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -1,0 +1,40 @@
+"""mx.dlpack — zero-copy tensor exchange.
+
+Reference parity: python/mxnet/dlpack.py (ndarray_to_dlpack_for_read/
+write, ndarray_from_dlpack over 3rdparty/dlpack).  jax.Array implements
+the DLPack protocol natively; these helpers keep the reference's module
+surface.
+"""
+from __future__ import annotations
+
+from .numpy.multiarray import ndarray, _wrap
+
+__all__ = ["ndarray_to_dlpack_for_read", "ndarray_to_dlpack_for_write",
+           "ndarray_from_dlpack", "from_dlpack", "to_dlpack_for_read",
+           "to_dlpack_for_write"]
+
+
+def ndarray_to_dlpack_for_read(data: ndarray):
+    """Export a capsule; the consumer must treat it as read-only."""
+    data.wait_to_read()
+    return data.__dlpack__()
+
+
+def ndarray_to_dlpack_for_write(data: ndarray):
+    """XLA buffers are immutable: writable export is the same capsule;
+    in-place mutation semantics are emulated at the ndarray layer."""
+    return data.__dlpack__()
+
+
+def ndarray_from_dlpack(capsule_or_array):
+    """Import anything speaking DLPack (torch/numpy/jax/...)."""
+    import jax
+    arr = jax.dlpack.from_dlpack(capsule_or_array) \
+        if not hasattr(capsule_or_array, "__dlpack__") \
+        else jax.numpy.from_dlpack(capsule_or_array)
+    return _wrap(arr)
+
+
+to_dlpack_for_read = ndarray_to_dlpack_for_read
+to_dlpack_for_write = ndarray_to_dlpack_for_write
+from_dlpack = ndarray_from_dlpack

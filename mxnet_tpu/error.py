@@ -1,0 +1,58 @@
+"""mx.error — typed error hierarchy.
+
+Reference parity: python/mxnet/error.py (MXNetError base registered
+against the C++ error codes, with InternalError/IndexError/ValueError/
+TypeError/AttributeError/NotImplementedForSymbol subclasses).  Here the
+hierarchy is pure python; each class also inherits its builtin
+counterpart so `except ValueError` catches mx.error.ValueError too.
+"""
+from __future__ import annotations
+
+import builtins
+
+from .base import MXNetError  # noqa: F401
+
+__all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
+           "TypeError", "AttributeError", "NotImplementedForSymbol",
+           "register_error"]
+
+_ERROR_TYPES = {}
+
+
+def register_error(cls):
+    """Register an error class by name (reference: error.py
+    register_error)."""
+    _ERROR_TYPES[cls.__name__] = cls
+    return cls
+
+
+@register_error
+class InternalError(MXNetError):
+    pass
+
+
+@register_error
+class IndexError(MXNetError, builtins.IndexError):
+    pass
+
+
+@register_error
+class ValueError(MXNetError, builtins.ValueError):
+    pass
+
+
+@register_error
+class TypeError(MXNetError, builtins.TypeError):
+    pass
+
+
+@register_error
+class AttributeError(MXNetError, builtins.AttributeError):
+    pass
+
+
+@register_error
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias=None, *args):
+        super().__init__(f"function {getattr(function, '__name__', function)}"
+                         " is not supported for Symbol")

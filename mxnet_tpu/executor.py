@@ -1,0 +1,10 @@
+"""mx.executor — symbol executor (alias module).
+
+Reference parity: python/mxnet/executor.py (Executor produced by
+Symbol.bind with forward/backward/arg_dict).  The implementation lives
+with the Symbol frontend (mxnet_tpu/symbol/symbol.py Executor); this
+module keeps the reference's import location working.
+"""
+from .symbol.symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

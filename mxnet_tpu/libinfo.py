@@ -1,0 +1,30 @@
+"""mx.libinfo — library/feature discovery.
+
+Reference parity: python/mxnet/libinfo.py (find_lib_path locating
+libmxnet.so, __version__).  Here the "library" is the set of native
+helper .so files built on demand plus the jax substrate; features come
+from mx.runtime.
+"""
+from __future__ import annotations
+
+import os
+
+from . import __version__  # noqa: F401
+
+
+def find_lib_path(prefix=None):
+    """Paths of the native helper libraries that exist/build locally
+    (reference: libinfo.py find_lib_path)."""
+    from . import native
+    out = []
+    build = native._build_dir()
+    if os.path.isdir(build):
+        for f in sorted(os.listdir(build)):
+            if f.endswith(".so"):
+                out.append(os.path.join(build, f))
+    return out
+
+
+def find_include_path():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")

@@ -1,0 +1,62 @@
+"""mx.model — 1.x-style checkpoint helpers.
+
+Reference parity: python/mxnet/model.py (save_checkpoint/load_checkpoint:
+`prefix-symbol.json` + `prefix-NNNN.params` with arg:/aux: name
+prefixes).  Files interchange with Apache MXNet: the params side uses the
+legacy binary format (mxnet_tpu.serialization) and the symbol side the
+graph json schema.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def _raw_dict(d, prefix):
+    import numpy as onp
+    out = {}
+    for k, v in (d or {}).items():
+        arr = v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v)
+        out[f"{prefix}:{k}"] = arr
+    return out
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Write `prefix-symbol.json` + `prefix-{epoch:04d}.params`
+    (reference: model.py save_checkpoint)."""
+    from . import serialization
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    tensors = {**_raw_dict(arg_params, "arg"), **_raw_dict(aux_params, "aux")}
+    path = f"{prefix}-{epoch:04d}.params"
+    serialization.save_legacy_params(path, tensors)
+    return path
+
+
+def load_checkpoint(prefix, epoch):
+    """-> (symbol or None, arg_params, aux_params) as mx ndarrays
+    (reference: model.py load_checkpoint)."""
+    import os
+    from . import serialization
+    from . import symbol as sym_mod
+    from .numpy import array
+
+    sym = None
+    sym_path = f"{prefix}-symbol.json"
+    if os.path.exists(sym_path):
+        sym = sym_mod.load(sym_path)
+    path = f"{prefix}-{epoch:04d}.params"
+    loaded = serialization.load_legacy_params(path)
+    if isinstance(loaded, list):
+        raise MXNetError(f"{path} has no parameter names")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = array(v)
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = array(v)
+        else:
+            arg_params[k] = array(v)
+    return sym, arg_params, aux_params

@@ -1,0 +1,53 @@
+"""mx.name — symbol name manager.
+
+Reference parity: python/mxnet/name.py (NameManager thread/with-scoped
+auto-naming of symbols, Prefix variant).
+"""
+from __future__ import annotations
+
+import threading
+
+_local = threading.local()
+
+
+class NameManager:
+    """Auto-generates unique names per op type (reference: name.py
+    NameManager; `with NameManager():` scopes it)."""
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old = current()
+        _local.manager = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.manager = self._old
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to every generated name (reference: Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current():
+    mgr = getattr(_local, "manager", None)
+    if mgr is None:
+        mgr = NameManager()
+        _local.manager = mgr
+    return mgr

@@ -52,6 +52,13 @@ def load(fname):
     return npx.load(fname)
 
 
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a registered python custom op (reference: mx.nd.Custom over
+    src/operator/custom/custom.cc; see mx.operator)."""
+    from .. import operator as _op
+    return _op.Custom(*inputs, op_type=op_type, **kwargs)
+
+
 def __getattr__(name):
     # legacy op names are the np names (plus CamelCase op aliases)
     try:

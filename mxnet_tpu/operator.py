@@ -1,0 +1,136 @@
+"""mx.operator — custom operator API.
+
+Reference parity: python/mxnet/operator.py (CustomOp:434 with
+forward/backward + assign, CustomOpProp:487 declaring shapes/types,
+register:710 decorator; executed via src/operator/custom/custom.cc on a
+dedicated async thread).  TPU-native: a registered custom op dispatches
+through the normal `_invoke` path — forward runs the user's python (host
+callback semantics, like the reference's custom-op thread), backward is
+wired into the autograd tape through the same mechanism as
+autograd.Function.
+
+    class Relu(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], mx.np.maximum(in_data[0], 0))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * (in_data[0] > 0))
+
+    @mx.operator.register("my_relu")
+    class ReluProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Relu()
+
+    y = mx.nd.Custom(x, op_type="my_relu")
+"""
+from __future__ import annotations
+
+from . import autograd
+from .base import MXNetError
+from .numpy.multiarray import ndarray, _wrap
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "Custom", "get"]
+
+_registry = {}
+
+
+class CustomOp:
+    """User op instance (reference: operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write per grad_req (reference: CustomOp.assign)."""
+        if req in ("null",):
+            return
+        src = src if isinstance(src, ndarray) else _wrap(src)
+        if req == "add":
+            dst._rebind((dst + src)._data)
+        else:   # write / inplace
+            dst._rebind(src._data)
+
+
+class CustomOpProp:
+    """Op metadata: shapes/dtypes/number of outputs
+    (reference: operator.py:487)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under a name
+    (reference: operator.py:710)."""
+    def deco(prop_cls):
+        _registry[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get(reg_name):
+    if reg_name not in _registry:
+        raise MXNetError(f"custom op {reg_name!r} not registered; "
+                         f"known: {sorted(_registry)}")
+    return _registry[reg_name]
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a registered custom op (the `mx.nd.Custom` entry point,
+    reference: src/operator/custom/custom.cc)."""
+    if op_type is None:
+        raise MXNetError("Custom needs op_type=")
+    prop = get(op_type)(**kwargs)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    out_shapes = prop.infer_shape(in_shapes)[1]
+    in_types = [str(x.dtype) for x in inputs]
+    out_types = prop.infer_type(in_types)[1]
+    op = prop.create_operator(None, in_shapes + out_shapes,
+                              in_types + out_types)
+
+    from .numpy import zeros
+    n_out = len(prop.list_outputs())
+    outputs = [zeros(s, dtype=t) for s, t in zip(out_shapes, out_types)]
+
+    is_train = autograd.is_recording() and autograd.is_training()
+    with autograd.pause():
+        op.forward(is_train, ["write"] * n_out, list(inputs), outputs, [])
+
+    if autograd.is_recording():
+        fwd_inputs = list(inputs)
+        fwd_outputs = list(outputs)
+
+        class _Bridge(autograd.Function):
+            def forward(self, *xs):
+                return tuple(fwd_outputs) if n_out > 1 else fwd_outputs[0]
+
+            def backward(self, *ograds):
+                import jax.numpy as jnp
+                in_grads = [_wrap(jnp.zeros(x.shape, x._data.dtype))
+                            for x in fwd_inputs]
+                op.backward(["write"] * len(in_grads), list(ograds),
+                            fwd_inputs, fwd_outputs, in_grads, [])
+                return tuple(in_grads) if len(in_grads) > 1 else in_grads[0]
+
+        result = _Bridge()(*inputs)
+        return result
+    return outputs[0] if n_out == 1 else outputs

@@ -1,0 +1,25 @@
+"""mx.rtc — runtime kernel compilation.
+
+Reference parity: python/mxnet/rtc.py (CudaModule/CudaKernel over NVRTC,
+src/common/rtc.cc).  On TPU there is no user-facing runtime C codegen:
+XLA is the JIT and custom kernels are Pallas (see
+mxnet_tpu/ops/pallas/ and mx.library for registration).  The classes
+exist so 1.x scripts fail with a pointer instead of an AttributeError.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+_MSG = ("CUDA RTC is not applicable on the TPU stack: XLA compiles the "
+        "graph and custom kernels are written with JAX Pallas — register "
+        "them via mx.library / mxnet_tpu.ops.registry instead")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

@@ -241,6 +241,9 @@ def load_legacy_params(path):
         dt = _np_from_flag(flag)
         count = 1
         for d in shape:
+            if d < 0:
+                raise MXNetError(f"{path}: corrupt legacy NDArray file "
+                                 f"(negative dim {d} in shape {shape})")
             count *= d
         nbytes = count * dt.itemsize
         if len(data) - off < nbytes:

@@ -19,10 +19,16 @@ class Symbol:
 
     def __init__(self, op, inputs, kwargs=None, name=None, num_outputs=1,
                  output_index=None):
+        from .. import name as _name_mod
         self._op = op                  # op name string; None for variables
         self._inputs = list(inputs)    # Symbol inputs
         self._kwargs = dict(kwargs or {})
-        self.name = name or (op if op else "sym")
+        # only unnamed symbols go through the NameManager: explicit names
+        # must survive graph reconstruction (load_json, amp rewrite)
+        # untouched, or a Prefix scope would corrupt round-trips
+        if name is None:
+            name = _name_mod.current().get(None, op if op else "sym")
+        self.name = name
         self._num_outputs = num_outputs
         self._output_index = output_index
 

@@ -1,0 +1,129 @@
+"""mx.viz — network visualization.
+
+Reference parity: python/mxnet/visualization.py (print_summary:46 layer
+table with shapes/params, plot_network: graphviz Digraph of the symbol
+DAG).  Works on both Symbol graphs and Gluon Blocks; plot_network
+returns DOT source text (and a graphviz.Digraph when the package is
+importable — it is optional here, as in the reference).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network", "dot_graph"]
+
+
+def _block_rows(block, input_shape):
+    """(name, type, out_shape, n_params) per direct child via a shaped
+    forward probe."""
+    from . import numpy as mxnp
+    rows = []
+    x = mxnp.zeros(input_shape)
+    for name, child in block._children.items():
+        params = sum(
+            int(onp.prod(p.shape)) for p in child.collect_params().values()
+            if p._data is not None or p._shape_known())
+        try:
+            x = child(x)
+            shape = tuple(x.shape)
+        except Exception:
+            shape = "?"
+        rows.append((name, type(child).__name__, shape, params))
+    return rows
+
+
+def _symbol_rows(symbol, shape=None):
+    rows = []
+    shapes = {}
+    if shape:
+        try:
+            args = symbol.list_arguments()
+            arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+            shapes = dict(zip(args, arg_shapes))
+        except Exception:
+            pass
+    for node in symbol._topo():
+        if node._op is None:
+            rows.append((node.name, "Variable",
+                         shapes.get(node.name, ""), 0))
+        else:
+            rows.append((node.name, node._op, "", 0))
+    return rows
+
+
+def print_summary(symbol, shape=None, line_length=98,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a layer table (reference: visualization.py print_summary).
+
+    `symbol` may be a Symbol (pass `shape` = dict name->shape) or a Gluon
+    Block (pass `shape` = the input shape tuple).
+    """
+    from .gluon.block import Block
+
+    if isinstance(symbol, Block):
+        if shape is None:
+            raise MXNetError("print_summary(Block) needs the input shape")
+        rows = _block_rows(symbol, shape)
+    elif hasattr(symbol, "_topo"):
+        rows = _symbol_rows(symbol, shape)
+    else:
+        raise MXNetError(f"cannot summarize {type(symbol)}")
+
+    cols = [int(line_length * p) for p in positions]
+    heads = ["Layer (type)", "Output Shape", "Param #", ""]
+
+    def fmt(fields):
+        line = ""
+        for f, c in zip(fields, cols):
+            line = (line + str(f))[:c].ljust(c)
+        return line.rstrip()
+
+    sep = "=" * line_length
+    print(sep)
+    print(fmt(heads))
+    print(sep)
+    total = 0
+    for name, typ, shp, nparam in rows:
+        print(fmt([f"{name} ({typ})", shp, nparam, ""]))
+        total += nparam
+    print(sep)
+    print(f"Total params: {total}")
+    print(sep)
+    return total
+
+
+def dot_graph(symbol, title="plot"):
+    """DOT source for a Symbol DAG (the text behind plot_network)."""
+    if not hasattr(symbol, "_topo"):
+        raise MXNetError("dot_graph needs a Symbol")
+    lines = [f'digraph "{title}" {{', "  rankdir=BT;"]
+    ids = {}
+    for i, node in enumerate(symbol._topo()):
+        ids[id(node)] = f"n{i}"
+        if node._op is None:
+            style = 'shape=oval, fillcolor="#8dd3c7", style=filled'
+            label = node.name
+        else:
+            style = 'shape=box, fillcolor="#fb8072", style=filled'
+            label = f"{node.name}\\n{node._op}"
+        lines.append(f'  n{i} [label="{label}", {style}];')
+    for node in symbol._topo():
+        for inp in node._inputs:
+            lines.append(f"  {ids[id(inp)]} -> {ids[id(node)]};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 **kwargs):
+    """Graphviz Digraph of the symbol DAG (reference: plot_network).
+    Returns a graphviz.Digraph when graphviz is installed, else the DOT
+    source string (same content either way)."""
+    src = dot_graph(symbol, title)
+    try:
+        import graphviz
+        return graphviz.Source(src, filename=title, format=save_format)
+    except ImportError:
+        return src

@@ -1,0 +1,187 @@
+"""Tests for the 1.x-parity top-level modules: viz, callback, model
+checkpoints, operator (CustomOp), name/attribute scopes, error types,
+dlpack, libinfo, rtc (reference: the same-named python/mxnet modules)."""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+
+
+def test_print_summary_block(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net(mx.np.ones((2, 8)))
+    total = mx.viz.print_summary(net, shape=(2, 8))
+    out = capsys.readouterr().out
+    assert "Dense" in out and "Total params" in out
+    assert total == 8 * 16 + 16 + 16 * 4 + 4
+
+
+def test_plot_network_dot_source():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = mx.sym.matmul(a, b)
+    src = mx.visualization.dot_graph(c)
+    assert src.startswith("digraph") and "matmul" in src
+    out = mx.viz.plot_network(c)
+    assert "matmul" in (out if isinstance(out, str) else out.source)
+
+
+def test_speedometer_logs(caplog):
+    from mxnet_tpu.callback import BatchEndParam, Speedometer
+    metric = mx.gluon.metric.Accuracy()
+    metric.update(mx.np.array([1, 0]), mx.np.array([[0.1, 0.9],
+                                                    [0.2, 0.8]]))
+    speedo = Speedometer(batch_size=2, frequent=2, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        for i in range(5):
+            speedo(BatchEndParam(epoch=0, nbatch=i, eval_metric=metric,
+                                 locals=None))
+    assert any("samples/sec" in r.message and "accuracy" in r.message
+               for r in caplog.records)
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "net")
+    a = mx.sym.var("a")
+    sym = mx.sym.tanh(a)
+    arg = {"weight": mx.np.ones((2, 3))}
+    aux = {"mean": mx.np.zeros((3,))}
+    path = mx.model.save_checkpoint(prefix, 7, sym, arg, aux)
+    assert path.endswith("-0007.params")
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert sym2 is not None
+    onp.testing.assert_array_equal(arg2["weight"].asnumpy(),
+                                   arg["weight"].asnumpy())
+    onp.testing.assert_array_equal(aux2["mean"].asnumpy(),
+                                   aux["mean"].asnumpy())
+    # interchange check: the params file is the legacy binary format
+    from mxnet_tpu import serialization
+    assert serialization.is_legacy_params(f"{prefix}-0007.params")
+
+
+def test_custom_op_forward_backward():
+    class MyRelu(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        mx.np.maximum(in_data[0], 0.0))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            mask = (in_data[0].asnumpy() > 0).astype("float32")
+            self.assign(in_grad[0], req[0], out_grad[0] * mx.np.array(mask))
+
+    @mx.operator.register("test_my_relu")
+    class MyReluProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return MyRelu()
+
+    x = mx.np.array([[-1.0, 2.0], [3.0, -4.0]])
+    y = mx.nd.Custom(x, op_type="test_my_relu")
+    onp.testing.assert_allclose(y.asnumpy(), [[0, 2], [3, 0]])
+
+    x.attach_grad()
+    with autograd.record():
+        out = mx.nd.Custom(x, op_type="test_my_relu")
+        loss = out.sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [[0, 1], [1, 0]])
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(MXNetError, match="not registered"):
+        mx.nd.Custom(mx.np.ones(3), op_type="nope")
+
+
+def test_name_manager_scopes():
+    from mxnet_tpu.name import NameManager, Prefix
+    with NameManager():
+        s1 = mx.sym.var("x") + 1.0
+        s2 = mx.sym.var("y") + 2.0
+        assert s1.name != s2.name
+    with Prefix("block_"):
+        s3 = mx.sym.var("z") * 2.0
+        assert s3.name.startswith("block_")
+
+
+def test_attr_scope_nesting():
+    from mxnet_tpu.attribute import AttrScope, current
+    with AttrScope(ctx_group="dev1"):
+        assert current().get()["ctx_group"] == "dev1"
+        with AttrScope(stage="2"):
+            got = current().get()
+            assert got["ctx_group"] == "dev1" and got["stage"] == "2"
+        assert "stage" not in current().get()
+    assert "ctx_group" not in current().get()
+    with pytest.raises(ValueError):
+        AttrScope(bad=3)
+
+
+def test_error_types_mix_with_builtins():
+    from mxnet_tpu import error
+    assert issubclass(error.ValueError, ValueError)
+    assert issubclass(error.ValueError, MXNetError)
+    with pytest.raises(ValueError):
+        raise error.ValueError("boom")
+    with pytest.raises(MXNetError):
+        raise error.TypeError("boom")
+
+
+def test_dlpack_interop_with_numpy_and_torch():
+    x = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    back = mx.dlpack.from_dlpack(x._data)      # jax array speaks dlpack
+    onp.testing.assert_array_equal(back.asnumpy(), x.asnumpy())
+    try:
+        import torch
+    except ImportError:
+        return
+    t = torch.tensor([1.0, 5.0])
+    got = mx.dlpack.from_dlpack(t)
+    onp.testing.assert_allclose(got.asnumpy(), [1.0, 5.0])
+
+
+def test_rtc_raises_with_pointer():
+    with pytest.raises(MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("kernel source")
+
+
+def test_libinfo_and_executor_module():
+    assert isinstance(mx.libinfo.find_lib_path(), list)
+    from mxnet_tpu.executor import Executor
+    a = mx.sym.var("a")
+    exe = (a * 2).bind(args={"a": mx.np.ones(3)})
+    assert isinstance(exe, Executor)
+    onp.testing.assert_allclose(exe.forward()[0].asnumpy(), [2, 2, 2])
+
+
+def test_prefix_scope_does_not_corrupt_reload():
+    """Explicit names must survive load_json inside a Prefix scope
+    (only auto-generated names are managed)."""
+    from mxnet_tpu.name import Prefix
+    a = mx.sym.var("x")
+    net = mx.sym.tanh(a)
+    js = net.tojson()
+    with Prefix("net_"):
+        back = mx.symbol.symbol.load_json(js)
+        assert back.list_arguments() == ["x"]
+        out = back.eval(x=mx.np.array([0.0]))[0]
+    onp.testing.assert_allclose(out.asnumpy(), [0.0])
+
+
+def test_corrupt_negative_dim_raises(tmp_path):
+    import struct
+    from mxnet_tpu import serialization as ser
+    p = str(tmp_path / "w.params")
+    ser.save_legacy_params(p, {"x": onp.ones((2, 2), "float32")})
+    raw = bytearray(open(p, "rb").read())
+    # shape dims start at offset 24 (header) + 12 (magic+stype+ndim)
+    struct.pack_into("<q", raw, 36, -1)
+    bad = str(tmp_path / "bad.params")
+    open(bad, "wb").write(bytes(raw))
+    with pytest.raises(MXNetError, match="negative dim"):
+        ser.load_legacy_params(bad)
