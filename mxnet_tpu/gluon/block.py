@@ -571,8 +571,43 @@ class SymbolBlock(HybridBlock):
     autograd records it (the artifact carries a first-order VJP), making
     reloaded models trainable like the reference's SymbolBlock."""
 
-    def __init__(self, exported=None, params=None):
+    def __init__(self, outputs=None, inputs=None, params=None,
+                 exported=None):
+        """Two construction forms, matching the reference:
+
+        - ``SymbolBlock(outputs_symbol, inputs_symbol(s), params=...)``
+          runs a Symbol DAG (reference block.py:1638 primary form; pairs
+          with ``mx.model.load_checkpoint``). ``params`` values may be
+          ndarrays or Parameters.
+        - ``SymbolBlock(exported=...)`` wraps a deserialized StableHLO
+          artifact (``SymbolBlock.imports``).
+        """
         super().__init__()
+        self._symbol = None
+        self._input_names = []
+        if outputs is not None:
+            if not hasattr(outputs, "_eval_with"):
+                raise MXNetError(
+                    "SymbolBlock outputs must be a Symbol; to wrap a "
+                    "StableHLO artifact pass exported= (or use "
+                    "SymbolBlock.imports)")
+            self._symbol = outputs
+            ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            self._input_names = [getattr(s, "name", s) for s in ins]
+            fixed = {}
+            for n, v in (params or {}).items():
+                if n in self._input_names:
+                    continue   # inputs are bound at call time, never stored
+                if isinstance(v, Parameter):
+                    fixed[n] = v
+                else:
+                    # trainable by default, like the reference's arg_params
+                    p = Parameter(n, shape=tuple(v.shape),
+                                  dtype=str(v.dtype), grad_req="write")
+                    p.set_data(v if isinstance(v, ndarray)
+                               else _wrap(jnp.asarray(v)))
+                    fixed[n] = p
+            params = fixed
         self._exported = exported
         self._sym_params = dict(params or {})
 
@@ -583,6 +618,14 @@ class SymbolBlock(HybridBlock):
         return {n: p for n, p in self._sym_params.items() if pat.search(n)}
 
     def forward(self, *args):
+        if self._symbol is not None:
+            if len(args) != len(self._input_names):
+                raise MXNetError(
+                    f"SymbolBlock expects {len(self._input_names)} inputs "
+                    f"{self._input_names}, got {len(args)}")
+            bindings = {n: p.data() for n, p in self._sym_params.items()}
+            bindings.update(zip(self._input_names, args))  # inputs win
+            return self._symbol._eval_with(bindings)
         if self._exported is None:
             raise MXNetError("SymbolBlock has no graph; use SymbolBlock."
                              "imports(symbol_file, ...)")
@@ -618,7 +661,7 @@ class SymbolBlock(HybridBlock):
                         p = Parameter(name, shape=data[name].shape)
                         p.set_data(array(data[name]))
                         params[name] = p
-            return SymbolBlock(exported, params)
+            return SymbolBlock(exported=exported, params=params)
         if allow_class_fallback and meta.get("block_class"):
             # v1 manifests (no graph artifact): reconstruct via the class
             mod_name, cls_name = meta["block_class"].rsplit(".", 1)

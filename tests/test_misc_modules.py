@@ -185,3 +185,70 @@ def test_corrupt_negative_dim_raises(tmp_path):
     open(bad, "wb").write(bytes(raw))
     with pytest.raises(MXNetError, match="negative dim"):
         ser.load_legacy_params(bad)
+
+
+def test_symbolblock_from_symbol_and_checkpoint(tmp_path):
+    """model.load_checkpoint -> SymbolBlock(sym, inputs, params) runs the
+    1.x deployment path end to end (reference: block.py:1638 +
+    model.py load_checkpoint)."""
+    from mxnet_tpu import gluon
+    data = mx.sym.var("data")
+    w = mx.sym.var("weight")
+    b = mx.sym.var("bias")
+    out = mx.sym.tanh(mx.sym.matmul(data, w) + b)
+
+    rs = onp.random.RandomState(0)
+    arg = {"weight": mx.np.array(rs.randn(3, 4).astype("float32")),
+           "bias": mx.np.array(rs.randn(4).astype("float32"))}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, out, arg, {})
+
+    sym, arg2, aux2 = mx.model.load_checkpoint(prefix, 0)
+    net = gluon.SymbolBlock(sym, mx.sym.var("data"),
+                            params={**arg2, **aux2})
+    x = mx.np.array(rs.randn(2, 3).astype("float32"))
+    got = net(x).asnumpy()
+    want = onp.tanh(x.asnumpy() @ arg["weight"].asnumpy()
+                    + arg["bias"].asnumpy())
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+    # hybridized (compiled) path gives identical values
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), got, rtol=1e-6)
+
+
+def test_symbolblock_wrong_input_count():
+    from mxnet_tpu import gluon
+    a = mx.sym.var("a")
+    net = gluon.SymbolBlock(mx.sym.tanh(a), a, params={})
+    with pytest.raises(MXNetError, match="expects 1 inputs"):
+        net(mx.np.ones(2), mx.np.ones(2))
+
+
+def test_symbolblock_params_trainable_and_input_precedence():
+    from mxnet_tpu import gluon
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.matmul(data, w)
+    rs = onp.random.RandomState(1)
+    # params dict deliberately includes the input name: it must be
+    # ignored so the live input wins
+    params = {"w": mx.np.array(rs.randn(3, 2).astype("float32")),
+              "data": mx.np.zeros((2, 3))}
+    net = gluon.SymbolBlock(out, data, params=params)
+    x1 = mx.np.array(rs.randn(2, 3).astype("float32"))
+    x2 = mx.np.array(rs.randn(2, 3).astype("float32"))
+    y1, y2 = net(x1).asnumpy(), net(x2).asnumpy()
+    assert not onp.allclose(y1, y2)      # input actually used
+    # params are trainable (reference: arg_params grad_req 'write')
+    assert net.collect_params()["w"].grad_req == "write"
+    with autograd.record():
+        loss = (net(x1) ** 2).sum()
+    loss.backward()
+    g = net.collect_params()["w"].grad().asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_symbolblock_rejects_non_symbol_outputs():
+    from mxnet_tpu import gluon
+    with pytest.raises(MXNetError, match="must be a Symbol"):
+        gluon.SymbolBlock(object(), None, params={})
