@@ -128,10 +128,16 @@ def _row(name, sec_per_step, items_per_step, model_flops_per_step,
 
 
 def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
-                     precision, on_cpu, peak, k_steps=8, tpu_cfg=(32, None),
+                     precision, on_cpu, peak, k_steps=16, tpu_cfg=(32, None),
                      cpu_cfg=(4, 64, 100), nclass_tpu=1000,
                      baseline_img_s=None):
-    """Shared CNN training bench: momentum-SGD step fused K-per-launch."""
+    """Shared CNN training bench: momentum-SGD step fused K-per-launch.
+
+    The ~160 1-D parameter/stat vectors (BN gamma/beta/running stats,
+    biases) are packed into single contiguous vectors (functional.Packer)
+    so cast + momentum + SGD lower to a few large fused ops instead of
+    hundreds of tiny ones — profiled at ~0.5 ms/step on ResNet-50.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -152,35 +158,46 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
     net.initialize()
     net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
     trainable, aux = functional.split_params(net)
-    momenta = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+    t_pack = functional.Packer(trainable)
+    a_pack = functional.Packer(aux)
+    tvec, tbig = t_pack.pack(trainable)
+    aux_pk = a_pack.pack(aux)
+    mom = (jnp.zeros_like(tvec), jax.tree_util.tree_map(jnp.zeros_like, tbig))
 
-    def train_step(trainable, aux, momenta, x, y):
+    def train_step(tvec, tbig, aux_pk, mom, x, y):
+        avec, abig = aux_pk
+
         # mixed precision: fp32 master weights, compute cast inside the step
-        def loss_fn(tr):
+        def loss_fn(tvec, tbig):
+            tr = t_pack.unpack(tvec.astype(cdtype), _cast_tree(tbig, cdtype))
+            aux_d = a_pack.unpack(avec, abig)
             logits, mutated = functional.functional_call(
-                net, {**_cast_tree(tr, cdtype), **aux},
-                x.astype(cdtype), train=True)
+                net, {**tr, **aux_d}, x.astype(cdtype), train=True)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
             return loss, mutated
         (loss, mutated), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(trainable)
-        momenta = jax.tree_util.tree_map(
-            lambda m, g: 0.9 * m + g.astype(m.dtype), momenta, grads)
-        trainable = jax.tree_util.tree_map(
-            lambda w, m: w - 0.05 * m, trainable, momenta)
-        return trainable, {**aux, **mutated}, momenta, loss
+            loss_fn, argnums=(0, 1), has_aux=True)(tvec, tbig)
+        gvec, gbig = grads
+        mvec = 0.9 * mom[0] + gvec
+        mbig = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g.astype(m.dtype), mom[1], gbig)
+        tvec = tvec - 0.05 * mvec
+        tbig = jax.tree_util.tree_map(lambda w, m: w - 0.05 * m, tbig, mbig)
+        aux_d = a_pack.unpack(avec, abig)
+        aux_pk = a_pack.pack({**aux_d, **mutated})
+        return tvec, tbig, aux_pk, (mvec, mbig), loss
 
-    step = jax.jit(scan_steps(train_step, n_state=3),
-                   donate_argnums=(0, 1, 2))
+    step = jax.jit(scan_steps(train_step, n_state=4),
+                   donate_argnums=(0, 1, 2, 3))
     kx, ky = jax.random.split(jax.random.PRNGKey(0))
     xs = jax.random.normal(kx, (k_steps, bs, 3, size, size), jnp.float32)
     ys = jax.random.randint(ky, (k_steps, bs), 0, nclass)
     step, xla_flops = _compile(
-        step, trainable, aux, momenta,
+        step, tvec, tbig, aux_pk, mom,
         jax.ShapeDtypeStruct(xs.shape, xs.dtype),
         jax.ShapeDtypeStruct(ys.shape, ys.dtype))
-    sec, _ = _measure(step, (trainable, aux, momenta, xs, ys), n_state=3)
+    sec, _ = _measure(step, (tvec, tbig, aux_pk, mom, xs, ys), n_state=4)
     sec /= k_steps
     flops = bs * 3 * 2 * macs_per_img * (size / native_size) ** 2
     row = _row(f"{name}_train_bs{bs}_{precision}", sec, bs, flops,
@@ -191,14 +208,14 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
     return row
 
 
-def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=8):
+def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=16):
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     return _bench_cnn_train(resnet50_v1, "resnet50", RESNET50_MACS_PER_IMG,
                             224, precision, on_cpu, peak, k_steps,
                             baseline_img_s=BASELINE_TRAIN_IMG_S)
 
 
-def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=8):
+def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=16):
     """Inception-v3 training (BASELINE.md row 3: 214.48 img/s on V100)."""
     from mxnet_tpu.gluon.model_zoo.vision import inception_v3
     return _bench_cnn_train(inception_v3, "inception_v3",
@@ -207,7 +224,7 @@ def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=8):
                             baseline_img_s=BASELINE_INCEPTION_IMG_S)
 
 
-def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=8):
+def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16):
     import jax
     import jax.numpy as jnp
 

@@ -46,6 +46,61 @@ def split_params(block):
     return trainable, aux
 
 
+class Packer:
+    """Pack the small (1-D) leaves of a name->array dict into one vector.
+
+    TPU-native analog of the reference's fused multi-tensor optimizer ops
+    (src/operator/optimizer_op.cc multi_sgd_update / multi_lamb): a model
+    like ResNet-50 has ~160 tiny BatchNorm vectors; carrying, casting and
+    updating each as its own HLO costs more in per-op overhead and loop
+    boundary copies than the math itself (profiled at ~0.5 ms/step).
+    Packing them into one contiguous vector turns cast + momentum + update
+    into three large fused ops and shrinks the scan carry to O(1) arrays.
+
+    pack(d)   -> (vec, big) where vec concatenates all 1-D leaves (sorted
+                 by name) and big holds the remaining leaves.
+    unpack(vec, big) -> dict with the original structure (slices are views
+                 compiled to zero-copy when layouts allow).
+    """
+
+    def __init__(self, d):
+        import numpy as onp
+
+        import jax.numpy as jnp
+
+        def _packable(a):
+            # fp32-only contract: the packed carrier is one f32 vector, so
+            # only f32 leaves pack; f16/bf16/int/bool leaves stay in `big`
+            # with their own dtype rather than silently promoting
+            return getattr(a, "ndim", 0) == 1 and a.dtype == jnp.float32
+
+        self.small = sorted(n for n, a in d.items() if _packable(a))
+        small = set(self.small)
+        self.big_names = sorted(n for n in d if n not in small)
+        self.sizes = [int(d[n].size) for n in self.small]
+        self.offsets = onp.cumsum([0] + self.sizes).tolist()
+
+    def pack(self, d):
+        import jax.numpy as jnp
+
+        big = {n: _raw(d[n]) for n in self.big_names}
+        if not self.small:
+            return jnp.zeros((0,)), big
+        vec = jnp.concatenate(
+            [_raw(d[n]).astype(jnp.float32) for n in self.small])
+        return vec, big
+
+    def unpack(self, vec, big):
+        """Rebuild the dict; slices keep ``vec``'s dtype so the caller can
+        cast the whole vector once (e.g. to bf16) instead of per-leaf."""
+        from jax import lax
+
+        out = dict(big)
+        for n, off, size in zip(self.small, self.offsets, self.sizes):
+            out[n] = lax.dynamic_slice(vec, (off,), (size,))
+        return out
+
+
 def functional_call(block, params, *args, train=False, rng_key=None):
     """Run ``block.forward`` as a pure function.
 

@@ -60,11 +60,26 @@ def Custom(*inputs, op_type=None, **kwargs):
 
 
 def __getattr__(name):
-    # legacy op names are the np names (plus CamelCase op aliases)
+    if name == "register":  # the submodule itself, not an op
+        import importlib
+        return importlib.import_module(__name__ + ".register")
+    # 1) the table-driven legacy surface (CamelCase layer ops + legacy
+    #    snake_case names like broadcast_add) — see register.py
+    import importlib
+    _register = importlib.import_module(__name__ + ".register")
+    fn = _register.get(name)
+    if fn is not None:
+        return fn
+    # 2) np, then npx (legacy nd exposed both layer and tensor ops)
     try:
         return getattr(_np, name)
     except AttributeError:
-        lowered = name.lower()
-        if lowered != name:
-            return getattr(_np, lowered)
-        raise
+        pass
+    from .. import numpy_extension as _npx
+    fn = getattr(_npx, name, None)
+    if fn is not None:
+        return fn
+    lowered = name.lower()
+    if lowered != name:
+        return getattr(_np, lowered)
+    raise AttributeError(name)

@@ -1,0 +1,792 @@
+"""Legacy CamelCase operator surface for ``mx.nd`` / ``mx.sym``.
+
+Reference parity: the 1.x generated wrappers
+(python/mxnet/ndarray/register.py:115-277 code-gens a python function per
+registered op; symbol/register.py does the same for Symbol) expose every
+``NNVM_REGISTER_OP`` name — including the CamelCase layer ops
+(FullyConnected, Convolution, BatchNorm, SliceChannel, ...) that 1.x
+model scripts and serialized symbol graphs use.
+
+TPU-native design: instead of code-gen from a C registry, a table of
+thin adapters maps each legacy name + legacy kwargs (``num_hidden``,
+``no_bias``, ``kernel``...) onto the mx.np / mx.npx implementations (which
+lower to XLA).  Both ``mx.nd.__getattr__`` and the Symbol resolver consult
+this one table, so eager and symbolic results match exactly, and symbol
+json graphs written by 1.x (attrs as strings) evaluate here: every adapter
+literal-parses string attrs like ``kernel="(3, 3)"``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..base import MXNetError
+
+LEGACY_OPS: dict = {}
+
+
+def register(name):
+    def deco(fn):
+        fn.__name__ = name
+        LEGACY_OPS[name] = fn
+        return fn
+    return deco
+
+
+def get(name):
+    return LEGACY_OPS.get(name)
+
+
+# -- legacy attr parsing -----------------------------------------------------
+def _lit(v):
+    """Parse legacy string attrs: "(3, 3)" -> (3, 3), "True" -> True,
+    "2" -> 2.  Non-strings pass through."""
+    if isinstance(v, str):
+        try:
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _tup(v, n=None):
+    v = _lit(v)
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * (n or 1)
+    return tuple(v)
+
+
+def _b(v):
+    v = _lit(v)
+    if isinstance(v, str):
+        return v.lower() in ("true", "1")
+    return bool(v)
+
+
+def _drop_name(kw):
+    kw.pop("name", None)
+    kw.pop("ctx", None)
+    return kw
+
+
+# -- neural-network layers ---------------------------------------------------
+@register("FullyConnected")
+def _fully_connected(data, weight=None, bias=None, num_hidden=None,
+                     no_bias=False, flatten=True, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.fully_connected(data, weight, bias,
+                               num_hidden=int(_lit(num_hidden)),
+                               no_bias=_b(no_bias), flatten=_b(flatten))
+
+
+@register("Convolution")
+def _convolution(data, weight=None, bias=None, kernel=None, stride=None,
+                 dilate=None, pad=None, num_filter=1, num_group=1,
+                 workspace=1024, no_bias=False, layout=None, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    kernel = _tup(kernel)
+    n = len(kernel)
+    return npx.convolution(data, weight, bias, kernel=kernel,
+                           stride=_tup(stride, n), dilate=_tup(dilate, n),
+                           pad=_tup(pad, n), num_filter=int(_lit(num_filter)),
+                           num_group=int(_lit(num_group)), no_bias=_b(no_bias),
+                           layout=_lit(layout))
+
+
+@register("Deconvolution")
+def _deconvolution(data, weight=None, bias=None, kernel=None, stride=None,
+                   dilate=None, pad=None, adj=None, target_shape=None,
+                   num_filter=1, num_group=1, workspace=512, no_bias=True,
+                   layout=None, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    kernel = _tup(kernel)
+    n = len(kernel)
+    return npx.deconvolution(data, weight, bias, kernel=kernel,
+                             stride=_tup(stride, n), dilate=_tup(dilate, n),
+                             pad=_tup(pad, n), adj=_tup(adj, n),
+                             num_filter=int(_lit(num_filter)),
+                             num_group=int(_lit(num_group)),
+                             no_bias=_b(no_bias), layout=_lit(layout))
+
+
+@register("BatchNorm")
+def _batch_norm(data, gamma=None, beta=None, moving_mean=None,
+                moving_var=None, eps=1e-3, momentum=0.9, fix_gamma=True,
+                use_global_stats=False, output_mean_var=False, axis=1, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          eps=float(_lit(eps)), momentum=float(_lit(momentum)),
+                          fix_gamma=_b(fix_gamma),
+                          use_global_stats=_b(use_global_stats),
+                          output_mean_var=_b(output_mean_var),
+                          axis=int(_lit(axis)))
+
+
+@register("LayerNorm")
+def _layer_norm(data, gamma=None, beta=None, axis=-1, eps=1e-5, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.layer_norm(data, gamma, beta, axis=int(_lit(axis)),
+                          eps=float(_lit(eps)))
+
+
+@register("GroupNorm")
+def _group_norm(data, gamma=None, beta=None, num_groups=1, eps=1e-5, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.group_norm(data, gamma, beta, num_groups=int(_lit(num_groups)),
+                          eps=float(_lit(eps)))
+
+
+@register("InstanceNorm")
+def _instance_norm(data, gamma=None, beta=None, eps=1e-3, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.instance_norm(data, gamma, beta, eps=float(_lit(eps)))
+
+
+@register("L2Normalization")
+def _l2_normalization(data, eps=1e-10, mode="instance", **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.l2_normalization(data, eps=float(_lit(eps)), mode=_lit(mode))
+
+
+@register("Activation")
+def _activation(data, act_type="relu", **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.activation(data, act_type=_lit(act_type))
+
+
+@register("LeakyReLU")
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.leaky_relu(data, gamma, act_type=_lit(act_type),
+                          slope=float(_lit(slope)),
+                          lower_bound=float(_lit(lower_bound)),
+                          upper_bound=float(_lit(upper_bound)))
+
+
+@register("Pooling")
+def _pooling(data, kernel=1, stride=None, pad=None, pool_type="max",
+             pooling_convention="valid", global_pool=False, p_value=2,
+             count_include_pad=True, layout=None, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    n = data.ndim - 2
+    kernel = _tup(kernel, n)
+    return npx.pooling(data, kernel=kernel, stride=_tup(stride, n),
+                       pad=_tup(pad, n), pool_type=_lit(pool_type),
+                       pooling_convention=_lit(pooling_convention),
+                       global_pool=_b(global_pool),
+                       p_value=int(_lit(p_value)),
+                       count_include_pad=_b(count_include_pad),
+                       layout=_lit(layout) if layout
+                       else {1: "NCW", 2: "NCHW", 3: "NCDHW"}[n])
+
+
+@register("Dropout")
+def _dropout(data, p=0.5, mode="training", axes=None, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.dropout(data, p=float(_lit(p)), mode=_lit(mode),
+                       axes=_tup(axes) if axes else None)
+
+
+@register("Embedding")
+def _embedding(data, weight=None, input_dim=None, output_dim=None,
+               dtype="float32", sparse_grad=False, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.embedding(data, weight, input_dim=int(_lit(input_dim)),
+                         output_dim=int(_lit(output_dim)),
+                         sparse_grad=_b(sparse_grad))
+
+
+@register("RNN")
+def _rnn(data, parameters=None, state=None, state_cell=None, mode="lstm",
+         state_size=None, num_layers=1, bidirectional=False, p=0.0,
+         state_outputs=False, projection_size=None, sequence_length=None,
+         use_sequence_length=False, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.rnn(data=data, parameters=parameters, state=state,
+                   state_cell=state_cell, mode=_lit(mode),
+                   state_size=int(_lit(state_size)),
+                   num_layers=int(_lit(num_layers)),
+                   bidirectional=_b(bidirectional), p=float(_lit(p)),
+                   state_outputs=_b(state_outputs),
+                   projection_size=(int(_lit(projection_size))
+                                    if projection_size else None),
+                   use_sequence_length=_b(use_sequence_length),
+                   sequence_length=sequence_length)
+
+
+# -- shape / data movement ---------------------------------------------------
+@register("Reshape")
+def _reshape(data, shape=None, reverse=False, target_shape=None,
+             keep_highest=False, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    if shape is None and target_shape is not None:
+        # pre-1.0 attr: exact output shape, 0 = keep the input dim
+        # (+ keep_highest preserving dim 0); matrix_op-inl.h legacy path
+        tgt = _tup(target_shape)
+        out = tuple(data.shape[i] if (s == 0 or (_b(keep_highest) and i == 0))
+                    else s for i, s in enumerate(tgt))
+        return data.reshape(out)
+    if shape is None:
+        raise MXNetError("Reshape requires shape or target_shape")
+    return npx.reshape(data, _tup(shape), reverse=_b(reverse))
+
+
+@register("Flatten")
+def _flatten(data, **kw):
+    _drop_name(kw)
+    return data.reshape((data.shape[0], -1))
+
+
+@register("Concat")
+def _concat(*data, dim=1, num_args=None, **kw):
+    from .. import numpy as _np
+    _drop_name(kw)
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _np.concatenate(data, axis=int(_lit(dim)))
+
+
+@register("SliceChannel")
+def _slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False, **kw):
+    from .. import numpy as _np
+    _drop_name(kw)
+    num_outputs = int(_lit(num_outputs))
+    axis = int(_lit(axis))
+    parts = _np.split(data, num_outputs, axis=axis)
+    if _b(squeeze_axis):
+        parts = [p.squeeze(axis=axis) for p in parts]
+    return parts
+
+
+@register("SwapAxis")
+def _swap_axis(data, dim1=0, dim2=0, **kw):
+    from .. import numpy as _np
+    _drop_name(kw)
+    return _np.swapaxes(data, int(_lit(dim1)), int(_lit(dim2)))
+
+
+@register("ExpandDims")
+def _expand_dims(data, axis=0, **kw):
+    from .. import numpy as _np
+    _drop_name(kw)
+    return _np.expand_dims(data, int(_lit(axis)))
+
+
+@register("Cast")
+def _cast(data, dtype=None, **kw):
+    _drop_name(kw)
+    return data.astype(_lit(dtype))
+
+
+@register("Pad")
+def _pad(data, mode="constant", pad_width=None, constant_value=0, **kw):
+    from .. import numpy as _np
+    _drop_name(kw)
+    pw = _tup(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    mode = _lit(mode)
+    if mode == "constant":
+        return _np.pad(data, pairs, mode="constant",
+                       constant_values=float(_lit(constant_value)))
+    return _np.pad(data, pairs, mode={"edge": "edge",
+                                      "reflect": "reflect"}[mode])
+
+
+@register("UpSampling")
+def _up_sampling(*data, scale=1, sample_type="nearest", num_filter=0,
+                 multi_input_mode="concat", num_args=1, **kw):
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    scale = int(_lit(scale))
+    sample_type = _lit(sample_type)
+    x = data[0]
+
+    def fn(x_, *rest):
+        import jax
+        import jax.numpy as jnp
+        if sample_type == "nearest":
+            return jnp.repeat(jnp.repeat(x_, scale, axis=2), scale, axis=3)
+        n, c, h, w = x_.shape
+        return jax.image.resize(x_, (n, c, h * scale, w * scale), "bilinear")
+    return _invoke(fn, (x,), name="upsampling")
+
+
+@register("Crop")
+def _crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False,
+          num_args=1, **kw):
+    _drop_name(kw)
+    x = data[0]
+    offset, h_w = _tup(offset, 2), _tup(h_w, 2)
+    if len(data) > 1:
+        h, w = data[1].shape[2], data[1].shape[3]
+    else:
+        h, w = h_w
+    if _b(center_crop):
+        oy = (x.shape[2] - h) // 2
+        ox = (x.shape[3] - w) // 2
+    else:
+        oy, ox = offset
+    return x[:, :, oy:oy + h, ox:ox + w]
+
+
+# -- loss-layer ops ----------------------------------------------------------
+@register("SoftmaxOutput")
+def _softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False,
+                    smooth_alpha=0.0, **kw):
+    """Reference: src/operator/softmax_output.cc — forward is softmax; the
+    backward IGNORES the incoming head gradient and emits
+    (softmax - one_hot(label)) * grad_scale, i.e. the op is a loss layer."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    grad_scale = float(_lit(grad_scale))
+    ignore_label = float(_lit(ignore_label))
+    use_ignore = _b(use_ignore)
+    multi_output = _b(multi_output)
+    normalization = _lit(normalization)
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def softmax_out(x, lab):
+        return _fwd(x, lab)[0]
+
+    def _fwd(x, lab):
+        axis = 1 if (multi_output and x.ndim > 2) else -1
+        out = jax.nn.softmax(x, axis=axis)
+        return out, (out, lab)
+
+    def _bwd(res, dy):
+        out, lab = res
+        axis = 1 if (multi_output and out.ndim > 2) else -1
+        nclass = out.shape[axis]
+        lab_i = lab.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab_i, nclass, dtype=out.dtype, axis=axis)
+        g = out - onehot
+        if use_ignore:
+            keep = (lab != ignore_label)
+            keep = jnp.expand_dims(keep, axis if axis != -1 else out.ndim - 1)
+            g = jnp.where(keep, g, jnp.zeros((), g.dtype))
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / out.shape[0]
+        elif normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum(lab != ignore_label), 1)
+            scale = scale / valid
+        g = g * scale
+        return g.astype(out.dtype), jnp.zeros_like(lab)
+
+    softmax_out.defvjp(_fwd, _bwd)
+    return _invoke(softmax_out, (data, label), name="softmax_output")
+
+
+@register("LinearRegressionOutput")
+def _linear_regression_output(data, label=None, grad_scale=1.0, **kw):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    grad_scale = float(_lit(grad_scale))
+
+    @jax.custom_vjp
+    def linreg(x, lab):
+        return x
+
+    def _fwd(x, lab):
+        return x, (x, lab)
+
+    def _bwd(res, dy):
+        x, lab = res
+        g = (x - lab.reshape(x.shape)) * grad_scale / x.shape[0]
+        return g.astype(x.dtype), jnp.zeros_like(lab)
+    linreg.defvjp(_fwd, _bwd)
+    return _invoke(linreg, (data, label), name="linear_regression_output")
+
+
+@register("LogisticRegressionOutput")
+def _logistic_regression_output(data, label=None, grad_scale=1.0, **kw):
+    import jax
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    grad_scale = float(_lit(grad_scale))
+
+    @jax.custom_vjp
+    def logreg(x, lab):
+        return jax.nn.sigmoid(x)
+
+    def _fwd(x, lab):
+        out = jax.nn.sigmoid(x)
+        return out, (out, lab)
+
+    def _bwd(res, dy):
+        out, lab = res
+        g = (out - lab.reshape(out.shape)) * grad_scale / out.shape[0]
+        return g.astype(out.dtype), jnp.zeros_like(lab)
+    logreg.defvjp(_fwd, _bwd)
+    return _invoke(logreg, (data, label), name="logistic_regression_output")
+
+
+@register("MAERegressionOutput")
+def _mae_regression_output(data, label=None, grad_scale=1.0, **kw):
+    import jax
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    grad_scale = float(_lit(grad_scale))
+
+    @jax.custom_vjp
+    def mae(x, lab):
+        return x
+
+    def _fwd(x, lab):
+        return x, (x, lab)
+
+    def _bwd(res, dy):
+        x, lab = res
+        g = jnp.sign(x - lab.reshape(x.shape)) * grad_scale / x.shape[0]
+        return g.astype(x.dtype), jnp.zeros_like(lab)
+    mae.defvjp(_fwd, _bwd)
+    return _invoke(mae, (data, label), name="mae_regression_output")
+
+
+@register("MakeLoss")
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null", **kw):
+    import jax
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    grad_scale = float(_lit(grad_scale))
+    normalization = _lit(normalization)
+
+    @jax.custom_vjp
+    def make_loss(x):
+        return x
+
+    def _fwd(x):
+        return x, x
+
+    def _bwd(x, dy):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / x.shape[0]
+        return (jnp.full_like(x, scale),)
+    make_loss.defvjp(_fwd, _bwd)
+    return _invoke(make_loss, (data,), name="make_loss")
+
+
+@register("BlockGrad")
+def _block_grad(data, **kw):
+    from jax import lax
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    return _invoke(lax.stop_gradient, (data,), name="stop_gradient")
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_attach_kl(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9, **kw):
+    _drop_name(kw)
+    return data
+
+
+@register("CTCLoss")
+def _ctc_loss(data, label=None, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", **kw):
+    """Reference: src/operator/nn/ctc_loss.cc (data is (T, N, C))."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+
+    blank = _lit(blank_label)
+    use_dl = _b(use_data_lengths)
+
+    def fn(d, lab, *rest):
+        tnc = jnp.transpose(d, (1, 0, 2))  # (N, T, C)
+        n, t, c = tnc.shape
+        logp = jax.nn.log_softmax(tnc, axis=-1)
+        lab_i = lab.astype(jnp.int32)
+        if use_dl and rest:
+            dl = rest[0].astype(jnp.int32)
+            logit_pad = (jnp.arange(t)[None, :] >=
+                         dl[:, None]).astype(jnp.float32)
+        else:
+            logit_pad = jnp.zeros((n, t))
+        if blank == "first":
+            # blank = class 0, labels are 1-based, 0-padded (ctc_loss.cc)
+            lab_pad = (lab_i <= 0).astype(jnp.float32)
+            loss = optax.ctc_loss(logp, logit_pad, lab_i, lab_pad,
+                                  blank_id=0)
+        else:
+            # blank = class C-1, labels 0-based, padded with -1
+            lab_pad = (lab_i < 0).astype(jnp.float32)
+            loss = optax.ctc_loss(logp, logit_pad,
+                                  jnp.maximum(lab_i, 0), lab_pad,
+                                  blank_id=c - 1)
+        return loss
+
+    args = (data, label) if not (use_dl and data_lengths is not None) \
+        else (data, label, data_lengths)
+    return _invoke(fn, args, name="ctc_loss")
+
+
+# -- misc --------------------------------------------------------------------
+@register("ElementWiseSum")
+def _element_wise_sum(*args, num_args=None, **kw):
+    _drop_name(kw)
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("LRN")
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2, nsize=5, **kw):
+    """Reference: src/operator/nn/lrn.cc (across-channel local response
+    normalization, layout NCHW)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    alpha, beta = float(_lit(alpha)), float(_lit(beta))
+    knorm, nsize = float(_lit(knorm)), int(_lit(nsize))
+
+    def fn(x):
+        sq = lax.square(x)
+        half = nsize // 2
+        dims = [1, nsize, 1, 1]
+        win = lax.reduce_window(sq, 0.0, lax.add, dims, [1, 1, 1, 1],
+                                [(0, 0), (half, half), (0, 0), (0, 0)])
+        return x * lax.pow(knorm + alpha / nsize * win, -beta)
+    return _invoke(fn, (data,), name="lrn")
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
+    """Reference: src/operator/roi_pooling.cc. rois: (n, 5) of
+    [batch_idx, x1, y1, x2, y2] in image coords."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+    _drop_name(kw)
+    ph, pw = _tup(pooled_size, 2)
+    scale = float(_lit(spatial_scale))
+
+    def fn(x, r):
+        def one(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+            y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+            x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+            y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+            h = x.shape[2]
+            w = x.shape[3]
+            fmap = jax.lax.dynamic_index_in_dim(x, b, 0, keepdims=False)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1)
+            bin_h = roi_h / ph
+            bin_w = roi_w / pw
+            iy = jnp.arange(h)
+            ix = jnp.arange(w)
+
+            def pool_bin(py, px):
+                ys = y1 + jnp.floor(py * bin_h).astype(jnp.int32)
+                ye = y1 + jnp.ceil((py + 1) * bin_h).astype(jnp.int32)
+                xs = x1 + jnp.floor(px * bin_w).astype(jnp.int32)
+                xe = x1 + jnp.ceil((px + 1) * bin_w).astype(jnp.int32)
+                mask = ((iy[:, None] >= ys) & (iy[:, None] < ye) &
+                        (ix[None, :] >= xs) & (ix[None, :] < xe))
+                neg = jnp.finfo(x.dtype).min
+                masked = jnp.where(mask[None], fmap, neg)
+                return jnp.max(masked, axis=(1, 2))
+            grid = [[pool_bin(py, px) for px in range(pw)]
+                    for py in range(ph)]
+            return jnp.stack([jnp.stack(row, -1) for row in grid], -2)
+        return jax.vmap(one)(r)
+    return _invoke(fn, (data, rois), name="roi_pooling")
+
+
+@register("SequenceMask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    if not _b(use_sequence_length) or sequence_length is None:
+        return data
+    return npx.sequence_mask(data, sequence_length,
+                             use_sequence_length=True,
+                             value=float(_lit(value)), axis=int(_lit(axis)))
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False,
+                   axis=0, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.sequence_last(data, sequence_length,
+                             use_sequence_length=_b(use_sequence_length),
+                             axis=int(_lit(axis)))
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0, **kw):
+    from .. import numpy_extension as npx
+    _drop_name(kw)
+    return npx.sequence_reverse(data, sequence_length,
+                                use_sequence_length=_b(use_sequence_length),
+                                axis=int(_lit(axis)))
+
+
+@register("Softmax")
+def _softmax_legacy(data, *args, **kw):
+    """1.x deprecated alias of SoftmaxOutput (reference softmax.cc alias);
+    with a single input it is plain softmax."""
+    from .. import numpy_extension as npx
+    if args or "label" in kw:
+        return _softmax_output(data, *args, **kw)
+    _drop_name(kw)
+    return npx.softmax(data, axis=-1)
+
+
+@register("Custom")
+def _custom(*inputs, op_type=None, **kw):
+    from .. import operator as _op
+    _drop_name(kw)
+    return _op.Custom(*inputs, op_type=op_type, **kw)
+
+
+# -- legacy snake_case names with no direct np analog -----------------------
+def _register_broadcast_aliases():
+    from .. import numpy as _np
+
+    pairs = {
+        "broadcast_add": "add", "broadcast_plus": "add",
+        "broadcast_sub": "subtract", "broadcast_minus": "subtract",
+        "broadcast_mul": "multiply", "broadcast_div": "divide",
+        "broadcast_mod": "mod", "broadcast_power": "power",
+        "broadcast_maximum": "maximum", "broadcast_minimum": "minimum",
+        "broadcast_equal": "equal", "broadcast_not_equal": "not_equal",
+        "broadcast_greater": "greater",
+        "broadcast_greater_equal": "greater_equal",
+        "broadcast_lesser": "less", "broadcast_lesser_equal": "less_equal",
+        "broadcast_logical_and": "logical_and",
+        "broadcast_logical_or": "logical_or",
+        "broadcast_logical_xor": "logical_xor",
+        "broadcast_hypot": "hypot",
+        "elemwise_add": "add", "elemwise_sub": "subtract",
+        "elemwise_mul": "multiply", "elemwise_div": "divide",
+    }
+    for legacy, np_name in pairs.items():
+        def mk(np_name=np_name, legacy=legacy):
+            def fn(*args, **kwargs):
+                _drop_name(kwargs)
+                return getattr(_np, np_name)(*args, **kwargs)
+            fn.__name__ = legacy
+            return fn
+        LEGACY_OPS[legacy] = mk()
+
+    def broadcast_to(data, shape=None, **kw):
+        _drop_name(kw)
+        shape = _tup(shape)
+        # legacy: 0 in target shape keeps the source dim
+        shape = tuple(s if s != 0 else data.shape[i]
+                      for i, s in enumerate(shape))
+        return _np.broadcast_to(data, shape)
+    LEGACY_OPS["broadcast_to"] = broadcast_to
+
+    def broadcast_axis(data, axis=None, size=None, **kw):
+        _drop_name(kw)
+        axes = _tup(axis)
+        sizes = _tup(size)
+        target = list(data.shape)
+        for a, s in zip(axes, sizes):
+            target[a] = s
+        return _np.broadcast_to(data, tuple(target))
+    LEGACY_OPS["broadcast_axis"] = broadcast_axis
+    LEGACY_OPS["broadcast_axes"] = broadcast_axis
+
+    def stop_gradient(data, **kw):
+        return _block_grad(data, **kw)
+    LEGACY_OPS["stop_gradient"] = stop_gradient
+
+    def argmax_channel(data, **kw):
+        _drop_name(kw)
+        return _np.argmax(data, axis=1).astype(data.dtype)
+    LEGACY_OPS["argmax_channel"] = argmax_channel
+
+    def flatten(data, **kw):
+        return _flatten(data, **kw)
+    LEGACY_OPS["flatten"] = flatten
+
+    def identity(data, **kw):
+        _drop_name(kw)
+        return data + 0
+    LEGACY_OPS["identity"] = identity
+
+    def zeros_like(data, **kw):
+        _drop_name(kw)
+        return _np.zeros_like(data)
+    LEGACY_OPS["zeros_like"] = zeros_like
+
+    def ones_like(data, **kw):
+        _drop_name(kw)
+        return _np.ones_like(data)
+    LEGACY_OPS["ones_like"] = ones_like
+
+    def norm(data, ord=2, axis=None, keepdims=False, **kw):  # noqa: A002
+        _drop_name(kw)
+        from ..numpy.multiarray import _invoke
+        import jax.numpy as jnp
+        o, ax = _lit(ord), _tup(axis) if axis is not None else None
+        if ax is not None and len(ax) == 1:
+            ax = ax[0]
+
+        def fn(x):
+            if ax is None:
+                # legacy: reduce over ALL elements (never a matrix norm)
+                x = x.ravel()
+            return jnp.linalg.norm(x, ord=None if o == 2 else o, axis=ax,
+                                   keepdims=_b(keepdims))
+        return _invoke(fn, (data,), name="norm")
+    LEGACY_OPS["norm"] = norm
+
+
+_register_broadcast_aliases()

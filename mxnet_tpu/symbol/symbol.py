@@ -263,12 +263,16 @@ def _make(op, *inputs, **kwargs):
 def _resolve(op):
     from .. import numpy as np
     from .. import numpy_extension as npx
+    from ..ndarray import register as _legacy
     if op == "constant":
         def c(value=None):
             return np.array(value) if not isinstance(value, ndarray) else value
         return c
     if op == "slice_index":
         return lambda x, index=None: x[index]
+    fn = _legacy.get(op)
+    if fn is not None:
+        return fn
     for mod in (np, npx):
         fn = getattr(mod, op, None)
         if fn is not None:
@@ -346,11 +350,13 @@ class Executor:
 
 
 def __getattr__(name):
-    """Any mx.np / mx.npx op lifted to symbolic composition (the analog of
-    symbol/register.py generated wrappers)."""
+    """Any mx.np / mx.npx / legacy-table op lifted to symbolic composition
+    (the analog of symbol/register.py generated wrappers)."""
     from .. import numpy as np
     from .. import numpy_extension as npx
-    target = getattr(np, name, None) or getattr(npx, name, None)
+    from ..ndarray import register as _legacy
+    target = _legacy.get(name) or getattr(np, name, None) \
+        or getattr(npx, name, None)
     if target is None or not callable(target):
         raise AttributeError(name)
 

@@ -1,0 +1,223 @@
+"""Legacy CamelCase op surface (mx.nd.* / mx.sym.*).
+
+Reference parity: python/mxnet/ndarray/register.py:115-277 and
+symbol/register.py generate one python function per registered op at
+import; 1.x scripts use CamelCase layer names (FullyConnected,
+Convolution, BatchNorm, SliceChannel, ...).  These tests parity-lock the
+surface and check numerics against the np/npx implementations.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+LEGACY_NAMES = [
+    # the CamelCase ops registered in the reference's src/operator/**.cc
+    "Activation", "BatchNorm", "BlockGrad", "CTCLoss", "Cast", "Concat",
+    "Convolution", "Crop", "Custom", "Deconvolution", "Dropout",
+    "ElementWiseSum", "Embedding", "ExpandDims", "Flatten",
+    "FullyConnected", "GroupNorm", "IdentityAttachKLSparseReg",
+    "InstanceNorm", "L2Normalization", "LRN", "LayerNorm", "LeakyReLU",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "MakeLoss", "Pad", "Pooling", "RNN",
+    "ROIPooling", "Reshape", "SequenceLast", "SequenceMask",
+    "SequenceReverse", "SliceChannel", "Softmax", "SoftmaxOutput",
+    "SwapAxis", "UpSampling",
+    # legacy snake_case names with no np analog
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_maximum", "broadcast_minimum", "broadcast_power",
+    "broadcast_greater", "broadcast_to", "broadcast_axis",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "stop_gradient", "argmax_channel", "ones_like", "zeros_like",
+    # tensor ops nd must expose (np or npx backed)
+    "dot", "batch_dot", "one_hot", "pick", "topk", "gather_nd",
+    "slice_axis", "slice_like", "sequence_mask", "clip", "take", "tile",
+    "repeat", "where", "abs", "exp", "log", "sqrt", "square", "maximum",
+    "minimum", "argmax", "argmin", "sum", "mean", "max", "min", "norm",
+]
+
+
+def test_legacy_surface_parity_lock():
+    missing = []
+    for name in LEGACY_NAMES:
+        if not callable(getattr(nd, name, None)):
+            missing.append(f"nd.{name}")
+        if not callable(getattr(sym, name, None)):
+            missing.append(f"sym.{name}")
+    assert not missing, f"legacy names absent: {missing}"
+
+
+def test_fully_connected_legacy_kwargs():
+    x = nd.array(onp.random.randn(4, 10).astype("float32"))
+    w = nd.array(onp.random.randn(3, 10).astype("float32"))
+    b = nd.array(onp.random.randn(3).astype("float32"))
+    out = nd.FullyConnected(x, w, b, num_hidden=3)
+    ref = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+    out2 = nd.FullyConnected(x, w, num_hidden=3, no_bias=True)
+    onp.testing.assert_allclose(out2.asnumpy(), ref - b.asnumpy(), rtol=1e-5)
+
+
+def test_convolution_legacy_kwargs():
+    x = nd.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+    w = nd.array(onp.random.randn(4, 3, 3, 3).astype("float32") * 0.1)
+    b = nd.array(onp.zeros(4, "float32"))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4,
+                         stride=(1, 1), pad=(1, 1))
+    assert out.shape == (2, 4, 8, 8)
+    # string attrs (as found in serialized symbol json)
+    out2 = nd.Convolution(x, w, b, kernel="(3, 3)", num_filter="4",
+                          stride="(1, 1)", pad="(1, 1)")
+    onp.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+
+def test_batchnorm_pooling_activation_chain():
+    x = nd.array(onp.random.randn(2, 4, 8, 8).astype("float32"))
+    gamma = nd.ones(4)
+    beta = nd.zeros(4)
+    rmean = nd.zeros(4)
+    rvar = nd.ones(4)
+    y = nd.BatchNorm(x, gamma, beta, rmean, rvar, fix_gamma=True)
+    y = nd.Activation(y, act_type="relu")
+    y = nd.Pooling(y, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert y.shape == (2, 4, 4, 4)
+    assert float(y.asnumpy().min()) >= 0.0
+
+
+def test_slice_channel_and_concat_roundtrip():
+    x = nd.array(onp.random.randn(2, 6, 4).astype("float32"))
+    parts = nd.SliceChannel(x, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2, 4)
+    back = nd.Concat(*parts, dim=1)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+    sq = nd.SliceChannel(x, num_outputs=6, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2, 4)
+
+
+def test_reshape_legacy_codes():
+    x = nd.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+    assert nd.Reshape(x, shape=(-1,)).shape == (24,)
+    assert nd.Reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.Reshape(x, shape=(-3, 0)).shape == (6, 4)
+
+
+def test_softmax_output_loss_gradient():
+    from mxnet_tpu import autograd
+    x = nd.array(onp.random.randn(4, 3).astype("float32"))
+    lab = nd.array(onp.array([0, 1, 2, 1], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, lab)
+    out.backward()
+    p = out.asnumpy()
+    onehot = onp.eye(3, dtype="float32")[lab.asnumpy().astype(int)]
+    onp.testing.assert_allclose(x.grad.asnumpy(), p - onehot, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_upsampling_and_pad():
+    x = nd.array(onp.random.randn(1, 2, 3, 3).astype("float32"))
+    up = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 6, 6)
+    onp.testing.assert_allclose(up.asnumpy()[0, 0, :2, :2],
+                                onp.full((2, 2), x.asnumpy()[0, 0, 0, 0]))
+    padded = nd.Pad(x, mode="constant",
+                    pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=5)
+    assert padded.shape == (1, 2, 5, 7)
+    assert padded.asnumpy()[0, 0, 0, 0] == 5
+
+
+def test_lrn_matches_formula():
+    x = onp.random.randn(2, 8, 4, 4).astype("float32")
+    out = nd.LRN(nd.array(x), alpha=1e-3, beta=0.75, knorm=2, nsize=3)
+    sq = x ** 2
+    acc = onp.zeros_like(x)
+    for c in range(8):
+        lo, hi = max(0, c - 1), min(8, c + 2)
+        acc[:, c] = sq[:, lo:hi].sum(axis=1)
+    ref = x * (2 + 1e-3 / 3 * acc) ** -0.75
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_broadcast_and_elemwise_aliases():
+    a = nd.array(onp.random.randn(2, 1, 4).astype("float32"))
+    b = nd.array(onp.random.randn(1, 3, 4).astype("float32"))
+    onp.testing.assert_allclose(nd.broadcast_add(a, b).asnumpy(),
+                                a.asnumpy() + b.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(
+        nd.broadcast_to(a, shape=(2, 3, 0)).asnumpy(),
+        onp.broadcast_to(a.asnumpy(), (2, 3, 4)), rtol=1e-6)
+    onp.testing.assert_allclose(
+        nd.broadcast_axis(a, axis=1, size=3).asnumpy(),
+        onp.broadcast_to(a.asnumpy(), (2, 3, 4)), rtol=1e-6)
+
+
+def test_sym_legacy_mlp_1x_style():
+    """A 1.x-style symbol script: build MLP with CamelCase ops, bind,
+    forward, backward, SGD step — the reference's classic mnist_mlp."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    fc1 = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                             sym.Variable("fc1_bias"), num_hidden=16,
+                             name="fc1")
+    act1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act1, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"), num_hidden=4,
+                             name="fc2")
+    out = sym.SoftmaxOutput(fc2, label, name="softmax")
+
+    assert set(out.list_arguments()) == {
+        "data", "softmax_label", "fc1_weight", "fc1_bias", "fc2_weight",
+        "fc2_bias"}
+
+    rng = onp.random.RandomState(0)
+    args = {
+        "data": nd.array(rng.randn(8, 10).astype("float32")),
+        "softmax_label": nd.array(rng.randint(0, 4, 8).astype("float32")),
+        "fc1_weight": nd.array(rng.randn(16, 10).astype("float32") * 0.1),
+        "fc1_bias": nd.zeros(16),
+        "fc2_weight": nd.array(rng.randn(4, 16).astype("float32") * 0.1),
+        "fc2_bias": nd.zeros(4),
+    }
+    exe = out.bind(args=args)
+    probs = exe.forward(is_train=True)[0]
+    assert probs.shape == (8, 4)
+    onp.testing.assert_allclose(probs.asnumpy().sum(-1),
+                                onp.ones(8), rtol=1e-5)
+    exe.backward()
+    g = exe.grad_dict
+    assert "fc1_weight" in g and g["fc1_weight"].shape == (16, 10)
+    assert float(onp.abs(g["fc2_weight"].asnumpy()).sum()) > 0
+
+    # json round-trip preserves legacy attrs
+    js = out.tojson()
+    out2 = sym.load_json(js)
+    probs2 = out2.bind(args=args).forward()[0]
+    onp.testing.assert_allclose(probs2.asnumpy(), probs.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_sym_legacy_convnet_eval():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, sym.Variable("w"), sym.Variable("b"),
+                           kernel=(3, 3), num_filter=2, pad=(1, 1))
+    act = sym.Activation(conv, act_type="tanh")
+    pool = sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    flat = sym.Flatten(pool)
+    rng = onp.random.RandomState(1)
+    out = flat.eval(
+        data=nd.array(rng.randn(1, 1, 4, 4).astype("float32")),
+        w=nd.array(rng.randn(2, 1, 3, 3).astype("float32")),
+        b=nd.zeros(2))[0]
+    assert out.shape == (1, 8)
+
+
+def test_nd_npx_fallback():
+    # tensor npx ops reachable through nd (legacy exposed them flat)
+    x = nd.array(onp.random.randn(3, 4).astype("float32"))
+    out = nd.slice_axis(x, axis=1, begin=1, end=3)
+    assert out.shape == (3, 2)
+    out = nd.topk(x, k=2)
+    assert out.shape == (3, 2)
