@@ -296,10 +296,10 @@ class _CachedGraph:
             for i, l in enumerate(leaves):
                 if l is _ARR:
                     leaves[i] = _wrap(next(it))
-            args = jax.tree_util.tree_unflatten(treedef, leaves)
+            fargs, fkwargs = jax.tree_util.tree_unflatten(treedef, leaves)
             with autograd._RecordingStateScope(False, self.train_mode), \
                     _random.trace_key_scope(rng_key):
-                out = self.block.forward(*args)
+                out = self.block.forward(*fargs, **fkwargs)
             out_leaves, out_tree = _flatten_args(out)
             out_raws = [l._data if _is_nd(l) else l for l in out_leaves]
             self._out_trees[sig_key] = out_tree  # trace-time side channel
@@ -322,9 +322,14 @@ class _CachedGraph:
         return self._call_impl(args)
 
     def _call_impl(self, args):
+        import numpy as onp
         leaves, treedef = _flatten_args(args)
         input_raws, static_leaves = [], []
-        for l in leaves:
+        for i, l in enumerate(leaves):
+            if isinstance(l, (jax.Array, onp.ndarray)) and not _is_nd(l):
+                # raw arrays (e.g. kwarg masks) must be traced inputs —
+                # keyed by repr() they would silently bake in as constants
+                leaves[i] = l = _wrap(jnp.asarray(l))
             if _is_nd(l):
                 input_raws.append(l._data)
                 static_leaves.append(_ARR)
@@ -468,29 +473,20 @@ class HybridBlock(Block):
                                     for a in args]
         if not self._active:
             return super().__call__(*args, **kwargs)
-        if kwargs:
-            # keyword args are not part of the trace signature; warn once
-            # instead of silently never compiling (the reference's
-            # _build_cache has the same positional-only restriction)
-            if not getattr(self, "_warned_kwargs_eager", False):
-                import warnings
-                warnings.warn(
-                    f"{type(self).__name__} is hybridized but was called "
-                    "with keyword arguments; running eagerly (pass inputs "
-                    "positionally to use the compiled path)",
-                    stacklevel=2)
-                self._warned_kwargs_eager = True
-            return super().__call__(*args, **kwargs)
         if self._ensure_init(*args):
             # first call: eager, triggers deferred init (the reference's
             # _build_cache also runs a traced forward first, block.py:1095)
-            return super().__call__(*args)
+            return super().__call__(*args, **kwargs)
         key = self._train_key()
         graph = self._cached_graphs.get(key)
         if graph is None:
             graph = _CachedGraph(self, key)
             self._cached_graphs[key] = graph
-        return graph(args)
+        # (args, kwargs) form one pytree: keyword names land in the treedef
+        # and therefore in the trace-cache key, so keyword calls compile
+        # exactly like positional ones (the reference's _build_cache is
+        # positional-only and errors; block.py:1095)
+        return graph((args, kwargs))
 
     @staticmethod
     def _train_key():

@@ -291,3 +291,64 @@ def test_hybrid_export_import(tmp_path):
     sym_file, param_file = net.export(str(tmp_path / "model"))
     net2 = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
     assert_almost_equal(y0, net2(x))
+
+
+def test_hybridize_kwargs_compile():
+    """Keyword calls must use the compiled path, not fall back to eager
+    (round-2 regression: BERT's encoder was called with kwargs and
+    silently ran eagerly)."""
+    import warnings
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    class KwNet(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = gluon.nn.Dense(4, in_units=6)
+
+        def forward(self, x, scale=None, flag=True):
+            out = self.dense(x)
+            if scale is not None:
+                out = out * scale
+            return out if flag else -out
+
+    net = KwNet()
+    net.initialize()
+    x = mx.np.array(onp.random.randn(2, 6).astype("float32"))
+    s = mx.np.array(onp.float32(2.0))
+    eager = net(x, scale=s, flag=True)
+    net.hybridize()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any eager-fallback warning fails
+        out = net(x, scale=s, flag=True)
+    assert net._cached_graphs, "kwargs call did not reach the compiled path"
+    onp.testing.assert_allclose(out.asnumpy(), eager.asnumpy(), rtol=1e-6)
+    # different static kwarg -> distinct trace, correct result
+    out2 = net(x, scale=s, flag=False)
+    onp.testing.assert_allclose(out2.asnumpy(), -eager.asnumpy(), rtol=1e-6)
+    # positional call still works against the same cache
+    out3 = net(x)
+    onp.testing.assert_allclose(out3.asnumpy(),
+                                net.dense(x).asnumpy(), rtol=1e-6)
+
+
+def test_model_zoo_bert_encoder_compiles():
+    import warnings
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining
+
+    net = BERTForPretraining(vocab_size=100, units=16, hidden_size=32,
+                             num_layers=1, num_heads=2, max_length=32,
+                             dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    ids = mx.np.array(onp.random.randint(0, 100, (2, 8)), dtype="int32")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        net(ids)  # first call: deferred init, eager
+        net(ids)  # compiled; must not warn about eager fallback

@@ -1,0 +1,99 @@
+"""Pretrained-weights cache (reference: gluon/model_zoo/model_store.py
+get_model_file — sha1-checked files under MXNET_HOME/models).
+
+No egress in CI, so the tests provision fixture archives offline and
+drive the full path: get_model_file -> sha1 verification ->
+load_parameters -> identical forward outputs.
+"""
+import hashlib
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import model_store
+from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+
+def _sha1(path):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def _provision(tmp_path, name, net):
+    """Save net's params as the hash-named legacy archive for `name` and
+    point the sha1 table at the fixture (the real table entries identify
+    the official Apache artifacts, which offline CI cannot fetch)."""
+    root = tmp_path / "models"
+    root.mkdir(exist_ok=True)
+    tmp = root / "tmp.params"
+    net.save_parameters(str(tmp))
+    sha = _sha1(str(tmp))
+    target = root / f"{name}-{sha[:8]}.params"
+    os.rename(tmp, target)
+    model_store._model_sha1[name] = sha
+    return str(root), str(target)
+
+
+def test_sha1_table_populated():
+    # parity with the reference's table (model_store.py:30-64)
+    assert len(model_store._model_sha1) >= 34
+    assert model_store._model_sha1["resnet18_v1"].startswith("a0666292")
+    assert model_store.short_hash("resnet50_v1") == "0aee57f9"
+
+
+def test_get_model_file_verifies_and_loads(tmp_path, monkeypatch):
+    saved = dict(model_store._model_sha1)
+    try:
+        src = resnet18_v1(classes=10)
+        src.initialize()
+        x = mx.np.array(onp.random.randn(1, 3, 32, 32).astype("float32"))
+        ref_out = src(x)
+        root, path = _provision(tmp_path, "resnet18_v1", src)
+
+        got = model_store.get_model_file("resnet18_v1", root=root)
+        assert got == path
+
+        net = resnet18_v1(pretrained=True, root=root, classes=10)
+        out = net(x)
+        onp.testing.assert_allclose(out.asnumpy(), ref_out.asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+    finally:
+        model_store._model_sha1.clear()
+        model_store._model_sha1.update(saved)
+
+
+def test_get_model_file_rejects_corrupt(tmp_path):
+    saved = dict(model_store._model_sha1)
+    try:
+        src = resnet18_v1(classes=10)
+        src.initialize()
+        root, path = _provision(tmp_path, "resnet18_v1", src)
+        with open(path, "ab") as f:
+            f.write(b"corruption")
+        with pytest.raises(MXNetError, match="checksum mismatch"):
+            model_store.get_model_file("resnet18_v1", root=root)
+    finally:
+        model_store._model_sha1.clear()
+        model_store._model_sha1.update(saved)
+
+
+def test_missing_weights_error_is_actionable(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_GLUON_REPO", "file:///nonexistent")
+    with pytest.raises(MXNetError, match="provision|Provision"):
+        model_store.get_model_file("vgg16", root=str(tmp_path / "empty"))
+
+
+def test_purge(tmp_path):
+    root = tmp_path / "models"
+    root.mkdir()
+    (root / "x.params").write_bytes(b"1")
+    (root / "y.zip").write_bytes(b"2")
+    (root / "keep.txt").write_bytes(b"3")
+    model_store.purge(str(root))
+    assert sorted(os.listdir(root)) == ["keep.txt"]
