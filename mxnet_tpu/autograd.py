@@ -258,12 +258,12 @@ def _run_backward(heads, head_grads, retain_graph, accumulate_to_vars,
                 # leaf variable
                 key = id(p)
                 if key in var_grads:
-                    var_grads[key] = (p, var_grads[key][1] + ig)
+                    var_grads[key] = (p, _accum(var_grads[key][1], ig))
                 else:
                     var_grads[key] = (p, ig)
             else:
                 key = _outkey(p.node, p.index)
-                cot[key] = cot[key] + ig if key in cot else ig
+                cot[key] = _accum(cot[key], ig) if key in cot else ig
         if not retain_graph:
             node.vjp_fn = None  # free residuals
 
@@ -346,6 +346,18 @@ def _zero_cot(shape, dt):
 
 def _is_float0(x):
     return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _accum(a, b):
+    """Cotangent accumulation; row-sparse cotangents (embedding
+    sparse_grad) merge through sparse.add instead of jnp +."""
+    from .ndarray import sparse as _sp
+    if isinstance(a, _sp.BaseSparseNDArray) or \
+            isinstance(b, _sp.BaseSparseNDArray):
+        out = _sp.add(a, b)
+        return out if isinstance(out, _sp.BaseSparseNDArray) else \
+            (out._data if hasattr(out, "_data") else out)
+    return a + b
 
 
 def get_symbol(x):

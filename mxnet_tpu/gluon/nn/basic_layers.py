@@ -291,11 +291,10 @@ class InstanceNorm(HybridBlock):
 class Embedding(HybridBlock):
     """Reference: basic_layers.py Embedding over indexing_op.cc.
 
-    ``sparse_grad=True`` marks the weight for row-sparse access:
-    ``weight.row_sparse_data(ids)`` / ``kvstore.row_sparse_pull`` fetch only
-    touched rows. The gradient itself is computed dense (XLA scatter-add —
-    the reference's storage-fallback path when a dense kernel serves a
-    sparse request, src/common/exec_utils.h)."""
+    ``sparse_grad=True`` gives the weight a ``RowSparseNDArray`` gradient
+    (O(batch) rows; see npx.embedding) feeding lazy_update optimizers and
+    kvstore row_sparse push, plus row-sparse access via
+    ``weight.row_sparse_data(ids)`` / ``kvstore.row_sparse_pull``."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False):
@@ -303,15 +302,18 @@ class Embedding(HybridBlock):
         self._input_dim = input_dim
         self._output_dim = output_dim
         self._sparse_grad = sparse_grad
-        self.weight = Parameter("weight", shape=(input_dim, output_dim),
-                                dtype=dtype, init=weight_initializer)
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         if self.weight._data is None:
             self.weight._finish_deferred_init()
         return npx.embedding(x, self.weight.data(),
                              input_dim=self._input_dim,
-                             output_dim=self._output_dim)
+                             output_dim=self._output_dim,
+                             sparse_grad=self._sparse_grad)
 
 
 class Flatten(HybridBlock):

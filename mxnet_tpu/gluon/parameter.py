@@ -40,6 +40,8 @@ class Parameter:
         if not differentiable:
             grad_req = "null"
         self._grad_req = grad_req
+        self._stype = stype
+        self._grad_stype = grad_stype
         self._data = None
         self._deferred_init = None  # (initializer, ctx)
         self._structure_name = None  # set by Block registration

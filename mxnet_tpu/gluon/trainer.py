@@ -159,13 +159,22 @@ class Trainer:
             self._kvstore = kv
             if self._compression_params and hasattr(kv, "set_gradient_compression"):
                 kv.set_gradient_compression(self._compression_params)
+            has_sparse = any(getattr(p, "_grad_stype", "default") ==
+                             "row_sparse" for p in self._params)
             if self._update_on_kvstore is None:
                 # env/config override first (reference: MXNET_UPDATE_ON_KVSTORE,
-                # trainer.py:36); default False — fused local update is faster
+                # trainer.py:36); default False — fused local update is faster.
+                # Row-sparse gradients force optimizer-on-store, like the
+                # reference (trainer.py: contains_sparse check).
                 from .. import config
                 forced = config.get("update_on_kvstore")
                 self._update_on_kvstore = (bool(forced)
-                                           if forced is not None else False)
+                                           if forced is not None
+                                           else has_sparse)
+            elif has_sparse and not self._update_on_kvstore:
+                raise MXNetError(
+                    "update_on_kvstore=False is not supported with "
+                    "row_sparse gradients (reference trainer.py raises too)")
             if self._update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
             for i, p in enumerate(self._params):
@@ -227,8 +236,17 @@ class Trainer:
                     # weights were updated inside the store: pull them back
                     self._kvstore.pull(i, out=p.data(), priority=-i)
             return
-        work = [(i, p) for i, p in enumerate(self._params)
-                if p.grad_req != "null" and p._data is not None]
+        from ..ndarray.sparse import BaseSparseNDArray
+        work, sparse_work = [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if isinstance(p.grad(), BaseSparseNDArray):
+                sparse_work.append((i, p))  # row-wise lazy/densified update
+            else:
+                work.append((i, p))
+        for i, p in sparse_work:
+            updater(i, p.grad(), p.data())
         if not work:
             return
         if self._fused_update is None:

@@ -117,9 +117,16 @@ class KVStore(KVStoreBase):
 
     @staticmethod
     def _reduce(vals):
-        """Merged value of a push (single reduced copy)."""
-        if isinstance(vals, ndarray):
+        """Merged value of a push (single reduced copy). Row-sparse values
+        reduce sparsely (reference: comm.h ReduceRowSparse)."""
+        from ..ndarray.sparse import BaseSparseNDArray, add as _sp_add
+        if isinstance(vals, (ndarray, BaseSparseNDArray)):
             return vals
+        if any(isinstance(v, BaseSparseNDArray) for v in vals):
+            merged = vals[0]
+            for v in vals[1:]:
+                merged = _sp_add(merged, v)
+            return merged
         return KVStore._reduce_parts(vals)[0]
 
     def push(self, key, value, priority=0):
@@ -131,6 +138,9 @@ class KVStore(KVStoreBase):
             if self._updater is not None:
                 self._updater(self._key_int(k), merged, self._store[k])
             else:
+                from ..ndarray.sparse import BaseSparseNDArray
+                if isinstance(merged, BaseSparseNDArray):
+                    merged = merged.tostype("default")
                 self._store[k]._rebind(merged._data.astype(self._store[k].dtype))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):

@@ -26,7 +26,42 @@ from ..numpy.multiarray import ndarray, _wrap
 _IDX = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
-           "csr_matrix", "zeros", "retain", "dot", "add", "BaseSparseNDArray"]
+           "csr_matrix", "zeros", "retain", "dot", "add", "BaseSparseNDArray",
+           "dedupe_coo"]
+
+
+def dedupe_coo(indices, values, n_rows):
+    """Sum duplicate rows of a COO batch, jit-friendly (static shapes).
+
+    Returns (uidx, uvals) of the same static length k where the distinct
+    row ids (sorted) occupy the leading slots and unused slots are padded
+    with the sentinel index ``n_rows`` and zero values.  Scatter consumers
+    must use out-of-range-safe modes (padding rows carry zeros, so
+    clip-mode scatter-ADD is also safe).  This is the TPU-native encoding
+    of the reference's "sorted unique indices" RowSparse invariant
+    (include/mxnet/ndarray.h:60-64) under XLA's static-shape rule: nnz is
+    data-dependent, so we keep k = len(indices) slots and mask.
+    """
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values)
+    k = indices.shape[0]
+    order = jnp.argsort(indices)
+    sidx = indices[order]
+    svals = values[order]
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             sidx[1:] != sidx[:-1]]) if k else \
+        jnp.ones((0,), bool)
+    slot = jnp.cumsum(first.astype(_IDX)) - 1          # group id per entry
+    uvals = jax.ops.segment_sum(svals, slot, num_segments=k)
+    # row id of each group: scatter the first-occurrence ids to their slot
+    uidx = jnp.full((k,), n_rows, sidx.dtype).at[slot].set(
+        sidx, mode="drop")
+    n_unique = (slot[-1] + 1) if k else jnp.zeros((), _IDX)
+    valid = jnp.arange(k) < n_unique
+    uidx = jnp.where(valid, uidx, n_rows)
+    uvals = jnp.where(valid.reshape((-1,) + (1,) * (values.ndim - 1)),
+                      uvals, jnp.zeros((), uvals.dtype))
+    return uidx.astype(_IDX), uvals
 
 
 def _as_raw(x, dtype=None):
@@ -68,7 +103,12 @@ class BaseSparseNDArray:
 class RowSparseNDArray(BaseSparseNDArray):
     """Rows at ``indices`` hold ``data``; all other rows are zero
     (reference: sparse.py RowSparseNDArray). data: (nnz, *row_shape),
-    indices: (nnz,) int64, sorted unique."""
+    indices: (nnz,) int64, sorted unique.
+
+    TPU static-shape extension: indices may be padded with the sentinel
+    value ``shape[0]`` (with zero rows in ``data``) so jit-produced sparse
+    gradients keep a static slot count — see ``dedupe_coo``.  All consumers
+    here scatter with add/drop semantics, which makes padding inert."""
 
     def __init__(self, data, indices, shape):
         self.data = data if isinstance(data, ndarray) else _wrap(_as_raw(data))
@@ -90,7 +130,10 @@ class RowSparseNDArray(BaseSparseNDArray):
         if stype != "default":
             raise MXNetError(f"cannot convert row_sparse to {stype!r}")
         dense = jnp.zeros(self.shape, self.data.dtype)
-        dense = dense.at[self.indices._data].set(self.data._data)
+        # add + drop (not set): unique-indices invariant makes add exact,
+        # and sentinel padding rows fall out of range harmlessly
+        dense = dense.at[self.indices._data].add(self.data._data,
+                                                 mode="drop")
         return _wrap(dense)
 
     def retain(self, row_ids):
@@ -109,8 +152,15 @@ class RowSparseNDArray(BaseSparseNDArray):
         return RowSparseNDArray(self.data.copy(), self.indices.copy(),
                                 self.shape)
 
+    def astype(self, dtype):
+        return RowSparseNDArray(self.data.astype(dtype), self.indices,
+                                self.shape)
+
     def __add__(self, other):
         return add(self, other)
+
+    def __radd__(self, other):
+        return add(other, self)
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -236,21 +286,18 @@ def dot(lhs, rhs, transpose_a=False):
 
 
 def add(a, b):
-    """Sparse + sparse/dense. Same-stype row_sparse adds merge indices;
-    anything else densifies (the reference's storage-fallback path,
+    """Sparse + sparse/dense. Same-stype row_sparse adds stay sparse
+    (concatenate the COO slots then ``dedupe_coo`` — static shapes, jit
+    safe); anything else densifies (the reference's storage-fallback path,
     src/common/exec_utils dispatch-fallback)."""
     if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
         if a.shape != b.shape:
             raise MXNetError("shape mismatch")
-        idx = onp.union1d(onp.asarray(a.indices._data),
-                          onp.asarray(b.indices._data)).astype("int64")
-        pos = {int(i): j for j, i in enumerate(idx)}
-        vals = onp.zeros((len(idx),) + a.shape[1:],
-                         onp.asarray(a.data._data).dtype)
-        for rsp in (a, b):
-            for j, i in enumerate(onp.asarray(rsp.indices._data)):
-                vals[pos[int(i)]] += onp.asarray(rsp.data._data[j])
-        return RowSparseNDArray(jnp.asarray(vals), jnp.asarray(idx), a.shape)
+        idx = jnp.concatenate([a.indices._data.astype(_IDX),
+                               b.indices._data.astype(_IDX)])
+        vals = jnp.concatenate([a.data._data, b.data._data])
+        uidx, uvals = dedupe_coo(idx, vals, a.shape[0])
+        return RowSparseNDArray(uvals, uidx, a.shape)
     da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
     db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
     return da + db

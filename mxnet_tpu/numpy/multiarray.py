@@ -293,6 +293,28 @@ class ndarray:
     def _write_grad(self, raw_grad):
         if self._grad_req == "null" or self._grad is None:
             return
+        from ..ndarray import sparse as _sp
+        if isinstance(raw_grad, _sp.BaseSparseNDArray):
+            # row-sparse gradient (embedding sparse_grad): .grad becomes
+            # the sparse object, the reference's grad-stype row_sparse
+            if self._grad_req == "add":
+                if isinstance(self._grad, _sp.BaseSparseNDArray):
+                    self._grad = _sp.add(self._grad, raw_grad)
+                elif bool(jnp.any(self._grad._data != 0)):
+                    # accumulated dense grad present: densify-and-add
+                    dense = self._grad._data + \
+                        raw_grad.tostype("default")._data
+                    self._grad = _wrap(dense.astype(self.dtype))
+                else:
+                    self._grad = raw_grad.astype(self.dtype)
+            else:
+                self._grad = raw_grad.astype(self.dtype)
+            return
+        if isinstance(self._grad, _sp.BaseSparseNDArray):
+            # dense grad arriving over a sparse one: densify
+            dense = self._grad.tostype("default")._data + raw_grad
+            self._grad = _wrap(dense.astype(self.dtype))
+            return
         g = raw_grad.astype(self._grad.dtype)
         if self._grad_req == "add":
             self._grad._rebind(self._grad._data + g)
@@ -300,8 +322,15 @@ class ndarray:
             self._grad._rebind(g)
 
     def zero_grad(self):
-        if self._grad is not None:
-            self._grad._rebind(jnp.zeros_like(self._grad._data))
+        if self._grad is None:
+            return
+        from ..ndarray import sparse as _sp
+        if isinstance(self._grad, _sp.BaseSparseNDArray):
+            # back to a dense zero buffer; the next sparse backward
+            # replaces it wholesale
+            self._grad = _wrap(jnp.zeros(self.shape, self.dtype))
+            return
+        self._grad._rebind(jnp.zeros_like(self._grad._data))
 
     @property
     def grad(self):

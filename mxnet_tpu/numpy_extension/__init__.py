@@ -471,10 +471,38 @@ def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False):
 
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
-    """Reference: src/operator/tensor/indexing_op.cc (Embedding)."""
+    """Reference: src/operator/tensor/indexing_op.cc (Embedding).
+
+    ``sparse_grad=True`` records a backward that yields a
+    ``RowSparseNDArray`` cotangent for the weight (the reference's
+    row_sparse gradient path feeding lazy_update optimizers and kvstore
+    row_sparse push) — O(batch) rows instead of an O(vocab) dense scatter.
+    """
     idx = data._data if isinstance(data, ndarray) else jnp.asarray(data)
-    return _invoke(lambda w: jnp.take(w, idx.astype(jnp.int32), axis=0),
-                   (weight,), name="embedding")
+    if not sparse_grad:
+        return _invoke(lambda w: jnp.take(w, idx.astype(jnp.int32), axis=0),
+                       (weight,), name="embedding")
+
+    from .. import autograd as _ag
+    from ..ndarray.sparse import RowSparseNDArray, dedupe_coo
+    from ..numpy.multiarray import _wrap
+    w_nd = weight if isinstance(weight, ndarray) else _wrap(jnp.asarray(weight))
+    idx32 = idx.astype(jnp.int32)
+    out = _wrap(jnp.take(w_nd._data, idx32, axis=0))
+    if _ag.is_recording() and w_nd._entry is not None:
+        vocab = int(w_nd.shape[0])
+
+        def vjp_sparse(cots):
+            dy = cots[0] if isinstance(cots, (tuple, list)) else cots
+            dim = dy.shape[-1]
+            flat_idx = idx32.reshape(-1)
+            flat_dy = dy.reshape(-1, dim)
+            uidx, uvals = dedupe_coo(flat_idx, flat_dy, vocab)
+            return (RowSparseNDArray(_wrap(uvals), _wrap(uidx),
+                                     (vocab, dim)),)
+
+        _ag._record_op(vjp_sparse, [w_nd], [out], "embedding_sparse")
+    return out
 
 
 def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):

@@ -136,16 +136,40 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if getattr(self, "lazy_update", False):
+                # reference: sgd.py lazy_update=True — only rows present in
+                # the sparse grad are read/updated (O(nnz) work)
+                new_w, new_s = self._lazy_update_impl(
+                    weight._data, grad, state, lr, wd)
+                weight._rebind(new_w.astype(weight.dtype))
+                return new_s
+            grad = grad.tostype("default")  # standard update: densify
         new_w, new_s = self._update_impl(
             weight._data, grad._data, state, lr, wd)
         weight._rebind(new_w.astype(weight.dtype))
         return new_s
+
+    def _lazy_update_impl(self, w, rsp_grad, state, lr, wd):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no lazy sparse update; use "
+            "lazy_update=False to densify row_sparse gradients")
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype in (jnp.float16, jnp.bfloat16):
             master, inner = state
             self._update_count(index)
             lr, wd = self._get_lr(index), self._get_wd(index)
+            from ..ndarray.sparse import RowSparseNDArray
+            if isinstance(grad, RowSparseNDArray):
+                if getattr(self, "lazy_update", False):
+                    new_w, new_s = self._lazy_update_impl(
+                        master._data, grad.astype(jnp.float32), inner, lr, wd)
+                    master._rebind(new_w)
+                    weight._rebind(new_w.astype(weight.dtype))
+                    return (master, new_s)
+                grad = grad.tostype("default")
             new_w, new_s = self._update_impl(
                 master._data, grad._data.astype(jnp.float32), inner, lr, wd)
             master._rebind(new_w)
@@ -193,6 +217,7 @@ class SGD(Optimizer):
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -218,6 +243,24 @@ class SGD(Optimizer):
         if state is not None:
             state._rebind(new_mom)
         return new_w, state
+
+    def _lazy_update_impl(self, w, rsp, state, lr, wd):
+        """Row-wise sgd(_mom) touching only rsp.indices rows (reference:
+        sgd.py lazy_update over optimizer_op.cc SGDUpdateRspImpl).  Sentinel
+        padding rows (index == n_rows, see sparse.dedupe_coo) drop out of
+        the scatters."""
+        idx = rsp.indices._data
+        g = rsp.data._data.astype(w.dtype) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_rows = jnp.take(w, idx, axis=0, mode="clip")
+        g = g + wd * w_rows
+        if state is None:
+            return w.at[idx].set(w_rows - lr * g, mode="drop"), None
+        mom_rows = jnp.take(state._data, idx, axis=0, mode="clip")
+        new_mom_rows = self.momentum * mom_rows - lr * g
+        state._rebind(state._data.at[idx].set(new_mom_rows, mode="drop"))
+        return w.at[idx].set(w_rows + new_mom_rows, mode="drop"), state
 
 
 @register
@@ -282,6 +325,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=False, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (_wrap(jnp.zeros(weight.shape, weight.dtype)),
@@ -316,6 +360,29 @@ class Adam(Optimizer):
             return super().update(index, weight, grad, state)
         finally:
             del self._cur_index
+
+    def _lazy_update_impl(self, w, rsp, state, lr, wd):
+        """Row-wise adam on grad rows only (reference: adam.py
+        lazy_update over AdamUpdateRspImpl: m/v of untouched rows stay)."""
+        m, v = state
+        t = self._index_update_count.get(self._cur_index, self.num_update) \
+            if hasattr(self, "_cur_index") else self.num_update
+        t = float(max(t, 1))
+        idx = rsp.indices._data
+        g = rsp.data._data.astype(w.dtype) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_rows = jnp.take(w, idx, axis=0, mode="clip")
+        g = g + wd * w_rows
+        m_rows = self.beta1 * jnp.take(m._data, idx, 0, mode="clip") \
+            + (1 - self.beta1) * g
+        v_rows = self.beta2 * jnp.take(v._data, idx, 0, mode="clip") \
+            + (1 - self.beta2) * g * g
+        lr_t = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        new_rows = w_rows - lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)
+        m._rebind(m._data.at[idx].set(m_rows, mode="drop"))
+        v._rebind(v._data.at[idx].set(v_rows, mode="drop"))
+        return w.at[idx].set(new_rows, mode="drop"), state
 
 
 @register
