@@ -322,6 +322,42 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8):
     return row
 
 
+def bench_augmentation(precision, on_cpu, peak, bs=256, k_steps=8):
+    """Batched image-augmentation throughput (mx.image.apply_batch):
+    the ImageIter/DataLoader device-side augment pass."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import image as mimg
+
+    if on_cpu:
+        bs, k_steps = 16, 2
+    chain = mimg.CreateAugmenter((3, 224, 224), rand_crop=True,
+                                 rand_resize=True, rand_mirror=True,
+                                 brightness=0.4, contrast=0.4,
+                                 saturation=0.4, pca_noise=0.1,
+                                 mean=True, std=True)
+
+    def aug_step(carry, key, xs):
+        def body(c, x):
+            out = mimg.apply_batch(chain, x + c, key=key)._data
+            return jnp.max(out).astype(jnp.float32), None
+        c, _ = jax.lax.scan(body, carry, xs)
+        return c, c
+
+    key = jax.random.PRNGKey(0)
+    xs = jax.random.uniform(key, (k_steps, bs, 256, 256, 3),
+                            jnp.float32, 0, 255)
+    step = jax.jit(aug_step)
+    step, _ = _compile(step, jax.ShapeDtypeStruct((), jnp.float32),
+                       jax.ShapeDtypeStruct(key.shape, key.dtype),
+                       jax.ShapeDtypeStruct(xs.shape, xs.dtype))
+    sec, _ = _measure(step, (jnp.zeros(()), key, xs), n_state=1)
+    sec /= k_steps
+    return {"name": f"augment_imagenet_bs{bs}", "items_per_s": bs / sec,
+            "ms_per_step": sec * 1e3, "precision": "fp32"}
+
+
 def main():
     import jax
 
@@ -337,6 +373,7 @@ def main():
         (bench_inception_train, dict(precision="bf16")),
         (bench_bert_train, dict(precision="bf16", bs=32)),
         (bench_bert_train, dict(precision="bf16", bs=64)),
+        (bench_augmentation, dict(precision="fp32")),
     ]:
         row = None
         for attempt in (1, 2):   # one retry: the tunneled platform can

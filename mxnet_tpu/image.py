@@ -1,11 +1,25 @@
-"""mx.image — image codecs + augmenters.
+"""mx.image — image codecs + batch-first augmentation.
 
-Reference parity: python/mxnet/image/ (imdecode/imread/imresize via OpenCV,
-ImageIter augmenter chain) over src/io/image_io.cc.
+Reference parity: python/mxnet/image/ (imdecode/imread/imresize via
+OpenCV, the Augmenter/CreateAugmenter chain, ImageIter) over
+src/io/image_io.cc.  The *surface* (class names, CreateAugmenter
+signature and augmenter ordering, per-image helpers) is the
+compatibility contract; the execution model is redesigned for TPU:
 
-This environment has no OpenCV; codecs use PIL when importable and a raw
-numpy .npy/.ppm fallback otherwise (sufficient for RecordIO pipelines that
-pack raw arrays). Resize/crop augmenters run via jax.image on device.
+- every augmenter implements ``batch_apply(x, key)`` over an (N, H, W, C)
+  float32 device batch with jax.random per-sample randomness (vmapped),
+  so one DataLoader batch is one fused XLA program instead of N python
+  loops fighting the GIL;
+- variable-size crops (random crop / Inception-style random-sized crop)
+  are expressed as fixed-output-shape affine resampling — per-sample
+  scale/offset into a bilinear gather — because data-dependent shapes
+  don't compile; this is the standard TPU formulation (crop-and-resize),
+  not a translation of the reference's per-image numpy slicing;
+- the per-image ``__call__`` API remains and simply runs the batch path
+  on a singleton batch.
+
+Codecs use PIL when importable and a raw numpy .npy fallback otherwise
+(no OpenCV in this environment).
 """
 from __future__ import annotations
 
@@ -25,6 +39,10 @@ def _pil():
     except ImportError:
         return None
 
+
+# ---------------------------------------------------------------------------
+# codecs
+# ---------------------------------------------------------------------------
 
 def imdecode(buf, flag=1, to_rgb=True, out=None):
     """Decode image bytes to HWC ndarray (reference: image.py imdecode)."""
@@ -46,6 +64,24 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
     if arr.ndim == 2:
         arr = arr[..., None]
     return _wrap(jnp.asarray(arr))
+
+
+def imdecode_np(buf, flag=1):
+    """Host-side decode to a numpy HWC array (no device transfer) — the
+    ImageIter batch path decodes all samples first, then ships ONE batch."""
+    Image = _pil()
+    if buf[:6] == b"\x93NUMPY":
+        arr = onp.load(_io.BytesIO(buf), allow_pickle=False)
+    elif Image is not None:
+        img = Image.open(_io.BytesIO(buf)).convert("RGB" if flag else "L")
+        arr = onp.asarray(img)
+        if not flag:
+            arr = arr[..., None]
+    else:
+        raise MXNetError("no image codec available (PIL missing)")
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr
 
 
 def imencode(img, fmt=".jpg", quality=95):
@@ -72,14 +108,73 @@ def imread(filename, flag=1, to_rgb=True):
         return imdecode(f.read(), flag, to_rgb)
 
 
-def imresize(src, w, h, interp=1):
-    import jax
+# ---------------------------------------------------------------------------
+# batched geometric kernels
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(batch, ys, xs):
+    """Gather batch (N,H,W,C) at fractional coords ys/xs (N,h,w) —
+    bilinear, edge-clamped. The workhorse for every crop/resize below."""
+    import jax.numpy as jnp
+    n, H, W, c = batch.shape
+    y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = (ys - y0)[..., None]
+    wx = (xs - x0)[..., None]
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    bidx = jnp.arange(n)[:, None, None]
+    p00 = batch[bidx, y0i, x0i]
+    p01 = batch[bidx, y0i, x1i]
+    p10 = batch[bidx, y1i, x0i]
+    p11 = batch[bidx, y1i, x1i]
+    top = p00 * (1 - wx) + p01 * wx
+    bot = p10 * (1 - wx) + p11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _affine_crop_resize(batch, y0, x0, hs, ws, out_hw, bilinear=True):
+    """Per-sample window (y0, x0, hs, ws) resampled to out_hw.
+
+    All windows share the static output shape; the varying geometry lives
+    in the sampling grid — the fixed-shape encoding of "crop then resize".
+    """
+    import jax.numpy as jnp
+    oh, ow = out_hw
+    gy = (jnp.arange(oh) + 0.5) / oh      # normalized output grid
+    gx = (jnp.arange(ow) + 0.5) / ow
+    ys = y0[:, None, None] + gy[None, :, None] * hs[:, None, None] - 0.5
+    xs = x0[:, None, None] + gx[None, None, :] * ws[:, None, None] - 0.5
+    if not bilinear:
+        ys, xs = jnp.round(ys), jnp.round(xs)
+    return _bilinear_sample(batch, ys, xs)
+
+
+def _batch_resize(batch, out_hw, bilinear=True):
+    import jax.numpy as jnp
+    n = batch.shape[0]
+    z = jnp.zeros((n,))
+    return _affine_crop_resize(
+        batch, z, z, jnp.full((n,), batch.shape[1], jnp.float32),
+        jnp.full((n,), batch.shape[2], jnp.float32), out_hw, bilinear)
+
+
+# ---------------------------------------------------------------------------
+# per-image helpers (reference surface; singleton-batch shims)
+# ---------------------------------------------------------------------------
+
+def _as_batch(src):
     import jax.numpy as jnp
     raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
-    out = jax.image.resize(raw.astype(jnp.float32),
-                           (h, w) + tuple(raw.shape[2:]),
-                           method="bilinear" if interp else "nearest")
-    return _wrap(out.astype(raw.dtype))
+    return raw.astype(jnp.float32)[None], raw.dtype
+
+
+def imresize(src, w, h, interp=1):
+    out, dt = _as_batch(src)
+    out = _batch_resize(out, (h, w), bilinear=bool(interp))
+    return _wrap(out[0].astype(dt))
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
@@ -124,8 +219,8 @@ def resize_short(src, size, interp=2):
 
 
 def random_size_crop(src, size, area, ratio, interp=2):
-    """Random area/aspect crop (reference: image.py random_size_crop —
-    the Inception-style training crop)."""
+    """Random area/aspect crop (reference surface: image.py
+    random_size_crop; Inception-style training crop)."""
     H, W = src.shape[0], src.shape[1]
     src_area = H * W
     if isinstance(area, (int, float)):
@@ -144,11 +239,24 @@ def random_size_crop(src, size, area, ratio, interp=2):
     return center_crop(src, size, interp)
 
 
-# -- augmenter chain (reference: python/mxnet/image/image.py Augmenter
-#    classes + CreateAugmenter) ---------------------------------------------
+# ---------------------------------------------------------------------------
+# augmenters: one batched XLA program per step
+# ---------------------------------------------------------------------------
+
+def _rgb_luma(x):
+    """Batch luminance (N,H,W,1), ITU-R BT.601 weights."""
+    import jax.numpy as jnp
+    w = jnp.asarray([0.299, 0.587, 0.114], x.dtype)
+    return (x * w).sum(-1, keepdims=True)
+
 
 class Augmenter:
-    """Image augmenter base (reference: image.py:~1000 Augmenter)."""
+    """Augmenter base (reference surface: image.py Augmenter).
+
+    Subclasses implement ``batch_apply(x, key) -> x`` on an (N,H,W,C)
+    float32 batch.  ``out_hw(in_hw)`` reports the static output spatial
+    shape so chains can be composed and jitted shape-stably.
+    """
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
@@ -157,14 +265,39 @@ class Augmenter:
         import json
         return json.dumps([type(self).__name__, self._kwargs])
 
-    def __call__(self, src):
+    def out_hw(self, in_hw):
+        return in_hw
+
+    def batch_apply(self, x, key):
         raise NotImplementedError
+
+    def __call__(self, src):
+        from . import random as _random
+        import jax.numpy as jnp
+        batch, dt = _as_batch(src)
+        out = self.batch_apply(batch, _random._next_key())
+        out = out[0]
+        if dt == jnp.uint8:
+            out = jnp.clip(out, 0, 255)
+        return _wrap(out.astype(dt) if dt != jnp.uint8 else out)
 
 
 class SequentialAug(Augmenter):
     def __init__(self, ts):
         super().__init__()
         self.ts = list(ts)
+
+    def out_hw(self, in_hw):
+        for t in self.ts:
+            in_hw = t.out_hw(in_hw)
+        return in_hw
+
+    def batch_apply(self, x, key):
+        import jax
+        for t in self.ts:
+            key, sub = jax.random.split(key)
+            x = t.batch_apply(x, sub)
+        return x
 
     def __call__(self, src):
         for t in self.ts:
@@ -173,42 +306,103 @@ class SequentialAug(Augmenter):
 
 
 class RandomOrderAug(Augmenter):
+    """Applies children in a random order.
+
+    Batch path: for <= 4 children, the order is drawn from ``key`` with
+    ``lax.switch`` over all permutations, so it stays random per call even
+    under jit (host RNG would freeze at trace time).  Larger lists fall
+    back to a host-drawn order (random per call only when not jitted)."""
+
     def __init__(self, ts):
         super().__init__()
         self.ts = list(ts)
 
+    def batch_apply(self, x, key):
+        import itertools
+
+        import jax
+        n = len(self.ts)
+        if n == 0:
+            return x
+        korder, key = jax.random.split(key)
+        subs = jax.random.split(key, n)
+        if n <= 4:
+            perms = list(itertools.permutations(range(n)))
+
+            def branch(perm):
+                def run(x):
+                    for j in perm:
+                        nonlocal_subs = subs[j]
+                        x = self.ts[j].batch_apply(x, nonlocal_subs)
+                    return x
+                return run
+            idx = jax.random.randint(korder, (), 0, len(perms))
+            return jax.lax.switch(idx, [branch(p) for p in perms], x)
+        order = onp.random.permutation(n)
+        for i in order:
+            x = self.ts[int(i)].batch_apply(x, subs[int(i)])
+        return x
+
     def __call__(self, src):
         order = onp.random.permutation(len(self.ts))
         for i in order:
-            src = self.ts[i](src)
+            src = self.ts[int(i)](src)
         return src
 
 
 class ResizeAug(Augmenter):
+    """Short-edge resize."""
+
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return resize_short(src, self.size, self.interp)
+    def out_hw(self, in_hw):
+        h, w = in_hw
+        if h > w:
+            return (int(h * self.size / w), self.size)
+        return (self.size, int(w * self.size / h))
+
+    def batch_apply(self, x, key):
+        return _batch_resize(x, self.out_hw(x.shape[1:3]),
+                             bilinear=bool(self.interp))
 
 
 class ForceResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
-        self.size, self.interp = size, interp
+        self.size, self.interp = size, interp  # (w, h)
 
-    def __call__(self, src):
-        return imresize(src, self.size[0], self.size[1], self.interp)
+    def out_hw(self, in_hw):
+        return (self.size[1], self.size[0])
+
+    def batch_apply(self, x, key):
+        return _batch_resize(x, (self.size[1], self.size[0]),
+                             bilinear=bool(self.interp))
 
 
 class RandomCropAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)
-        self.size, self.interp = size, interp
+        self.size, self.interp = size, interp  # (w, h)
 
-    def __call__(self, src):
-        return random_crop(src, self.size, self.interp)[0]
+    def out_hw(self, in_hw):
+        return (self.size[1], self.size[0])
+
+    def batch_apply(self, x, key):
+        import jax
+        import jax.numpy as jnp
+        n, H, W, _ = x.shape
+        w, h = self.size
+        ky, kx = jax.random.split(key)
+        # inclusive upper corner, like the reference's randint(0, H-h+1)
+        y0 = jax.random.randint(ky, (n,), 0, max(H - h, 0) + 1)
+        x0 = jax.random.randint(kx, (n,), 0, max(W - w, 0) + 1)
+        hs = jnp.full((n,), float(h))
+        ws = jnp.full((n,), float(w))
+        return _affine_crop_resize(x, y0.astype(jnp.float32),
+                                   x0.astype(jnp.float32), hs, ws,
+                                   (h, w), bilinear=bool(self.interp))
 
 
 class CenterCropAug(Augmenter):
@@ -216,25 +410,70 @@ class CenterCropAug(Augmenter):
         super().__init__(size=size, interp=interp)
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return center_crop(src, self.size, self.interp)[0]
+    def out_hw(self, in_hw):
+        return (self.size[1], self.size[0])
+
+    def batch_apply(self, x, key):
+        import jax.numpy as jnp
+        n, H, W, _ = x.shape
+        w, h = self.size
+        y0 = jnp.full((n,), float((H - h) // 2))
+        x0 = jnp.full((n,), float((W - w) // 2))
+        return _affine_crop_resize(x, y0, x0, jnp.full((n,), float(h)),
+                                   jnp.full((n,), float(w)), (h, w),
+                                   bilinear=bool(self.interp))
 
 
 class RandomSizedCropAug(Augmenter):
+    """Inception-style area/aspect crop, batched: per-sample (area,
+    aspect) drawn on device, realized as an affine resample to the fixed
+    output size (no data-dependent shapes)."""
+
     def __init__(self, size, area, ratio, interp=2):
         super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        if isinstance(area, (int, float)):
+            area = (area, 1.0)
         self.size, self.area, self.ratio, self.interp = \
             size, area, ratio, interp
 
-    def __call__(self, src):
-        return random_size_crop(src, self.size, self.area, self.ratio,
-                                self.interp)[0]
+    def out_hw(self, in_hw):
+        return (self.size[1], self.size[0])
+
+    def batch_apply(self, x, key):
+        import jax
+        import jax.numpy as jnp
+        n, H, W, _ = x.shape
+        ka, kr, ky, kx = jax.random.split(key, 4)
+        area = jax.random.uniform(ka, (n,), minval=self.area[0],
+                                  maxval=self.area[1]) * (H * W)
+        logr = jax.random.uniform(
+            kr, (n,), minval=onp.log(self.ratio[0]),
+            maxval=onp.log(self.ratio[1]))
+        aspect = jnp.exp(logr)
+        ws = jnp.sqrt(area * aspect)
+        hs = jnp.sqrt(area / aspect)
+        # clamp to the image (the reference retries then center-crops;
+        # clamping is the batched equivalent)
+        ws = jnp.minimum(ws, W)
+        hs = jnp.minimum(hs, H)
+        y0 = jax.random.uniform(ky, (n,)) * (H - hs)
+        x0 = jax.random.uniform(kx, (n,)) * (W - ws)
+        return _affine_crop_resize(x, y0, x0, hs, ws,
+                                   (self.size[1], self.size[0]),
+                                   bilinear=bool(self.interp))
 
 
 class HorizontalFlipAug(Augmenter):
     def __init__(self, p):
         super().__init__(p=p)
         self.p = p
+
+    def batch_apply(self, x, key):
+        import jax
+        import jax.numpy as jnp
+        n = x.shape[0]
+        flip = jax.random.bernoulli(key, self.p, (n,))
+        return jnp.where(flip[:, None, None, None], x[:, :, ::-1], x)
 
     def __call__(self, src):
         if onp.random.random() < self.p:
@@ -247,6 +486,9 @@ class CastAug(Augmenter):
         super().__init__(typ=typ)
         self.typ = typ
 
+    def batch_apply(self, x, key):
+        return x  # batch path already runs in float32
+
     def __call__(self, src):
         return src.astype(self.typ)
 
@@ -256,67 +498,73 @@ class BrightnessJitterAug(Augmenter):
         super().__init__(brightness=brightness)
         self.brightness = brightness
 
-    def __call__(self, src):
-        alpha = 1.0 + onp.random.uniform(-self.brightness, self.brightness)
-        return src * alpha
+    def batch_apply(self, x, key):
+        import jax
+        n = x.shape[0]
+        alpha = 1.0 + jax.random.uniform(
+            key, (n, 1, 1, 1), minval=-self.brightness,
+            maxval=self.brightness)
+        return x * alpha
 
 
 class ContrastJitterAug(Augmenter):
-    _coef = onp.array([[[0.299, 0.587, 0.114]]], "float32")
+    """Blend with the per-image mean luminance."""
 
     def __init__(self, contrast):
         super().__init__(contrast=contrast)
         self.contrast = contrast
 
-    def __call__(self, src):
-        alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
-        import jax.numpy as jnp
-        raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
-        gray = (raw.astype(jnp.float32) * jnp.asarray(self._coef)).sum()
-        gray = gray * (3.0 / raw.size) * (1.0 - alpha)
-        return _wrap((raw * alpha + gray).astype(raw.dtype))
+    def batch_apply(self, x, key):
+        import jax
+        n = x.shape[0]
+        alpha = 1.0 + jax.random.uniform(
+            key, (n, 1, 1, 1), minval=-self.contrast, maxval=self.contrast)
+        mean_luma = _rgb_luma(x).mean(axis=(1, 2), keepdims=True)
+        return x * alpha + mean_luma * (1.0 - alpha)
 
 
 class SaturationJitterAug(Augmenter):
-    _coef = onp.array([[[0.299, 0.587, 0.114]]], "float32")
+    """Blend each pixel with its own luminance."""
 
     def __init__(self, saturation):
         super().__init__(saturation=saturation)
         self.saturation = saturation
 
-    def __call__(self, src):
-        alpha = 1.0 + onp.random.uniform(-self.saturation, self.saturation)
-        import jax.numpy as jnp
-        raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
-        gray = (raw.astype(jnp.float32)
-                * jnp.asarray(self._coef)).sum(-1, keepdims=True)
-        return _wrap((raw * alpha + gray * (1.0 - alpha)).astype(raw.dtype))
+    def batch_apply(self, x, key):
+        import jax
+        n = x.shape[0]
+        alpha = 1.0 + jax.random.uniform(
+            key, (n, 1, 1, 1), minval=-self.saturation,
+            maxval=self.saturation)
+        return x * alpha + _rgb_luma(x) * (1.0 - alpha)
 
 
 class HueJitterAug(Augmenter):
-    """Hue jitter via the YIQ rotation trick (reference: image.py
-    HueJitterAug cites the same approximation)."""
+    """Hue rotation about the RGB gray axis.
+
+    Built from Rodrigues' rotation of the color cube around (1,1,1)/√3 —
+    constructed with jnp per sample (batched), rather than a fixed
+    YIQ-basis matrix product."""
 
     def __init__(self, hue):
         super().__init__(hue=hue)
         self.hue = hue
-        self.tyiq = onp.array([[0.299, 0.587, 0.114],
-                               [0.596, -0.274, -0.321],
-                               [0.211, -0.523, 0.311]], "float32")
-        self.ityiq = onp.array([[1.0, 0.956, 0.621],
-                                [1.0, -0.272, -0.647],
-                                [1.0, -1.107, 1.705]], "float32")
 
-    def __call__(self, src):
+    def batch_apply(self, x, key):
+        import jax
         import jax.numpy as jnp
-        alpha = onp.random.uniform(-self.hue, self.hue)
-        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
-        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
-                       "float32")
-        t = onp.dot(onp.dot(self.ityiq, bt), self.tyiq).T
-        raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
-        return _wrap(jnp.einsum("hwc,cd->hwd", raw.astype(jnp.float32),
-                                jnp.asarray(t)).astype(raw.dtype))
+        n = x.shape[0]
+        theta = jax.random.uniform(key, (n,), minval=-self.hue,
+                                   maxval=self.hue) * jnp.pi
+        c = jnp.cos(theta)[:, None, None]
+        s = jnp.sin(theta)[:, None, None]
+        eye = jnp.eye(3)
+        axis = jnp.ones((3, 3)) / 3.0               # uu^T for u = gray axis
+        k = jnp.asarray([[0.0, -1.0, 1.0],
+                         [1.0, 0.0, -1.0],
+                         [-1.0, 1.0, 0.0]]) / jnp.sqrt(3.0)  # cross matrix
+        rot = c * eye + (1 - c) * axis + s * k       # (n, 3, 3)
+        return jnp.einsum("nhwc,ncd->nhwd", x, rot)
 
 
 class ColorJitterAug(RandomOrderAug):
@@ -332,7 +580,7 @@ class ColorJitterAug(RandomOrderAug):
 
 
 class LightingAug(Augmenter):
-    """PCA (AlexNet-style) lighting noise."""
+    """PCA (AlexNet-style) lighting noise, per-sample."""
 
     def __init__(self, alphastd, eigval, eigvec):
         super().__init__(alphastd=alphastd)
@@ -340,10 +588,13 @@ class LightingAug(Augmenter):
         self.eigval = onp.asarray(eigval, "float32")
         self.eigvec = onp.asarray(eigvec, "float32")
 
-    def __call__(self, src):
-        alpha = onp.random.normal(0, self.alphastd, size=(3,))
-        rgb = onp.dot(self.eigvec * alpha, self.eigval)
-        return src + rgb.astype("float32")
+    def batch_apply(self, x, key):
+        import jax
+        import jax.numpy as jnp
+        n = x.shape[0]
+        alpha = jax.random.normal(key, (n, 3)) * self.alphastd
+        rgb = (alpha * self.eigval) @ jnp.asarray(self.eigvec).T
+        return x + rgb[:, None, None, :]
 
 
 class ColorNormalizeAug(Augmenter):
@@ -352,33 +603,39 @@ class ColorNormalizeAug(Augmenter):
         self.mean = None if mean is None else onp.asarray(mean, "float32")
         self.std = None if std is None else onp.asarray(std, "float32")
 
+    def batch_apply(self, x, key):
+        import jax.numpy as jnp
+        if self.mean is not None:
+            x = x - jnp.asarray(self.mean)
+        if self.std is not None:
+            x = x / jnp.asarray(self.std)
+        return x
+
     def __call__(self, src):
         return color_normalize(src, self.mean, self.std)
 
 
 class RandomGrayAug(Augmenter):
-    _coef = onp.array([[[0.299], [0.587], [0.114]]], "float32").reshape(1, 1, 3)
-
     def __init__(self, p):
         super().__init__(p=p)
         self.p = p
 
-    def __call__(self, src):
-        if onp.random.random() < self.p:
-            import jax.numpy as jnp
-            raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
-            gray = (raw.astype(jnp.float32)
-                    * jnp.asarray(self._coef)).sum(-1, keepdims=True)
-            return _wrap(jnp.broadcast_to(gray, raw.shape).astype(raw.dtype))
-        return src
+    def batch_apply(self, x, key):
+        import jax
+        import jax.numpy as jnp
+        n = x.shape[0]
+        gray = jnp.broadcast_to(_rgb_luma(x), x.shape)
+        pick = jax.random.bernoulli(key, self.p, (n,))
+        return jnp.where(pick[:, None, None, None], gray, x)
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
                     rand_gray=0, inter_method=2):
-    """Standard augmenter list factory (reference: image.py
-    CreateAugmenter)."""
+    """Standard augmenter list factory — the ordering (resize, crop,
+    mirror, cast, color, hue, pca, gray, normalize) is the reference's
+    documented pipeline contract (image.py CreateAugmenter)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
@@ -415,9 +672,33 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def apply_batch(auglist, batch, key=None):
+    """Run an augmenter list over an (N,H,W,C) batch in one device pass.
+
+    Uniform-shape batches go through each augmenter's ``batch_apply``
+    (jax.random key per stage).  Returns float32 (N,H,W,C).
+    """
+    from . import random as _random
+    import jax
+    import jax.numpy as jnp
+    x = batch._data if isinstance(batch, ndarray) else jnp.asarray(batch)
+    x = x.astype(jnp.float32)
+    if key is None:
+        key = _random._next_key()
+    for aug in auglist:
+        key, sub = jax.random.split(key)
+        x = aug.batch_apply(x, sub)
+    return _wrap(x)
+
+
 class ImageIter:
     """Image data iterator over RecordIO or an image list (reference:
-    image.py ImageIter: decode -> augment -> batch, NCHW output)."""
+    image.py ImageIter: decode -> augment -> batch, NCHW output).
+
+    Batch-first: samples are decoded on host, stacked once, and the whole
+    augmenter chain runs as device batch ops (``apply_batch``).  Mixed
+    source sizes fall back to the per-image path for the geometric prefix
+    until shapes unify."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
@@ -499,27 +780,40 @@ class ImageIter:
 
     def next(self):
         from .io import DataBatch
-        from .numpy import zeros as np_zeros
         import jax.numpy as jnp
         c, h, w = self.data_shape
-        batch = onp.zeros((self.batch_size, c, h, w), "float32")
         labels = onp.zeros((self.batch_size, self.label_width), "float32")
+        raws = []
         i = 0
         try:
             while i < self.batch_size:
                 label, buf = self._next_sample()
-                img = imdecode(buf, flag=1 if c == 3 else 0)
-                for aug in self.aug_list:
-                    img = aug(img)
-                arr = img.asnumpy() if isinstance(img, ndarray) \
-                    else onp.asarray(img)
-                batch[i] = arr.transpose(2, 0, 1)
+                raws.append(imdecode_np(buf, flag=1 if c == 3 else 0))
                 labels[i] = onp.asarray(label).reshape(-1)[:self.label_width]
                 i += 1
         except StopIteration:
             if i == 0:
                 raise
         pad = self.batch_size - i
+
+        shapes = {r.shape for r in raws}
+        if len(shapes) == 1:
+            # uniform batch: one stack, one fused device augment pass
+            stacked = onp.stack(raws).astype("float32")
+            out = apply_batch(self.aug_list, stacked)._data
+        else:
+            # mixed sizes: per-image until the chain's first shape-
+            # unifying stage, then there's nothing left to batch
+            imgs = []
+            for r in raws:
+                img = _wrap(jnp.asarray(r))
+                for aug in self.aug_list:
+                    img = aug(img)
+                imgs.append(img._data.astype(jnp.float32))
+            out = jnp.stack(imgs)
+        if pad:
+            fill = jnp.zeros((pad,) + tuple(out.shape[1:]), out.dtype)
+            out = jnp.concatenate([out, fill])
+        out = jnp.transpose(out, (0, 3, 1, 2))  # NHWC -> NCHW API contract
         lab = labels[:, 0] if self.label_width == 1 else labels
-        return DataBatch([_wrap(jnp.asarray(batch))],
-                         [_wrap(jnp.asarray(lab))], pad=pad)
+        return DataBatch([_wrap(out)], [_wrap(jnp.asarray(lab))], pad=pad)

@@ -139,3 +139,57 @@ def test_model_store_purge(tmp_path):
     f.write_bytes(b"abc")
     model_store.purge(str(tmp_path))
     assert not f.exists()
+
+
+def test_apply_batch_matches_per_image_for_deterministic_chain():
+    """Batch path == per-image path for deterministic augmenters."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import image as img
+
+    rng = onp.random.RandomState(0)
+    batch = rng.randint(0, 255, size=(4, 40, 48, 3)).astype("float32")
+    chain = [img.ForceResizeAug((32, 24)), img.CastAug(),
+             img.ColorNormalizeAug(onp.array([123.0, 117.0, 104.0]),
+                                   onp.array([58.0, 57.0, 57.0]))]
+    out = img.apply_batch(chain, batch).asnumpy()
+    assert out.shape == (4, 24, 32, 3)
+    for i in range(4):
+        single = mx.np.array(batch[i])
+        for aug in chain:
+            single = aug(single)
+        onp.testing.assert_allclose(out[i], single.asnumpy(),
+                                    rtol=1e-4, atol=1e-3)
+
+
+def test_batch_random_augs_shapes_and_bounds():
+    import numpy as onp
+    from mxnet_tpu import image as img
+
+    rng = onp.random.RandomState(1)
+    batch = rng.randint(0, 255, size=(8, 64, 64, 3)).astype("float32")
+    chain = img.CreateAugmenter((3, 32, 32), rand_crop=True, rand_resize=True,
+                                rand_mirror=True, brightness=0.2,
+                                contrast=0.2, saturation=0.2, hue=0.1,
+                                pca_noise=0.05, rand_gray=0.3,
+                                mean=True, std=True)
+    out = img.apply_batch(chain, batch).asnumpy()
+    assert out.shape == (8, 32, 32, 3)
+    assert onp.isfinite(out).all()
+    # per-sample randomness: samples of identical input differ
+    same = onp.repeat(batch[:1], 8, axis=0)
+    out2 = img.apply_batch(chain, same).asnumpy()
+    assert onp.abs(out2[0] - out2[1]).max() > 1e-3
+
+
+def test_hue_rotation_preserves_gray_axis():
+    """Rotating hue must fix gray pixels (the rotation axis)."""
+    import numpy as onp
+    from mxnet_tpu import image as img
+    import jax
+
+    gray = onp.full((2, 8, 8, 3), 128.0, "float32")
+    aug = img.HueJitterAug(0.5)
+    out = onp.asarray(aug.batch_apply(jax.numpy.asarray(gray),
+                                      jax.random.PRNGKey(3)))
+    onp.testing.assert_allclose(out, gray, rtol=1e-4)
