@@ -24,7 +24,23 @@ from . import lists  # noqa: F401
 _state = threading.local()
 
 _TARGET_OPS = frozenset(lists.TARGET_DTYPE_OPS)
-_FP32_OPS = frozenset(lists.FP32_OPS)
+_FP32_OPS = frozenset(lists.FP32_OPS) | lists.conditional_fp32_names()
+# lists.WIDEST_TYPE_CASTS is documentation of which combiners rely on
+# jnp's dtype promotion for the widest-input behavior; no dispatcher hook
+# is needed (test_amp_dtype_drift_oracle locks this in).
+
+
+def _norm_conditional(ops):
+    """User-supplied conditional entries: (op, attr, [values]) triples or
+    plain names -> dispatch-name set."""
+    out = set()
+    for item in ops or ():
+        if isinstance(item, str):
+            out.add(item)
+        else:
+            op, _attr, values = item
+            out.update(f"{op}:{v}" for v in values)
+    return out
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
@@ -33,7 +49,7 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
     _state.dtype = np_dtype(target_dtype)
     _state.target_ops = _TARGET_OPS | set(target_precision_ops or ())
     _state.fp32_ops = _FP32_OPS | set(fp32_ops or ()) \
-        | set(conditional_fp32_ops or ())
+        | _norm_conditional(conditional_fp32_ops)
     _state.active = True
 
 

@@ -1,24 +1,59 @@
 """AMP op lists (reference: python/mxnet/amp/lists/symbol_fp16.py,
 symbol_bf16.py). Functional groups instead of the reference's exhaustive
-per-op enumeration: jnp names that hit the MXU run low-precision, reductions
-and normalizations stay fp32."""
+per-op enumeration — entries are ``_invoke`` dispatch names, so one entry
+covers every call site.  Ops that hit the MXU run low-precision;
+reductions/normalizations/transcendentals stay fp32; elementwise
+combiners widen to the widest floating input (amp_multicast semantics)."""
 
 # run in target (bf16/fp16) precision — MXU-bound
+# (reference FP16_FUNCS: Convolution/Deconvolution/FullyConnected/RNN +
+# the attention matmul ops)
 TARGET_DTYPE_OPS = [
-    "matmul", "dot", "einsum", "tensordot", "convolution",
-    "fully_connected", "multi_head_attention",
+    "matmul", "dot", "einsum", "tensordot", "convolution", "deconvolution",
+    "fully_connected", "batch_dot", "rnn", "multi_head_attention",
     "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
     "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
 ]
 
 # always fp32 — numerically sensitive
+# (reference FP32_FUNCS: norm layers, softmax family, losses, exp/log
+# transcendentals, cumulative reductions)
 FP32_OPS = [
-    "softmax", "log_softmax", "batch_norm", "layer_norm", "group_norm",
-    "instance_norm", "sum", "mean", "var", "std", "norm", "exp", "log",
-    "erf", "erfinv", "gammaln",
+    "softmax", "log_softmax", "masked_softmax", "masked_log_softmax",
+    "softmin", "batch_norm", "layer_norm", "group_norm", "instance_norm",
+    "l2_normalization", "lrn",
+    "sum", "mean", "var", "std", "norm", "cumsum", "prod", "nansum",
+    "exp", "expm1", "log", "log1p", "log2", "log10", "erf", "erfinv",
+    "gamma", "gammaln", "digamma", "sqrt", "cbrt",
+    "softmax_cross_entropy", "smooth_l1", "ctc_loss", "softmax_output",
+    "linear_regression_output", "logistic_regression_output",
+    "mae_regression_output", "make_loss",
 ]
 
-# fp32 unless inputs already low precision
-CONDITIONAL_FP32_OPS = []
+# fp32 only for specific attr values, encoded as dispatch-name suffixes
+# ("activation:softrelu") — the analog of the reference's
+# CONDITIONAL_FP32_FUNCS [(op, attr, values)] triples
+# (amp/lists/symbol_fp16.py CONDITIONAL_FP32_FUNCS)
+CONDITIONAL_FP32_OPS = [
+    ("activation", "act_type", ["softrelu"]),
+    ("leaky_relu", "act_type", ["elu", "selu"]),
+    ("pooling", "pool_type", ["lp", "sum"]),
+]
 
-WIDEST_TYPE_CASTS = ["add", "subtract", "multiply", "true_divide", "where"]
+# elementwise combiners: cast mixed floating inputs to the widest dtype
+# present (reference: WIDEST_TYPE_CASTS via amp_multicast)
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "true_divide", "divide", "where",
+    "maximum", "minimum", "hypot", "mod",
+    "concatenate", "stack",
+]
+
+
+def conditional_fp32_names():
+    """The conditional triples expanded to exact dispatch names
+    (dispatch names carry the attr value as a suffix)."""
+    out = set()
+    for op, _attr, values in CONDITIONAL_FP32_OPS:
+        for v in values:
+            out.add(f"{op}:{v}")
+    return out

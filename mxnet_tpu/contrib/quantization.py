@@ -333,4 +333,14 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
                 object.__setattr__(parent, attr, q)
         replaced += 1
     log.info("quantized %d/%d target layers", replaced, len(targets))
+    if targets and replaced == 0:
+        # hooks only ever saw tracers (actively hybridized network) or the
+        # calibration iterable was empty — returning an unquantized copy
+        # as "success" would be a silent no-op
+        raise MXNetError(
+            "quantize_net calibrated 0 of "
+            f"{len(targets)} target layers. If the network is hybridized, "
+            "call net.hybridize(False) for the calibration pass (compiled "
+            "replays skip forward hooks); also check calib_data is "
+            "non-empty.")
     return qnet

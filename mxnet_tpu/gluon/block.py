@@ -188,8 +188,18 @@ class Block:
                 raise MXNetError(
                     f"{filename} holds unnamed arrays; parameters need "
                     "names to load into a Block (save with a dict)")
-            loaded = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
-                       else k): v for k, v in loaded.items()}
+            stripped = {}
+            for k, v in loaded.items():
+                base = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) \
+                    else k
+                if base in stripped:
+                    # the reference keeps arg/aux as separate dicts; a name
+                    # in both would silently lose one here — refuse
+                    raise MXNetError(
+                        f"{filename}: parameter {base!r} appears as both "
+                        "arg: and aux:; cannot merge into one namespace")
+                stripped[base] = v
+            loaded = stripped
         else:
             path = filename if os.path.exists(filename) \
                 else filename + ".npz"

@@ -1,15 +1,17 @@
 """Vision transforms.
 
 Reference parity: python/mxnet/gluon/data/vision/transforms/ (ToTensor,
-Normalize, Resize, CenterCrop, RandomResizedCrop, RandomFlipLeftRight, Cast,
-Compose). Transforms are Blocks operating on HWC uint8/float arrays.
+Normalize, Resize, CenterCrop, RandomResizedCrop, flips, color jitter,
+Cast, Compose) — each forwards to the ``npx.image.*`` operator namespace
+(reference: transforms/image.py calling npx.image.to_tensor etc. over
+src/operator/image/), which runs batched device kernels.  Transforms
+accept HWC (single image) or NHWC (batch) input.
 """
 from __future__ import annotations
 
 import numpy as onp
 
-from .... import numpy as _np
-from ....numpy.multiarray import ndarray
+from .... import numpy_extension as npx
 from ...block import Block, HybridBlock
 from ...nn import Sequential
 
@@ -32,92 +34,106 @@ class Cast(HybridBlock):
 
 
 class ToTensor(HybridBlock):
-    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ToTensor)."""
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ToTensor over
+    _image_to_tensor)."""
 
     def forward(self, x):
-        x = x.astype("float32") / 255.0
-        if x.ndim == 3:
-            return x.transpose(2, 0, 1)
-        return x.transpose(0, 3, 1, 2)
+        return npx.image.to_tensor(x)
 
 
 class Normalize(HybridBlock):
+    """Channel-wise normalization on CHW/NCHW input (reference: Normalize
+    over _image_normalize)."""
+
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
         self._mean = onp.asarray(mean, dtype=onp.float32)
         self._std = onp.asarray(std, dtype=onp.float32)
 
     def forward(self, x):
-        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
-        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
-        return (x - _np.array(mean)) / _np.array(std)
+        return npx.image.normalize(x, self._mean, self._std)
 
 
 class Resize(Block):
-    """Bilinear resize HWC (reference: transforms Resize over image resize op)."""
+    """Reference: transforms Resize over _image_resize."""
 
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
-        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
 
     def forward(self, x):
-        import jax
-        import jax.numpy as jnp
-        raw = x._data if isinstance(x, ndarray) else jnp.asarray(x)
-        h, w = self._size[1], self._size[0]
-        out = jax.image.resize(raw.astype(jnp.float32),
-                               (h, w) + tuple(raw.shape[2:]), method="bilinear")
-        from ....numpy.multiarray import _wrap
-        return _wrap(out.astype(raw.dtype))
+        return npx.image.resize(x, self._size, self._keep, self._interp)
 
 
 class CenterCrop(Block):
+    """Reference: transforms CenterCrop — random_crop at fixed fractional
+    position (0.5, 0.5), upsampling if the source is smaller."""
+
     def __init__(self, size, interpolation=1):
         super().__init__()
         self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interp = interpolation
 
     def forward(self, x):
-        w, h = self._size
-        H, W = x.shape[0], x.shape[1]
-        y0 = max((H - h) // 2, 0)
-        x0 = max((W - w) // 2, 0)
-        return x[y0:y0 + h, x0:x0 + w]
+        return npx.image.random_crop(x, (0.5, 0.5), (0.5, 0.5),
+                                     width=self._size[0],
+                                     height=self._size[1],
+                                     interp=self._interp)
+
+
+class RandomCrop(Block):
+    """Reference: transforms RandomCrop (optional zero padding first)."""
+
+    def __init__(self, size, pad=None, pad_value=0, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interp = interpolation
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def forward(self, x):
+        if self._pad:
+            from .... import numpy as _np
+            p = self._pad
+            pw = ((p, p), (p, p), (0, 0)) if isinstance(p, int) else p
+            if x.ndim == 4:
+                pw = ((0, 0),) + tuple(pw)
+            x = _np.pad(x, pw, mode="constant",
+                        constant_values=self._pad_value)
+        return npx.image.random_crop(x, (0, 1), (0, 1),
+                                     width=self._size[0],
+                                     height=self._size[1],
+                                     interp=self._interp)
 
 
 class RandomResizedCrop(Block):
+    """Reference: transforms RandomResizedCrop over
+    _image_random_resized_crop."""
+
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
                  interpolation=1):
         super().__init__()
         self._size = (size, size) if isinstance(size, int) else tuple(size)
         self._scale = scale
         self._ratio = ratio
+        self._interp = interpolation
 
     def forward(self, x):
-        H, W = x.shape[0], x.shape[1]
-        area = H * W
-        scale = onp.random.uniform(*self._scale)
-        ratio = onp.random.uniform(*self._ratio)
-        w = int(round((area * scale * ratio) ** 0.5))
-        h = int(round((area * scale / ratio) ** 0.5))
-        w, h = min(w, W), min(h, H)
-        x0 = onp.random.randint(0, W - w + 1)
-        y0 = onp.random.randint(0, H - h + 1)
-        crop = x[y0:y0 + h, x0:x0 + w]
-        return Resize(self._size).forward(crop)
+        return npx.image.random_resized_crop(
+            x, width=self._size[0], height=self._size[1], area=self._scale,
+            ratio=self._ratio, interp=self._interp)
 
 
 class RandomFlipLeftRight(Block):
     def forward(self, x):
-        if onp.random.rand() < 0.5:
-            return x[:, ::-1]
-        return x
+        return npx.image.random_flip_left_right(x)
 
 
 class RandomFlipTopBottom(Block):
     def forward(self, x):
-        if onp.random.rand() < 0.5:
-            return x[::-1]
-        return x
+        return npx.image.random_flip_top_bottom(x)
 
 
 class RandomBrightness(Block):
@@ -126,8 +142,8 @@ class RandomBrightness(Block):
         self._b = brightness
 
     def forward(self, x):
-        f = 1.0 + onp.random.uniform(-self._b, self._b)
-        return (x.astype("float32") * f).clip(0, 255).astype(x.dtype)
+        return npx.image.random_brightness(x, max(0.0, 1 - self._b),
+                                           1 + self._b)
 
 
 class RandomContrast(Block):
@@ -136,7 +152,42 @@ class RandomContrast(Block):
         self._c = contrast
 
     def forward(self, x):
-        f = 1.0 + onp.random.uniform(-self._c, self._c)
-        xf = x.astype("float32")
-        mean = xf.mean()
-        return ((xf - mean) * f + mean).clip(0, 255).astype(x.dtype)
+        return npx.image.random_contrast(x, max(0.0, 1 - self._c),
+                                         1 + self._c)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        return npx.image.random_saturation(x, max(0.0, 1 - self._s),
+                                           1 + self._s)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        return npx.image.random_hue(x, max(0.0, 1 - self._h), 1 + self._h)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._args = (brightness, contrast, saturation, hue)
+
+    def forward(self, x):
+        return npx.image.random_color_jitter(x, *self._args)
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.image.random_lighting(x, self._alpha)

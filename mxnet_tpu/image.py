@@ -278,8 +278,8 @@ class Augmenter:
         out = self.batch_apply(batch, _random._next_key())
         out = out[0]
         if dt == jnp.uint8:
-            out = jnp.clip(out, 0, 255)
-        return _wrap(out.astype(dt) if dt != jnp.uint8 else out)
+            out = jnp.clip(jnp.round(out), 0, 255)
+        return _wrap(out.astype(dt))
 
 
 class SequentialAug(Augmenter):
@@ -550,12 +550,10 @@ class HueJitterAug(Augmenter):
         super().__init__(hue=hue)
         self.hue = hue
 
-    def batch_apply(self, x, key):
-        import jax
+    @staticmethod
+    def _rotate(x, theta):
+        """Rotate (N,H,W,3) batch colors by per-sample angles theta."""
         import jax.numpy as jnp
-        n = x.shape[0]
-        theta = jax.random.uniform(key, (n,), minval=-self.hue,
-                                   maxval=self.hue) * jnp.pi
         c = jnp.cos(theta)[:, None, None]
         s = jnp.sin(theta)[:, None, None]
         eye = jnp.eye(3)
@@ -565,6 +563,14 @@ class HueJitterAug(Augmenter):
                          [-1.0, 1.0, 0.0]]) / jnp.sqrt(3.0)  # cross matrix
         rot = c * eye + (1 - c) * axis + s * k       # (n, 3, 3)
         return jnp.einsum("nhwc,ncd->nhwd", x, rot)
+
+    def batch_apply(self, x, key):
+        import jax
+        import jax.numpy as jnp
+        n = x.shape[0]
+        theta = jax.random.uniform(key, (n,), minval=-self.hue,
+                                   maxval=self.hue) * jnp.pi
+        return self._rotate(x, theta)
 
 
 class ColorJitterAug(RandomOrderAug):

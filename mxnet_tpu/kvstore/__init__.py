@@ -1,5 +1,5 @@
 """mx.kvstore (reference: python/mxnet/kvstore/__init__.py)."""
 from .base import KVStoreBase, create  # noqa: F401
 from .kvstore import KVStore  # noqa: F401
-from .dist import DistKVStore  # noqa: F401
+from .dist import DistAsyncKVStore, DistKVStore  # noqa: F401
 from .horovod import Horovod, BytePS  # noqa: F401

@@ -73,7 +73,9 @@ def create(name="local"):
                 "local_allreduce_cpu"):
         return KVStore(name)
     if name.startswith("dist"):
-        from .dist import DistKVStore
+        from .dist import DistAsyncKVStore, DistKVStore
+        if "async" in name:
+            return DistAsyncKVStore(name)
         return DistKVStore(name)
     if name in KVStoreBase.kv_registry:
         return KVStoreBase.kv_registry[name]()

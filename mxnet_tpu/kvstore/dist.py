@@ -7,11 +7,20 @@ python/mxnet/kvstore/kvstore_server.py.
 TPU-native design: there is no parameter server. Cross-host reduction is an
 XLA AllReduce over the DCN mesh axis; rendezvous is jax.distributed
 (PJRT coordination service replaces the ps-lite scheduler, SURVEY §5).
-Workers call pushpull -> psum over all processes. 'dist_async' has no XLA
-analog and is executed as sync (documented divergence; the reference itself
-only guarantees eventual consistency there). Optimizer-on-server
+Workers call pushpull -> psum over all processes. Optimizer-on-server
 (update_on_kvstore) runs the updater identically on every worker after the
 reduce — bitwise-identical state without a server round-trip.
+
+'dist_async' (DistAsyncKVStore): the reference's async server applies each
+worker's update immediately with no cross-worker aggregation
+(kvstore_dist_server.h:157 ApplyUpdates in async mode) — workers see stale
+state bounded by their pull frequency.  Without a server, the TPU
+emulation keeps a store REPLICA per process: push applies the updater to
+the local replica immediately (no collective — genuinely asynchronous
+progress), and pull reconciles by averaging replicas across processes (a
+psum/N at the pull point), which is where other workers' updates become
+visible.  Same eventual-consistency contract, staleness window = time
+between pulls.
 
 Gradient compression (reference: src/kvstore/gradient_compression.h) applies
 on the worker before the cross-process reduce: the local gradient is 1-bit/
@@ -107,3 +116,66 @@ class DistKVStore(KVStore):
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 t._rebind(merged._data.astype(t.dtype))
+
+
+class DistAsyncKVStore(DistKVStore):
+    """'dist_async': per-process immediate updates, reconciling pulls.
+
+    Reference: kvstore_dist_server.h async mode — the server applies each
+    worker's gradient the moment it arrives; nothing waits for the other
+    workers.  Here every process owns a store replica:
+
+    - ``push`` runs the updater on the LOCAL replica with only the local
+      gradient (no collective — workers make progress independently; this
+      is where the semantics genuinely diverge from dist_sync);
+    - ``pull``/``pushpull(out=...)`` reconcile: replicas are averaged
+      across processes and the local replica adopts the average.  Until a
+      worker pulls, it does not see other workers' updates (staleness).
+
+    CAVEAT (differs from a true parameter server): reconciliation is an
+    XLA collective, so every process must call ``pull`` for the same keys
+    in the same order the same number of times — mismatched pull counts
+    deadlock, exactly like any SPMD collective.  Asynchrony lives between
+    pulls (pushes never synchronize), not in the pull schedule.  The
+    reference's ZMQ server has no such constraint; workloads needing
+    fully unscheduled pulls are out of scope for the collective backend.
+    """
+
+    def __init__(self, name="dist_async"):
+        super().__init__(name)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            merged = self._reduce(vs)  # local devices only; NO cross-process
+            if self._gc is not None:
+                merged = _wrap(self._gc.quantize(k, merged._data))
+            if self._updater is not None:
+                self._updater(self._key_int(k), merged, self._store[k])
+            else:
+                self._store[k]._rebind(
+                    merged._data.astype(self._store[k].dtype))
+
+    def _reconcile(self, k):
+        """Average replicas across processes; adopt the average locally."""
+        if self._nprocs > 1:
+            avg = self._allreduce(self._store[k])._data / self._nprocs
+            self._store[k]._rebind(avg.astype(self._store[k].dtype))
+        return self._store[k]
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._reconcile(k)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._rebind(src._data.astype(t.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)

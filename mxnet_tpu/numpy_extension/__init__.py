@@ -121,19 +121,22 @@ def sigmoid(data):
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
                lower_bound=0.125, upper_bound=0.334, **kwargs):
     """Reference: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu/rrelu)."""
+    nm = f"leaky_relu:{act_type}"  # attr-suffixed for AMP conditional lists
     if act_type == "leaky":
-        return _invoke(lambda x: jax.nn.leaky_relu(x, slope), (data,))
+        return _invoke(lambda x: jax.nn.leaky_relu(x, slope), (data,), name=nm)
     if act_type == "prelu":
-        return _invoke(lambda x, g: jnp.where(x >= 0, x, g * x), (data, gamma))
+        return _invoke(lambda x, g: jnp.where(x >= 0, x, g * x),
+                       (data, gamma), name=nm)
     if act_type == "elu":
-        return _invoke(lambda x: jax.nn.elu(x, slope), (data,))
+        return _invoke(lambda x: jax.nn.elu(x, slope), (data,), name=nm)
     if act_type == "selu":
-        return _invoke(jax.nn.selu, (data,))
+        return _invoke(jax.nn.selu, (data,), name=nm)
     if act_type == "gelu":
-        return _invoke(lambda x: jax.nn.gelu(x, approximate=False), (data,))
+        return _invoke(lambda x: jax.nn.gelu(x, approximate=False), (data,),
+                       name=nm)
     if act_type == "rrelu":
         mid = (lower_bound + upper_bound) / 2.0
-        return _invoke(lambda x: jax.nn.leaky_relu(x, mid), (data,))
+        return _invoke(lambda x: jax.nn.leaky_relu(x, mid), (data,), name=nm)
     raise MXNetError(f"unknown leaky_relu act_type {act_type!r}")
 
 
@@ -1075,3 +1078,6 @@ def nonzero(data):
     idx = onp.argwhere(arr)
     from ..numpy.multiarray import array as _array
     return _array(idx.astype("int64"))
+
+
+from . import image  # noqa: E402,F401  (npx.image.* operator namespace)

@@ -1,0 +1,275 @@
+"""npx.image — the image operator namespace.
+
+Reference parity: src/operator/image/ (`_image_to_tensor`,
+`_image_normalize`, `_image_resize`, `_image_crop`, `_image_random_crop`,
+`_image_random_resized_crop`, flips, random color ops, lighting —
+image_random.cc, resize.cc, crop.cc) backing
+``gluon.data.vision.transforms``.
+
+TPU-native: every op accepts HWC (3-D) or NHWC (4-D batch) input and
+lowers to the batched kernels in ``mxnet_tpu.image`` (affine crop/resize
+gather, luminance blends, Rodrigues hue rotation).  Randomness draws from
+the mx.random key stream, per sample.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..numpy.multiarray import _wrap, ndarray
+
+__all__ = ["to_tensor", "normalize", "resize", "crop", "random_crop",
+           "random_resized_crop", "flip_left_right", "flip_top_bottom",
+           "random_flip_left_right", "random_flip_top_bottom",
+           "random_brightness", "random_contrast", "random_saturation",
+           "random_hue", "random_color_jitter", "adjust_lighting",
+           "random_lighting"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, ndarray) else jnp.asarray(x)
+
+
+def _batched(x):
+    """(raw NHWC batch, had_batch_dim)."""
+    r = _raw(x)
+    if r.ndim == 3:
+        return r[None], False
+    if r.ndim == 4:
+        return r, True
+    raise MXNetError(f"image ops expect HWC or NHWC input, got {r.shape}")
+
+
+def _debatch(out, batched):
+    return _wrap(out if batched else out[0])
+
+
+def _key():
+    from .. import random as _random
+    return _random._next_key()
+
+
+def to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference:
+    image_random.cc _image_to_tensor; NHWC -> NCHW for batches)."""
+    r = _raw(data)
+    scaled = r.astype(jnp.float32) / 255.0
+    if r.ndim == 3:
+        return _wrap(jnp.transpose(scaled, (2, 0, 1)))
+    return _wrap(jnp.transpose(scaled, (0, 3, 1, 2)))
+
+
+def normalize(data, mean=0.0, std=1.0):
+    """Channel-wise normalize on CHW/NCHW float input (reference:
+    _image_normalize)."""
+    r = _raw(data)
+    mean_a = jnp.asarray(_raw(mean) if isinstance(mean, ndarray) else mean,
+                         jnp.float32)
+    std_a = jnp.asarray(_raw(std) if isinstance(std, ndarray) else std,
+                        jnp.float32)
+    c_axis = r.ndim - 3  # CHW -> 0, NCHW -> 1
+    shape = [1] * r.ndim
+    shape[c_axis] = -1
+    return _wrap((r - mean_a.reshape(shape)) / std_a.reshape(shape))
+
+
+def resize(data, size, keep_ratio=False, interp=1):
+    """Reference: resize.cc _image_resize. size: int or (w, h)."""
+    from ..image import _batch_resize
+    r, batched = _batched(data)
+    h, w = r.shape[1], r.shape[2]
+    if isinstance(size, int):
+        if keep_ratio:
+            if h > w:
+                out_hw = (int(h * size / w), size)
+            else:
+                out_hw = (size, int(w * size / h))
+        else:
+            out_hw = (size, size)
+    else:
+        out_hw = (size[1], size[0])
+    dt = r.dtype
+    out = _batch_resize(r.astype(jnp.float32), out_hw,
+                        bilinear=bool(interp))
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)
+
+
+def crop(data, x, y, width, height):
+    """Reference: crop.cc _image_crop (x, y = top-left corner)."""
+    r, batched = _batched(data)
+    out = r[:, y:y + height, x:x + width]
+    return _debatch(out, batched)
+
+
+def random_crop(data, xrange=(0.0, 1.0), yrange=(0.0, 1.0), width=None,
+                height=None, interp=1):
+    """Crop `width`x`height` at a fractional position drawn from
+    xrange/yrange (reference: crop-inl.h RandomCrop; CenterCrop passes
+    (0.5, 0.5)).  Upsamples when the source is smaller than the target."""
+    from ..image import _affine_crop_resize
+    if width is None or height is None:
+        raise MXNetError("random_crop requires width and height")
+    r, batched = _batched(data)
+    n, h, w = r.shape[0], r.shape[1], r.shape[2]
+    dt = r.dtype
+    kx, ky = jax.random.split(_key())
+    fx = jax.random.uniform(kx, (n,), minval=xrange[0], maxval=xrange[1])
+    fy = jax.random.uniform(ky, (n,), minval=yrange[0], maxval=yrange[1])
+    cw, ch = min(width, w), min(height, h)
+    x0 = jnp.floor(fx * (w - cw + 1))
+    y0 = jnp.floor(fy * (h - ch + 1))
+    out = _affine_crop_resize(r.astype(jnp.float32), y0, x0,
+                              jnp.full((n,), float(ch)),
+                              jnp.full((n,), float(cw)),
+                              (height, width), bilinear=bool(interp))
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)
+
+
+def random_resized_crop(data, width=None, height=None, area=(0.08, 1.0),
+                        ratio=(3 / 4.0, 4 / 3.0), interp=1, max_trial=10):
+    """Inception-style random area/aspect crop resized to (width, height)
+    (reference: crop-inl.h RandomResizedCrop), batched as an affine
+    resample."""
+    from ..image import RandomSizedCropAug
+    r, batched = _batched(data)
+    dt = r.dtype
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    aug = RandomSizedCropAug((width, height), area, ratio, interp)
+    out = aug.batch_apply(r.astype(jnp.float32), _key())
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)
+
+
+def flip_left_right(data):
+    r, batched = _batched(data)
+    return _debatch(r[:, :, ::-1], batched)
+
+
+def flip_top_bottom(data):
+    r, batched = _batched(data)
+    return _debatch(r[:, ::-1], batched)
+
+
+def _random_flip(data, axis, p=0.5):
+    r, batched = _batched(data)
+    flip = jax.random.bernoulli(_key(), p, (r.shape[0],))
+    flipped = r[:, :, ::-1] if axis == 2 else r[:, ::-1]
+    out = jnp.where(flip[:, None, None, None], flipped, r)
+    return _debatch(out, batched)
+
+
+def random_flip_left_right(data, p=0.5):
+    return _random_flip(data, 2, p)
+
+
+def random_flip_top_bottom(data, p=0.5):
+    return _random_flip(data, 1, p)
+
+
+def _enhance(data, mode, min_factor, max_factor):
+    # factor drawn in [min,max] (the Augmenter classes use symmetric
+    # jitter ranges, so the blend is applied here with explicit bounds)
+    from ..image import _rgb_luma
+    if mode not in ("brightness", "contrast", "saturation"):
+        raise MXNetError(f"unknown enhance mode {mode!r}")
+    r, batched = _batched(data)
+    dt = r.dtype
+    n = r.shape[0]
+    alpha = jax.random.uniform(_key(), (n, 1, 1, 1), minval=min_factor,
+                               maxval=max_factor)
+    x = r.astype(jnp.float32)
+    if mode == "brightness":
+        out = x * alpha
+    elif mode == "contrast":
+        mean_luma = _rgb_luma(x).mean(axis=(1, 2), keepdims=True)
+        out = x * alpha + mean_luma * (1.0 - alpha)
+    else:  # saturation
+        out = x * alpha + _rgb_luma(x) * (1.0 - alpha)
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)
+
+
+def random_brightness(data, min_factor, max_factor):
+    return _enhance(data, "brightness", min_factor, max_factor)
+
+
+def random_contrast(data, min_factor, max_factor):
+    return _enhance(data, "contrast", min_factor, max_factor)
+
+
+def random_saturation(data, min_factor, max_factor):
+    return _enhance(data, "saturation", min_factor, max_factor)
+
+
+def random_hue(data, min_factor, max_factor):
+    """Hue rotation with factor drawn in [min,max] (reference:
+    image_random.cc RandomHue); 1.0 = identity.  theta = (f - 1) * pi,
+    so the requested (possibly asymmetric) range is honored exactly."""
+    from ..image import HueJitterAug
+    r, batched = _batched(data)
+    dt = r.dtype
+    n = r.shape[0]
+    f = jax.random.uniform(_key(), (n,), minval=min_factor,
+                           maxval=max_factor)
+    theta = (f - 1.0) * jnp.pi
+    aug = HueJitterAug(0.0)
+    out = aug._rotate(r.astype(jnp.float32), theta)
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)
+
+
+def random_color_jitter(data, brightness=0, contrast=0, saturation=0, hue=0):
+    from ..image import ColorJitterAug, HueJitterAug
+    r, batched = _batched(data)
+    dt = r.dtype
+    x = r.astype(jnp.float32)
+    x = ColorJitterAug(brightness, contrast, saturation).batch_apply(
+        x, _key())
+    if hue:
+        x = HueJitterAug(hue).batch_apply(x, _key())
+    if jnp.issubdtype(dt, jnp.integer):
+        x = jnp.clip(jnp.round(x), 0, 255)
+    return _debatch(x.astype(dt), batched)
+
+
+def adjust_lighting(data, alpha):
+    """AlexNet-PCA lighting with FIXED alpha (reference:
+    image_random.cc _image_adjust_lighting)."""
+    from ..image import LightingAug
+    import numpy as onp
+    aug = LightingAug(1.0, onp.array([55.46, 4.794, 1.148]),
+                      onp.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.8140],
+                                 [-0.5836, -0.6948, 0.4203]]))
+    r, batched = _batched(data)
+    dt = r.dtype
+    a = jnp.broadcast_to(jnp.asarray(alpha, jnp.float32), (r.shape[0], 3))
+    rgb = (a * jnp.asarray(aug.eigval)) @ jnp.asarray(aug.eigvec).T
+    out = r.astype(jnp.float32) + rgb[:, None, None, :]
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)
+
+
+def random_lighting(data, alpha_std=0.05):
+    from ..image import LightingAug
+    import numpy as onp
+    aug = LightingAug(alpha_std, onp.array([55.46, 4.794, 1.148]),
+                      onp.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.8140],
+                                 [-0.5836, -0.6948, 0.4203]]))
+    r, batched = _batched(data)
+    dt = r.dtype
+    out = aug.batch_apply(r.astype(jnp.float32), _key())
+    if jnp.issubdtype(dt, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return _debatch(out.astype(dt), batched)

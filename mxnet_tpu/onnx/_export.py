@@ -59,7 +59,8 @@ class _Ctx:
         return f"{prefix}_{self._n}"
 
     def node(self, op, inputs, n_out=1, out=None, **attrs):
-        outs = out if out is not None else [self.fresh(op.lower())]
+        outs = out if out is not None \
+            else [self.fresh(op.lower()) for _ in range(n_out)]
         if isinstance(outs, str):
             outs = [outs]
         self.nodes.append(make_node(op, list(inputs), outs, **attrs))
@@ -318,9 +319,10 @@ def _iota(ctx, eqn, ins, out):
     shape = tuple(p["shape"])
     dim = p["dimension"]
     dt = np.dtype(p["dtype"])
-    # Range supports numeric dtypes; generate in the target dtype when it
-    # is float/int, else in int64 then Cast
-    gen_dt = dt if dt.kind in "ifu" and dt.itemsize >= 4 else np.int64
+    # ONNX Range only supports float/double/int16/int32/int64: generate in
+    # the target dtype for signed int/float >= 32-bit, else int64 + Cast
+    # (unsigned dtypes in particular must go through the Cast path)
+    gen_dt = dt if dt.kind in "if" and dt.itemsize >= 4 else np.int64
     r = ctx.node("Range", [ctx.const(np.asarray(0, gen_dt)),
                            ctx.const(np.asarray(shape[dim], gen_dt)),
                            ctx.const(np.asarray(1, gen_dt))])
@@ -593,6 +595,179 @@ for _p in ("jit", "pjit", "closed_call", "core_call", "remat",
     _reg(_p)(_inline)
 
 
+@_reg("split")
+def _split(ctx, eqn, ins, out):
+    p = eqn.params
+    sizes = [int(s) for s in p["sizes"]]
+    axis = int(p["axis"])
+    outs = out if isinstance(out, list) else [out]
+    outs = [o or ctx.fresh("split_drop") for o in outs]
+    ctx.node("Split", [ins[0], ctx.i64(sizes)], n_out=len(outs),
+             out=outs, axis=axis)
+
+
+@_reg("atan2")
+def _atan2(ctx, eqn, ins, out):
+    # no Atan2 in ONNX: atan(y/x) with quadrant correction via signs
+    y, x = ins
+    q = ctx.node("Div", [y, x])
+    a = ctx.node("Atan", [q])
+    dt = _dtype(eqn.invars[0])
+    pi = ctx.const(np.asarray(np.pi, dt))
+    zero = ctx.const(np.asarray(0, dt))
+    x_neg = ctx.node("Less", [x, zero])
+    y_neg = ctx.node("Less", [y, zero])
+    corr_sign = ctx.node("Where", [y_neg, ctx.const(np.asarray(-1, dt)),
+                                   ctx.const(np.asarray(1, dt))])
+    corr = ctx.node("Mul", [corr_sign, pi])
+    corrected = ctx.node("Add", [a, corr])
+    ctx.node("Where", [x_neg, corrected, a], out=out)
+
+
+@_reg("cumprod")
+def _cumprod(ctx, eqn, ins, out):
+    # CumProd is not standard ONNX: exp(cumsum(log(x))) works for positive
+    # inputs; general sign handling via cumulative sign products
+    axis = eqn.params["axis"]
+    dt = _dtype(eqn.invars[0])
+    absx = ctx.node("Abs", ins)
+    logx = ctx.node("Log", [absx])
+    csum = ctx.node("CumSum", [logx, ctx.const(np.asarray(axis, np.int64))])
+    mag = ctx.node("Exp", [csum])
+    sign = ctx.node("Sign", ins)
+    # cumulative product of signs: count of negatives so far, parity
+    neg = ctx.node("Less", [sign, ctx.const(np.asarray(0, dt))])
+    negf = ctx.node("Cast", [neg], to=onnx_dtype(np.dtype(np.float32)))
+    negc = ctx.node("CumSum", [negf, ctx.const(np.asarray(axis, np.int64))])
+    par = ctx.node("Mod", [negc, ctx.const(np.asarray(2.0, np.float32))],
+                   fmod=1)
+    two = ctx.const(np.asarray(-2.0, np.float32))
+    sgn = ctx.node("Add", [ctx.node("Mul", [par, two]),
+                           ctx.const(np.asarray(1.0, np.float32))])
+    sgn_c = ctx.node("Cast", [sgn], to=onnx_dtype(dt))
+    ctx.node("Mul", [mag, sgn_c], out=out)
+
+
+@_reg("top_k")
+def _top_k(ctx, eqn, ins, out):
+    k = eqn.params["k"]
+    vals, idx = ctx.node("TopK", [ins[0], ctx.i64([k])], n_out=2,
+                         axis=-1, largest=1, sorted=1)
+    outs = out if isinstance(out, list) else [out]
+    ctx.node("Identity", [vals], out=outs[0])
+    if len(outs) > 1 and outs[1] is not None:
+        idx32 = ctx.node("Cast", [idx],
+                         to=onnx_dtype(_dtype(eqn.outvars[1])))
+        ctx.node("Identity", [idx32], out=outs[1])
+
+
+@_reg("sort")
+def _sort(ctx, eqn, ins, out):
+    p = eqn.params
+    dim = p.get("dimension", -1)
+    n = _shape(eqn.invars[0])[dim]
+    if len(ins) > 2:
+        raise NotImplementedError("sort of >2 operands has no ONNX path")
+    # 2-operand form: the argsort pattern (keys, iota) — TopK's index
+    # output IS the sorted iota. TopK is unstable; accepted divergence.
+    vals, idx = ctx.node("TopK", [ins[0], ctx.i64([n])], n_out=2,
+                         axis=dim, largest=0, sorted=1)
+    outs = out if isinstance(out, list) else [out]
+    ctx.node("Identity", [vals], out=outs[0])
+    for extra, var in zip(outs[1:], eqn.outvars[1:]):
+        if extra is not None:
+            cast = ctx.node("Cast", [idx], to=onnx_dtype(var.aval.dtype))
+            ctx.node("Identity", [cast], out=extra)
+
+
+@_reg("scatter", "scatter-update")
+def _scatter_set(ctx, eqn, ins, out):
+    _scatter_impl(ctx, eqn, ins, out, "none")
+
+
+@_reg("scatter-add")
+def _scatter_add(ctx, eqn, ins, out):
+    _scatter_impl(ctx, eqn, ins, out, "add")
+
+
+def _scatter_impl(ctx, eqn, ins, out, reduction):
+    """Row-wise scatter (the .at[idx].set/.add pattern: index vector over
+    axis 0, full trailing window) -> ONNX ScatterND."""
+    dn = eqn.params["dimension_numbers"]
+    operand, indices, updates = ins
+    op_shape = _shape(eqn.invars[0])
+    if (tuple(dn.scatter_dims_to_operand_dims) != (0,)
+            or tuple(dn.inserted_window_dims) != (0,)):
+        raise NotImplementedError(
+            "only axis-0 row scatter translates to ONNX ScatterND")
+    idx_shape = _shape(eqn.invars[1])
+    # lax scatter indices: (..., 1); ScatterND wants (..., 1) int64 too
+    idx64 = ctx.node("Cast", [indices], to=onnx_dtype(np.dtype(np.int64)))
+    if len(idx_shape) == 1:
+        idx64 = ctx.node("Unsqueeze", [idx64, ctx.i64([-1])])
+    kwargs = {} if reduction == "none" else {"reduction": reduction}
+    ctx.node("ScatterND", [operand, idx64, updates], out=out, **kwargs)
+
+
+@_reg("scan")
+def _scan(ctx, eqn, ins, out):
+    """lax.scan -> ONNX Scan. Body consts become outer-scope references
+    (ONNX subgraphs capture enclosing names); carries map to Scan state
+    variables, xs to scan inputs, ys to scan outputs."""
+    p = eqn.params
+    closed = p["jaxpr"]
+    inner = closed.jaxpr
+    n_const, n_carry = p["num_consts"], p["num_carry"]
+    reverse = bool(p.get("reverse", False))
+    const_names = ins[:n_const]
+    carry_init = ins[n_const:n_const + n_carry]
+    xs_names = ins[n_const + n_carry:]
+    n_xs = len(xs_names)
+    n_ys = len(inner.outvars) - n_carry
+
+    # build the body subgraph with its own node list
+    body_in_names = []
+    sub_nodes = []
+    saved_nodes, ctx.nodes = ctx.nodes, sub_nodes
+    try:
+        body = serde.GraphProto()
+        body.name = ctx.fresh("scan_body")
+        env = {}
+        for var, cname in zip(inner.invars[:n_const], const_names):
+            env[var] = cname  # outer-scope capture
+        for var in inner.invars[n_const:]:
+            nm = ctx.fresh("scan_in")
+            env[var] = nm
+            body_in_names.append(nm)
+            aval = var.aval
+            body.input.add().CopyFrom(make_value_info(
+                nm, aval.dtype, aval.shape))
+        out_names = _translate_jaxpr(ctx, inner, closed.consts,
+                                     [env[v] for v in inner.invars])
+        produced = {o for n in sub_nodes for o in n.output}
+        for i, (nm, var) in enumerate(zip(out_names, inner.outvars)):
+            if nm not in produced or out_names.count(nm) > 1:
+                nm2 = ctx.fresh("scan_out")
+                ctx.node("Identity", [nm], out=nm2)
+                nm = nm2
+                out_names[i] = nm
+            body.output.add().CopyFrom(make_value_info(
+                nm, var.aval.dtype, var.aval.shape))
+        for n in sub_nodes:
+            body.node.add().CopyFrom(n)
+    finally:
+        ctx.nodes = saved_nodes
+
+    outs = out if isinstance(out, list) else [out]
+    scan_outs = [o or ctx.fresh("scan_drop") for o in outs]
+    direction = [1 if reverse else 0] * n_xs
+    ctx.node("Scan", list(carry_init) + list(xs_names),
+             n_out=len(scan_outs), out=scan_outs, body=body,
+             num_scan_inputs=n_xs,
+             scan_input_directions=direction,
+             scan_output_directions=[1 if reverse else 0] * n_ys)
+
+
 # --------------------------------------------------------------------------
 # jaxpr walker
 # --------------------------------------------------------------------------
@@ -707,7 +882,10 @@ def trace_to_onnx(fn, example_args, *, graph_name="mxnet_tpu",
     final = []
     produced = {o for n in ctx.nodes for o in n.output}
     for i, (name, var) in enumerate(zip(out_names, closed.jaxpr.outvars)):
-        if name not in produced or name in ctx.initializers:
+        if name not in produced or name in ctx.initializers \
+                or name in final:
+            # the `final` check: a model returning the same traced value
+            # twice must not emit two graph.outputs with one name
             name = ctx.node("Identity", [name], out=f"output_{i}")
         final.append(name)
     for n in ctx.nodes:

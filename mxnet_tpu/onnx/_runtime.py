@@ -253,6 +253,62 @@ _OPS["ReduceProd"] = _reduce("prod")
 _OPS["ReduceMean"] = _reduce("mean")
 
 
+@_op("Split")
+def _split(attrs, x, sizes=None):
+    jnp = _jnp()
+    axis = attrs.get("axis", 0)
+    sz = [int(s) for s in np.asarray(sizes).reshape(-1)]
+    offs = np.cumsum([0] + sz)
+    return [jnp.take(x, jnp.arange(offs[i], offs[i + 1]), axis=axis)
+            for i in range(len(sz))]
+
+
+@_op("Sign")
+def _sign(attrs, x):
+    return _jnp().sign(x)
+
+
+@_op("Atan")
+def _atan(attrs, x):
+    return _jnp().arctan(x)
+
+
+@_op("TopK")
+def _topk(attrs, x, k):
+    jnp = _jnp()
+    k = int(np.asarray(k).reshape(-1)[0])
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", 1)
+    sorted_ = attrs.get("sorted", 1)  # lax.top_k always sorts
+    if axis not in (-1, x.ndim - 1):
+        x_sw = jnp.moveaxis(x, axis, -1)
+    else:
+        x_sw = x
+    src = x_sw if largest else -x_sw
+    import jax
+    vals, idx = jax.lax.top_k(src, k)
+    if not largest:
+        vals = -vals
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(np.int64)
+
+
+@_op("ScatterND")
+def _scatternd(attrs, data, indices, updates):
+    jnp = _jnp()
+    red = attrs.get("reduction", "none")
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    if red == "add":
+        return data.at[idx].add(updates)
+    if red in ("none", b"none", ""):
+        return data.at[idx].set(updates)
+    if red == "mul":
+        return data.at[idx].multiply(updates)
+    raise NotImplementedError(f"ScatterND reduction {red!r}")
+
+
 @_op("ArgMax")
 def _argmax(attrs, x):
     r = _jnp().argmax(x, axis=attrs.get("axis", 0))
@@ -402,6 +458,55 @@ def _constant_of_shape(attrs, shape):
 
 # --------------------------------------------------------------------------
 
+def _run_scan(attrs, vals, outer_env):
+    """ONNX Scan via lax.scan: body subgraph nodes become the scan body;
+    names not defined in the body resolve from the enclosing graph env
+    (outer-scope captures, which lax treats as closure constants)."""
+    import jax
+    jnp = _jnp()
+    from .serde import node_attrs as _na, to_array as _ta
+    body = attrs["body"]
+    n_scan = int(attrs["num_scan_inputs"])
+    dirs = list(attrs.get("scan_input_directions", [])) or [0] * n_scan
+    out_dirs = list(attrs.get("scan_output_directions", []))
+    n_state = len(vals) - n_scan
+    state0 = vals[:n_state]
+    xs = vals[n_state:]
+    if any(dirs):
+        xs = [jnp.flip(x, 0) if d else x for x, d in zip(xs, dirs)]
+    body_nodes = [(n.op_type, list(n.input), list(n.output), _na(n))
+                  for n in body.node]
+    body_inits = {t.name: _ta(t) for t in body.initializer}
+    in_names = [vi.name for vi in body.input]
+    out_names = [vi.name for vi in body.output]
+    n_ys = len(out_names) - n_state
+
+    def step(carry, x_slices):
+        env = dict(outer_env)
+        env.update(body_inits)
+        for nm, v in zip(in_names[:n_state], carry):
+            env[nm] = v
+        for nm, v in zip(in_names[n_state:], x_slices):
+            env[nm] = v
+        for op_type, ins, outs, a in body_nodes:
+            vv = [env[i] if i else None for i in ins]
+            res = (_run_scan(a, vv, env) if op_type == "Scan"
+                   else _OPS[op_type](a, *vv))
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            for name, v in zip(outs, res):
+                env[name] = v
+        outs_v = [env[o] for o in out_names]
+        return tuple(outs_v[:n_state]), tuple(outs_v[n_state:])
+
+    final, ys = jax.lax.scan(step, tuple(state0), tuple(xs))
+    ys = list(ys)
+    for i, y in enumerate(ys):
+        if i < len(out_dirs) and out_dirs[i]:
+            ys[i] = jnp.flip(y, 0)
+    return list(final) + ys
+
+
 def make_fn(model, weights_override=None):
     """Build `fn(*inputs) -> list[jnp.ndarray]` from a ModelProto.
 
@@ -419,9 +524,17 @@ def make_fn(model, weights_override=None):
     output_names = [vi.name for vi in graph.output]
     nodes = [(n.op_type, list(n.input), list(n.output), node_attrs(n))
              for n in graph.node]
-    for op_type, *_ in nodes:
-        if op_type not in _OPS:
-            raise NotImplementedError(f"ONNX op {op_type!r} unsupported")
+
+    def _check_ops(node_list):
+        for n in node_list:
+            if n.op_type == "Scan":
+                for a in n.attribute:
+                    if a.name == "body":
+                        _check_ops(a.g.node)  # validate subgraphs at load
+            elif n.op_type not in _OPS:
+                raise NotImplementedError(
+                    f"ONNX op {n.op_type!r} unsupported")
+    _check_ops(graph.node)
 
     def fn(*args, **kwargs):
         jnp = _jnp()
@@ -436,7 +549,10 @@ def make_fn(model, weights_override=None):
             env[k] = jnp.asarray(bound[k])
         for op_type, ins, outs, attrs in nodes:
             vals = [env[i] if i else None for i in ins]
-            res = _OPS[op_type](attrs, *vals)
+            if op_type == "Scan":
+                res = _run_scan(attrs, vals, env)
+            else:
+                res = _OPS[op_type](attrs, *vals)
             if not isinstance(res, (list, tuple)):
                 res = [res]
             for name, v in zip(outs, res):

@@ -16,21 +16,38 @@ __all__ = ["print_summary", "plot_network", "dot_graph"]
 
 
 def _block_rows(block, input_shape):
-    """(name, type, out_shape, n_params) per direct child via a shaped
-    forward probe."""
+    """(name, type, out_shape, n_params) per direct child.
+
+    Shapes are captured with forward hooks during ONE full forward of the
+    parent block, so branching/residual architectures report each child's
+    true output shape (a sequential probe would mis-thread them)."""
     from . import numpy as mxnp
+    shapes = {}
+    hooks = []
+    for name, child in block._children.items():
+        def mk(name):
+            def hook(blk, args, out):
+                o = out[0] if isinstance(out, (list, tuple)) else out
+                shapes[name] = tuple(getattr(o, "shape", ()))
+            return hook
+        hooks.append((child, child.register_forward_hook(mk(name))))
+    try:
+        block(mxnp.zeros(input_shape))
+    except Exception:
+        pass  # partial rows are still useful; missing shapes print '?'
+    finally:
+        for child, h in hooks:
+            try:
+                child._forward_hooks.remove(h)
+            except (ValueError, AttributeError):
+                pass
     rows = []
-    x = mxnp.zeros(input_shape)
     for name, child in block._children.items():
         params = sum(
             int(onp.prod(p.shape)) for p in child.collect_params().values()
             if p._data is not None or p._shape_known())
-        try:
-            x = child(x)
-            shape = tuple(x.shape)
-        except Exception:
-            shape = "?"
-        rows.append((name, type(child).__name__, shape, params))
+        rows.append((name, type(child).__name__,
+                     shapes.get(name, "?"), params))
     return rows
 
 

@@ -58,6 +58,25 @@ def main():
     kv3.pull(3, out=out3)
     check_eq(out3, -0.1 * n, "sgd on kvstore")
 
+    # --- dist_async: immediate local updates, stale until pull -----------
+    # (reference: kvstore_dist_server.h async ApplyUpdates — no
+    # cross-worker aggregation at push time)
+    kv4 = kvstore.create("dist_async")
+    assert isinstance(kv4, kvstore.DistAsyncKVStore)
+    kv4.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+    kv4.init("a0", mx.np.zeros(shape))
+    # each worker pushes a DIFFERENT gradient; without a pull, the local
+    # replica must reflect only the local update (staleness!)
+    kv4.push("a0", mx.np.full(shape, float(rank + 1)))
+    local = kv4._store["a0"].asnumpy()
+    assert onp.allclose(local, -(rank + 1)), \
+        f"async push leaked across workers: {local.ravel()[:3]}"
+    # pull reconciles: every worker now sees the average of the replicas
+    out4 = mx.np.empty(shape)
+    kv4.pull("a0", out=out4)
+    expect = -sum(range(1, n + 1)) / n
+    check_eq(out4, expect, "async pull reconciliation")
+
     print(f"DIST_OK {rank}", flush=True)
 
 

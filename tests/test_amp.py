@@ -190,3 +190,66 @@ def test_convert_symbol_fp32_ops_stay_fp32():
     assert str(got.dtype) == "float32"
     onp.testing.assert_allclose(got.asnumpy().sum(-1), onp.ones(4),
                                 rtol=1e-3)
+
+
+def test_amp_conditional_fp32_ops():
+    """Conditional entries (op, attr, values) run fp32 only for the listed
+    attr values (reference: CONDITIONAL_FP32_FUNCS)."""
+    from mxnet_tpu import npx
+    amp.init("bfloat16")
+    try:
+        x = mx.np.array(onp.random.randn(4, 8).astype("float32"))
+        # softrelu is conditionally fp32; relu is not listed -> unchanged
+        soft = npx.activation(x.astype("bfloat16"), "softrelu")
+        assert str(soft.dtype) == "float32"
+        rel = npx.activation(x.astype("bfloat16"), "relu")
+        assert str(rel.dtype) == "bfloat16"
+        # leaky_relu elu conditional; leaky not
+        elu = npx.leaky_relu(x.astype("bfloat16"), act_type="elu")
+        assert str(elu.dtype) == "float32"
+        leaky = npx.leaky_relu(x.astype("bfloat16"), act_type="leaky")
+        assert str(leaky.dtype) == "bfloat16"
+        # user-supplied conditional triple
+        amp.init("bfloat16",
+                 conditional_fp32_ops=[("activation", "act_type", ["tanh"])])
+        tanh = npx.activation(x.astype("bfloat16"), "tanh")
+        assert str(tanh.dtype) == "float32"
+    finally:
+        amp._deactivate()
+
+
+def test_amp_dtype_drift_oracle():
+    """Drive a mixed net under amp.init() and assert every intermediate
+    dtype against the policy oracle: MXU ops -> target dtype, fp32-listed
+    ops -> fp32, unlisted elementwise -> input dtype, mixed elementwise ->
+    widest (jnp promotion)."""
+    from mxnet_tpu import npx
+    amp.init("bfloat16")
+    try:
+        x = mx.np.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+        w = mx.np.array(onp.random.randn(4, 3, 3, 3).astype("float32"))
+        g = mx.np.ones(4)
+        b = mx.np.zeros(4)
+        rm = mx.np.zeros(4)
+        rv = mx.np.ones(4)
+
+        conv = npx.convolution(x, w, kernel=(3, 3), num_filter=4,
+                               pad=(1, 1), no_bias=True)
+        assert str(conv.dtype) == "bfloat16"          # TARGET op
+        act = npx.activation(conv, "relu")
+        assert str(act.dtype) == "bfloat16"           # unlisted: keep dtype
+        bn = npx.batch_norm(act, g, b, rm, rv, use_global_stats=True)
+        assert str(bn.dtype) == "float32"             # FP32 op upcasts
+        pooled = npx.pooling(bn.astype("bfloat16"), kernel=(2, 2),
+                             stride=(2, 2), pool_type="max")
+        assert str(pooled.dtype) == "bfloat16"        # pooling:max unlisted
+        mixed = pooled + bn[:, :, ::2, ::2]
+        assert str(mixed.dtype) == "float32"          # widest-type combine
+        flat = mixed.reshape((2, -1))
+        wfc = mx.np.array(onp.random.randn(5, flat.shape[1]).astype("float32"))
+        fc = npx.fully_connected(flat, wfc, num_hidden=5, no_bias=True)
+        assert str(fc.dtype) == "bfloat16"            # TARGET op downcasts
+        sm = npx.softmax(fc)
+        assert str(sm.dtype) == "float32"             # FP32 op
+    finally:
+        amp._deactivate()

@@ -1,0 +1,98 @@
+"""npx.image operator namespace (reference: src/operator/image/ ops
+behind gluon.data.vision.transforms)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import npx
+from mxnet_tpu.gluon.data.vision import transforms as T
+
+
+def _img(h=32, w=40, c=3, seed=0):
+    return onp.random.RandomState(seed).randint(
+        0, 255, (h, w, c)).astype("uint8")
+
+
+def test_to_tensor_and_normalize():
+    x = _img()
+    t = npx.image.to_tensor(x)
+    assert t.shape == (3, 32, 40) and str(t.dtype) == "float32"
+    assert 0.0 <= float(t.asnumpy().min()) and float(t.asnumpy().max()) <= 1.0
+    n = npx.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    onp.testing.assert_allclose(n.asnumpy(),
+                                (t.asnumpy() - 0.5) / 0.2, rtol=1e-5)
+    # batch NHWC -> NCHW
+    tb = npx.image.to_tensor(onp.stack([x, x]))
+    assert tb.shape == (2, 3, 32, 40)
+
+
+def test_resize_modes():
+    x = _img()
+    assert npx.image.resize(x, (20, 16)).shape == (16, 20, 3)
+    assert npx.image.resize(x, 16).shape == (16, 16, 3)
+    kept = npx.image.resize(x, 16, keep_ratio=True)
+    assert kept.shape == (16, 20, 3)  # short edge 32 -> 16, 40 -> 20
+    assert str(kept.dtype) == "uint8"
+
+
+def test_crop_ops():
+    x = _img()
+    c = npx.image.crop(x, 4, 2, 10, 8)
+    onp.testing.assert_array_equal(c.asnumpy(), x[2:10, 4:14])
+    cc = npx.image.random_crop(x, (0.5, 0.5), (0.5, 0.5),
+                               width=16, height=16)
+    onp.testing.assert_allclose(cc.asnumpy(), x[8:24, 12:28], atol=1)
+    rrc = npx.image.random_resized_crop(x, width=16, height=16)
+    assert rrc.shape == (16, 16, 3)
+    # upsample when source smaller than target
+    up = npx.image.random_crop(x, (0.5, 0.5), (0.5, 0.5),
+                               width=64, height=64)
+    assert up.shape == (64, 64, 3)
+
+
+def test_flips():
+    x = _img()
+    onp.testing.assert_array_equal(
+        npx.image.flip_left_right(x).asnumpy(), x[:, ::-1])
+    onp.testing.assert_array_equal(
+        npx.image.flip_top_bottom(x).asnumpy(), x[::-1])
+    flipped = npx.image.random_flip_left_right(onp.stack([x] * 64))
+    arr = flipped.asnumpy()
+    n_flipped = sum(bool((arr[i] == x[:, ::-1]).all()) for i in range(64))
+    assert 5 < n_flipped < 59  # ~Binomial(64, .5)
+
+
+def test_color_ops_bounds_and_identity():
+    x = _img()
+    for fn in [lambda a: npx.image.random_brightness(a, 1.0, 1.0),
+               lambda a: npx.image.random_contrast(a, 1.0, 1.0),
+               lambda a: npx.image.random_saturation(a, 1.0, 1.0),
+               lambda a: npx.image.random_hue(a, 1.0, 1.0)]:
+        out = fn(x).asnumpy()
+        onp.testing.assert_allclose(out, x, atol=1.01)  # identity factor
+    j = npx.image.random_color_jitter(x, 0.4, 0.4, 0.4, 0.2).asnumpy()
+    assert j.dtype == onp.uint8 and j.shape == x.shape
+    lit = npx.image.adjust_lighting(x, (0.1, 0.1, 0.1))
+    assert lit.shape == x.shape
+    assert npx.image.random_lighting(x, 0.05).shape == x.shape
+
+
+def test_transforms_compose_through_npx_image():
+    x = mx.np.array(_img(50, 60))
+    aug = T.Compose([
+        T.Resize(40), T.RandomResizedCrop(32), T.RandomFlipLeftRight(),
+        T.RandomColorJitter(0.2, 0.2, 0.2, 0.1), T.ToTensor(),
+        T.Normalize((0.485, 0.456, 0.406), (0.229, 0.224, 0.225))])
+    out = aug(x)
+    assert out.shape == (3, 32, 32)
+    assert onp.isfinite(out.asnumpy()).all()
+    # batched input flows through the same chain
+    xb = mx.np.array(onp.stack([_img(40, 40), _img(40, 40, seed=1)]))
+    outb = aug(xb)
+    assert outb.shape == (2, 3, 32, 32)
+
+
+def test_random_crop_transform_with_pad():
+    x = mx.np.array(_img(32, 32))
+    out = T.RandomCrop(32, pad=4).forward(x)
+    assert out.shape == (32, 32, 3)

@@ -280,3 +280,130 @@ def test_import_external_style_model():
     out = fn(x)[0]
     onp.testing.assert_allclose(onp.asarray(out),
                                 onp.maximum(x @ w + b, 0), rtol=1e-5)
+
+
+def test_export_all_vision_families():
+    """Every model_zoo vision family exports and round-trips numerically
+    (reference: tests/python/onnx model zoo coverage)."""
+    from mxnet_tpu.gluon.model_zoo import vision as V
+    factories = [
+        ("alexnet", lambda: V.alexnet(classes=10), (1, 3, 64, 64)),
+        ("vgg11", lambda: V.vgg11(classes=10), (1, 3, 32, 32)),
+        ("resnet18_v2", lambda: V.resnet18_v2(classes=10), (1, 3, 32, 32)),
+        ("squeezenet", lambda: V.squeezenet1_0(classes=10), (1, 3, 64, 64)),
+        ("densenet121", lambda: V.densenet121(classes=10), (1, 3, 32, 32)),
+        ("mobilenet", lambda: V.mobilenet0_25(classes=10), (1, 3, 32, 32)),
+        ("mobilenet_v2", lambda: V.mobilenet_v2_0_25(classes=10),
+         (1, 3, 32, 32)),
+        ("inception_v3", lambda: V.inception_v3(classes=10), (1, 3, 80, 80)),
+    ]
+    for name, ctor, shape in factories:
+        net = ctor()
+        net.initialize()
+        x = mx.np.array(onp.random.RandomState(0)
+                        .randn(*shape).astype("float32"))
+        net(x)  # materialize deferred shapes
+        _roundtrip(net, x, tol=2e-4)
+
+
+def test_export_lstm_scan():
+    """Fused RNN (lax.scan over time) exports through ONNX Scan and
+    round-trips (reference: mx2onnx RNN translation)."""
+    net = nn.HybridSequential()
+    from mxnet_tpu.gluon import rnn as grnn
+    lstm = grnn.LSTM(8, num_layers=1)
+    lstm.initialize()
+    x = mx.np.array(onp.random.RandomState(1).randn(5, 2, 4)
+                    .astype("float32"))
+    lstm(x)
+    _roundtrip(lstm, x, tol=1e-4)
+
+
+def test_export_gru_bidirectional_scan():
+    from mxnet_tpu.gluon import rnn as grnn
+    gru = grnn.GRU(6, num_layers=1, bidirectional=True)
+    gru.initialize()
+    x = mx.np.array(onp.random.RandomState(2).randn(4, 2, 3)
+                    .astype("float32"))
+    gru(x)
+    _roundtrip(gru, x, tol=1e-4)
+
+
+def test_export_topk_sort_scatter():
+    import os
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from mxnet_tpu import npx
+
+    x = mx.np.array(onp.random.RandomState(3).randn(4, 8).astype("float32"))
+
+    def rt(fn, tol=1e-5):
+        want = onp.asarray(fn(x))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.onnx")
+            mx.onnx.export_model(fn, p, args=(x,))
+            got = mx.onnx.import_model(p)(x)
+        got = got[0] if isinstance(got, (list, tuple)) else got
+        onp.testing.assert_allclose(onp.asarray(got.asnumpy()), want,
+                                    rtol=tol, atol=tol)
+
+    def raw(a):
+        return a._data if hasattr(a, "_data") else a
+
+    def unwrap(v):
+        return v._data if hasattr(v, "_data") else v
+
+    rt(lambda a: unwrap(npx.topk(a, k=3, ret_typ="value")))
+    rt(lambda a: unwrap(mx.np.sort(a, axis=-1)))
+    rt(lambda a: raw(a).at[jnp.asarray([0, 2])].set(
+        jnp.ones((2, 8), jnp.float32)))
+    rt(lambda a: raw(a).at[jnp.asarray([1, 1, 3])].add(
+        jnp.ones((3, 8), jnp.float32)))
+
+
+def test_export_duplicate_outputs_unique_names():
+    from mxnet_tpu.onnx import serde
+
+    def f(a):
+        b = a * 2
+        return b, b  # same traced value twice
+    x = mx.np.array(onp.ones((2, 2), "float32"))
+    import os
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "dup.onnx")
+        mx.onnx.export_model(f, p, args=(x,))
+        model = serde.load_model(p)
+        names = [o.name for o in model.graph.output]
+        assert len(names) == len(set(names)), names
+        loaded = mx.onnx.import_model(p)
+        g = loaded(x)
+        onp.testing.assert_allclose(g[0].asnumpy(), 2 * onp.ones((2, 2)))
+        onp.testing.assert_allclose(g[1].asnumpy(), 2 * onp.ones((2, 2)))
+
+
+def test_export_unsigned_iota_range_cast():
+    import jax.numpy as jnp
+
+    def f(a):
+        raw = a._data if hasattr(a, "_data") else a
+        return (jnp.arange(6, dtype=jnp.uint32).reshape(1, 6) +
+                raw.astype(jnp.uint32))
+    x = mx.np.array(onp.zeros((1, 6), "float32"))
+    from mxnet_tpu.onnx import serde
+    import os
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "iota.onnx")
+        mx.onnx.export_model(f, p, args=(x,))
+        model = serde.load_model(p)
+        # every Range node must generate in a Range-legal dtype
+        legal = {serde.onnx_dtype(onp.dtype(t)) for t in
+                 ("float32", "float64", "int16", "int32", "int64")}
+        for node in model.graph.node:
+            if node.op_type == "Range":
+                ini = {t.name: t for t in model.graph.initializer}
+                start = ini[node.input[0]]
+                assert start.data_type in legal
