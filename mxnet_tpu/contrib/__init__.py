@@ -6,3 +6,4 @@ roles live in mx.onnx and the XLA pipeline here).
 """
 from . import quantization
 from . import tensorboard
+from . import text  # noqa: F401,E402 (vocab + pretrained embeddings)

@@ -60,9 +60,9 @@ def Custom(*inputs, op_type=None, **kwargs):
 
 
 def __getattr__(name):
-    if name == "register":  # the submodule itself, not an op
+    if name in ("register", "contrib"):  # submodules, not ops
         import importlib
-        return importlib.import_module(__name__ + ".register")
+        return importlib.import_module(__name__ + "." + name)
     # 1) the table-driven legacy surface (CamelCase layer ops + legacy
     #    snake_case names like broadcast_add) — see register.py
     import importlib

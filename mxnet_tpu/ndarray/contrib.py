@@ -1,0 +1,176 @@
+"""mx.nd.contrib — contrib operator namespace.
+
+Reference parity: python/mxnet/ndarray/contrib.py (control-flow helpers
+foreach/while_loop/cond) plus the contrib C++ ops this build keeps:
+FFT (src/operator/contrib/fft-inl.h: real (N, d) -> interleaved
+real/imag (N, 2d)), and the DGL graph-sampling family
+(src/operator/contrib/dgl_graph.cc).
+
+TPU-native: FFT lowers to jnp.fft (XLA FFT HLO); the DGL samplers are
+imperative host ops (data-dependent output shapes, like the reference's
+CPU-only implementations).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..numpy.multiarray import _wrap, ndarray
+
+
+def _raw(x):
+    import jax.numpy as jnp
+    return x._data if isinstance(x, ndarray) else jnp.asarray(x)
+
+
+# -- control flow (reference: ndarray/contrib.py foreach/while_loop/cond) --
+
+def foreach(body, data, init_states):
+    from .. import numpy_extension as npx
+    return npx.foreach(body, data, init_states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    from .. import numpy_extension as npx
+    return npx.while_loop(cond, func, loop_vars,
+                          max_iterations=max_iterations)
+
+
+def cond(pred, then_func, else_func):
+    from .. import numpy_extension as npx
+    return npx.cond(pred, then_func, else_func)
+
+
+# -- FFT (reference: src/operator/contrib/fft-inl.h) -----------------------
+
+def fft(data, compute_size=128):
+    """1-D FFT over the last axis: real (..., d) -> (..., 2d) interleaved
+    [re0, im0, re1, im1, ...] (the reference's cuFFT wire format)."""
+    import jax.numpy as jnp
+    x = _raw(data)
+    spec = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return _wrap(out.reshape(x.shape[:-1] + (2 * x.shape[-1],))
+                 .astype(jnp.float32))
+
+
+def ifft(data, compute_size=128):
+    """Inverse of ``fft``: (..., 2d) interleaved -> real (..., d).
+
+    Matches the reference's unnormalized cuFFT inverse (ifft(fft(x)) =
+    d * x; callers divide by d, see fft-inl.h docs)."""
+    import jax.numpy as jnp
+    x = _raw(data)
+    d = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (d, 2))
+    spec = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(spec, axis=-1).real * d  # unnormalized like cuFFT
+    return _wrap(out.astype(jnp.float32))
+
+
+# -- DGL graph sampling (reference: src/operator/contrib/dgl_graph.cc) -----
+
+def dgl_csr_neighbor_uniform_sample(csr, seeds, num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """Uniform neighbor sampling from a CSR graph (reference:
+    _contrib_dgl_csr_neighbor_uniform_sample). Returns (sampled_vertices,
+    sampled_subgraph_csr, layer_ids); vertices padded with -1 to
+    max_num_vertices, with the valid count stored in the last slot."""
+    from ..ndarray.sparse import CSRNDArray
+    if not isinstance(csr, CSRNDArray):
+        raise MXNetError("expects a CSRNDArray graph")
+    indptr = onp.asarray(csr.indptr._data)
+    indices = onp.asarray(csr.indices._data)
+    seed_ids = onp.asarray(_raw(seeds)).astype("int64").ravel()
+    seed_ids = seed_ids[seed_ids >= 0]
+
+    cap = max_num_vertices - 1
+    # seeds are admitted first and the cap is enforced DURING expansion,
+    # so seed vertices can never be truncated out of the sample
+    visited = {}
+    for v in seed_ids[:cap]:
+        visited[int(v)] = 0
+    frontier = list(visited)
+    rng = onp.random
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            nbrs = indices[indptr[v]:indptr[v + 1]]
+            if len(nbrs) == 0:
+                continue
+            take = min(num_neighbor, len(nbrs))
+            chosen = rng.choice(nbrs, size=take, replace=False)
+            for u in chosen:
+                u = int(u)
+                if u not in visited and len(visited) < cap:
+                    visited[u] = hop
+                    nxt.append(u)
+        frontier = nxt
+        if len(visited) >= cap:
+            break
+    verts = sorted(visited)
+    n_valid = len(verts)
+    out_ids = onp.full((max_num_vertices,), -1, "int64")
+    out_ids[:n_valid] = verts
+    out_ids[-1] = n_valid  # reference convention: count in the last slot
+    layers = onp.full((max_num_vertices,), -1, "int64")
+    layers[:n_valid] = [visited[v] for v in verts]
+
+    # induced subgraph CSR over the sampled vertices (relabelled 0..n-1)
+    pos = {v: i for i, v in enumerate(verts)}
+    sub_rows = []
+    for v in verts:
+        nbrs = [pos[int(u)] for u in indices[indptr[v]:indptr[v + 1]]
+                if int(u) in pos]
+        sub_rows.append(sorted(nbrs))
+    data, idx, ptr = [], [], [0]
+    for r in sub_rows:
+        idx.extend(r)
+        data.extend([1.0] * len(r))
+        ptr.append(len(idx))
+    sub = CSRNDArray(onp.asarray(data, "float32"),
+                     onp.asarray(idx, "int64"), onp.asarray(ptr, "int64"),
+                     (n_valid, n_valid))
+    return _wrap_np(out_ids), sub, _wrap_np(layers)
+
+
+def dgl_adjacency(csr):
+    """CSR adjacency with all-ones data (reference: _contrib_dgl_adjacency)."""
+    from ..ndarray.sparse import CSRNDArray
+    import jax.numpy as jnp
+    if not isinstance(csr, CSRNDArray):
+        raise MXNetError("expects a CSRNDArray graph")
+    return CSRNDArray(jnp.ones_like(csr.data._data, jnp.float32),
+                      csr.indices, csr.indptr, csr.shape)
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False):
+    """Induced subgraphs for given vertex sets (reference:
+    _contrib_dgl_subgraph)."""
+    from ..ndarray.sparse import CSRNDArray
+    if not isinstance(graph, CSRNDArray):
+        raise MXNetError("expects a CSRNDArray graph")
+    indptr = onp.asarray(graph.indptr._data)
+    indices = onp.asarray(graph.indices._data)
+    outs = []
+    for vid in vids:
+        ids = onp.asarray(_raw(vid)).astype("int64").ravel()
+        ids = ids[ids >= 0]
+        pos = {int(v): i for i, v in enumerate(ids)}
+        data, idx, ptr = [], [], [0]
+        for v in ids:
+            nbrs = [pos[int(u)] for u in indices[indptr[v]:indptr[v + 1]]
+                    if int(u) in pos]
+            idx.extend(sorted(nbrs))
+            data.extend([1.0] * len(nbrs))
+            ptr.append(len(idx))
+        outs.append(CSRNDArray(onp.asarray(data, "float32"),
+                               onp.asarray(idx, "int64"),
+                               onp.asarray(ptr, "int64"),
+                               (len(ids), len(ids))))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _wrap_np(a):
+    import jax.numpy as jnp
+    return _wrap(jnp.asarray(a))

@@ -1,0 +1,81 @@
+"""contrib tail: FFT ops, DGL-style graph sampling, text embeddings
+(reference: src/operator/contrib/fft-inl.h, dgl_graph.cc,
+python/mxnet/contrib/text/)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray.sparse import csr_matrix
+
+
+def test_contrib_fft_roundtrip():
+    x = onp.random.RandomState(0).randn(3, 8).astype("float32")
+    out = nd.contrib.fft(mx.np.array(x))
+    assert out.shape == (3, 16)
+    spec = onp.fft.fft(x, axis=-1)
+    inter = onp.stack([spec.real, spec.imag], -1).reshape(3, 16)
+    onp.testing.assert_allclose(out.asnumpy(), inter, rtol=1e-4, atol=1e-4)
+    # unnormalized inverse (cuFFT convention): ifft(fft(x)) = d * x
+    back = nd.contrib.ifft(out)
+    onp.testing.assert_allclose(back.asnumpy(), 8 * x, rtol=1e-4, atol=1e-3)
+
+
+def test_contrib_dgl_sampling():
+    dense = onp.zeros((6, 6), "float32")
+    edges = [(0, 1), (0, 2), (1, 3), (2, 4), (3, 5), (4, 5), (1, 0),
+             (2, 0), (3, 1), (4, 2), (5, 3), (5, 4)]
+    for i, j in edges:
+        dense[i, j] = 1.0
+    g = csr_matrix(dense)
+    onp.random.seed(0)
+    verts, sub, layers = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, mx.np.array([0]), num_hops=2, num_neighbor=2,
+        max_num_vertices=6)
+    ids = verts.asnumpy()
+    n_valid = int(ids[-1])
+    assert n_valid >= 2 and ids[0] == 0
+    assert sub.shape == (n_valid, n_valid)
+    lay = layers.asnumpy()
+    assert lay[list(ids[:n_valid]).index(0)] == 0  # seed at hop 0
+
+    adj = nd.contrib.dgl_adjacency(g)
+    onp.testing.assert_array_equal(adj.tostype("default").asnumpy(),
+                                   (dense != 0).astype("float32"))
+
+    sub2 = nd.contrib.dgl_subgraph(g, mx.np.array([0, 1, 3]))
+    sd = sub2.tostype("default").asnumpy()
+    # edges inside {0,1,3} relabelled: 0->1, 1->3(->2), 1->0, 3->1
+    expect = onp.zeros((3, 3), "float32")
+    expect[0, 1] = expect[1, 2] = expect[1, 0] = expect[2, 1] = 1
+    onp.testing.assert_array_equal(sd, expect)
+
+
+def test_contrib_text_vocab_and_embedding(tmp_path):
+    from mxnet_tpu.contrib import text
+    counter = text.count_tokens_from_str("a b b c c c\nd d d d")
+    vocab = text.Vocabulary(counter, min_freq=2, unknown_token="<unk>",
+                            reserved_tokens=["<pad>"])
+    assert vocab.to_indices("<unk>") == 0
+    assert vocab.to_indices("d") == 2  # most frequent after reserved
+    assert vocab.to_tokens(1) == "<pad>"
+    assert vocab.to_indices(["zzz", "c"]) == [0, 3]
+
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world")
+    onp.testing.assert_allclose(v.asnumpy(), [0.4, 0.5, 0.6], rtol=1e-6)
+    unk = emb.get_vecs_by_tokens("missing")
+    onp.testing.assert_allclose(unk.asnumpy(), onp.zeros(3))
+    emb.update_token_vectors("hello", mx.np.array([[1.0, 1.0, 1.0]]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), onp.ones(3))
+
+
+def test_contrib_text_glove_missing_is_actionable():
+    from mxnet_tpu.contrib import text
+    with pytest.raises(MXNetError, match="provision"):
+        text.GloVe("glove.6B.50d.txt")
