@@ -78,6 +78,8 @@ def __getattr__(name):
     from .. import numpy_extension as _npx
     fn = getattr(_npx, name, None)
     if fn is not None:
+        if callable(fn) and not isinstance(fn, type):
+            return _register.with_out(fn)
         return fn
     lowered = name.lower()
     if lowered != name:

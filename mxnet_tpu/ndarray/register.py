@@ -18,6 +18,7 @@ literal-parses string attrs like ``kernel="(3, 3)"``.
 from __future__ import annotations
 
 import ast
+import functools
 
 from ..base import MXNetError
 
@@ -32,8 +33,29 @@ def register(name):
     return deco
 
 
+_OUT_WRAPPED: dict = {}
+
+
+def with_out(fn):
+    """Wrap an op so ``out=`` writes through to the destination array
+    (reference generated-wrapper semantics, ndarray/register.py:171)."""
+    w = _OUT_WRAPPED.get(fn)
+    if w is None:
+        @functools.wraps(fn)
+        def w(*args, **kwargs):
+            out = kwargs.pop("out", None)
+            res = fn(*args, **kwargs)
+            if out is None:
+                return res
+            from ..numpy.multiarray import _writeback
+            return _writeback(out, res)
+        _OUT_WRAPPED[fn] = w
+    return w
+
+
 def get(name):
-    return LEGACY_OPS.get(name)
+    fn = LEGACY_OPS.get(name)
+    return None if fn is None else with_out(fn)
 
 
 # -- legacy attr parsing -----------------------------------------------------

@@ -23,7 +23,7 @@ from .multiarray import (  # noqa: F401
     ndarray, array, zeros, ones, empty, full, arange, linspace, logspace, eye,
     identity, zeros_like, ones_like, full_like, empty_like, fromnumpy,
     from_dlpack, newaxis, pi, e, inf, nan, euler_gamma, _invoke, _wrap,
-    _wrap_out,
+    _wrap_out, _writeback, _wants_x64,
 )
 from . import random  # noqa: F401
 from . import linalg  # noqa: F401
@@ -52,10 +52,13 @@ def _make_op(fn, name):
     def op(*args, **kwargs):
         kwargs.pop("ctx", None)
         kwargs.pop("device", None)
-        kwargs.pop("out", None)
+        out = kwargs.pop("out", None)
+        x64 = False
         if "dtype" in kwargs:
+            x64 = _wants_x64(kwargs["dtype"])
             kwargs["dtype"] = np_dtype(kwargs["dtype"])
-        return _invoke(fn, args, kwargs, name=name)
+        res = _invoke(fn, args, kwargs, name=name, x64=x64)
+        return _writeback(out, res)
     op.__name__ = name
     return op
 
@@ -89,16 +92,16 @@ def __getattr__(name):
 # -- a few ops whose reference signature differs from jnp -------------------
 
 def concatenate(seq, axis=0, out=None):
-    return _invoke(lambda *xs: jnp.concatenate(xs, axis=axis), tuple(seq),
-                   name="concatenate")
+    return _writeback(out, _invoke(lambda *xs: jnp.concatenate(xs, axis=axis),
+                                   tuple(seq), name="concatenate"))
 
 
 concat = concatenate
 
 
 def stack(arrays, axis=0, out=None):
-    return _invoke(lambda *xs: jnp.stack(xs, axis=axis), tuple(arrays),
-                   name="stack")
+    return _writeback(out, _invoke(lambda *xs: jnp.stack(xs, axis=axis),
+                                   tuple(arrays), name="stack"))
 
 
 def vstack(arrays):

@@ -17,6 +17,7 @@ Imperative::RecordOp, src/imperative/imperative.cc:235).
 """
 from __future__ import annotations
 
+import contextlib
 import weakref
 
 import jax
@@ -45,6 +46,57 @@ def _is_inexact(x):
     return jnp.issubdtype(x.dtype, jnp.inexact)
 
 
+_64BIT = frozenset(("int64", "uint64", "float64", "complex128"))
+
+
+def _wants_x64(dt):
+    """True when a dtype spec names a 64-bit type that JAX's default
+    32-bit canonicalization would truncate (the reference builds with
+    MXNET_USE_INT64_TENSOR_SIZE; here 64-bit ops run in a scoped x64
+    mode, see util.int64_tensor_size)."""
+    if dt is None:
+        return False
+    try:
+        return onp.dtype(dt).name in _64BIT
+    except TypeError:
+        return False
+
+
+def _writeback(out, res):
+    """Write an op result through an ``out=`` destination array.
+
+    Reference: generated wrappers accept ``out`` and the engine writes the
+    result into its buffer (python/mxnet/ndarray/register.py:171). Here the
+    destination wrapper is rebound to the new buffer (cast to its dtype) so
+    aliases observe the update; the autograd entry moves with it so
+    recording through ``out=`` stays correct.
+    """
+    if out is None:
+        return res
+    if isinstance(out, (tuple, list)):
+        if not isinstance(res, (tuple, list)) or len(res) != len(out):
+            raise ValueError("out= arity does not match op outputs")
+        return type(out)(_writeback(o, r) for o, r in zip(out, res))
+    if not isinstance(out, ndarray):
+        raise TypeError(f"out= must be an mxnet ndarray, got {type(out)}")
+    if not isinstance(res, ndarray):
+        raise TypeError("op returned a non-array; cannot write through out=")
+    if tuple(out.shape) != tuple(res.shape):
+        raise ValueError(
+            f"out= shape mismatch: destination {out.shape} vs result {res.shape}")
+    if isinstance(res._data, jax.core.Tracer) and \
+            not isinstance(out._data, jax.core.Tracer):
+        # a hybridized trace must not leak a tracer into a persistent
+        # eager array (it would be corrupted forever)
+        raise MXNetError(
+            "out= cannot write a traced (hybridized) result into an array "
+            "created outside the trace; allocate the destination inside "
+            "the hybrid forward or drop out=")
+    out._rebind(res._data.astype(out.dtype))
+    out._entry = res._entry
+    return out
+
+
 def _wrap(raw, ctx=None):
     """Wrap a raw jax array into an ndarray without copying."""
     out = ndarray.__new__(ndarray)
@@ -66,7 +118,7 @@ def _wrap_out(out):
     return out
 
 
-def _invoke(prim, args, kwargs=None, name=None):
+def _invoke(prim, args, kwargs=None, name=None, x64=False):
     """Dispatch one op: the eager hot path.
 
     Reference analog: FFI glue -> Imperative::Invoke -> Engine::PushAsync
@@ -80,11 +132,16 @@ def _invoke(prim, args, kwargs=None, name=None):
     if _profiler._state["running"] and _profiler._config["profile_imperative"]:
         with _profiler.span(name or getattr(prim, "__name__", "op"),
                             "operator"):
-            return _invoke_impl(prim, args, kwargs, name)
-    return _invoke_impl(prim, args, kwargs, name)
+            return _invoke_impl(prim, args, kwargs, name, x64)
+    return _invoke_impl(prim, args, kwargs, name, x64)
 
 
-def _invoke_impl(prim, args, kwargs=None, name=None):
+def _leaf_is_64bit(x):
+    dt = getattr(x, "dtype", None)
+    return dt is not None and getattr(dt, "name", "") in _64BIT
+
+
+def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
     kwargs = kwargs or {}
     from .. import amp as _amp
     amp_dt = _amp._op_cast_dtype(name or getattr(prim, "__name__", ""))
@@ -92,9 +149,15 @@ def _invoke_impl(prim, args, kwargs=None, name=None):
         (args, kwargs), is_leaf=lambda x: isinstance(x, ndarray))
     # differentiable inputs: inexact-dtype ndarrays; others are unwrapped
     # in place (bool masks / int indices stay concrete for eager indexing).
+    # 64-bit dtype on an mx array input or an explicit dtype request ->
+    # scoped x64 so JAX does not truncate (raw host-numpy operands do NOT
+    # trigger it: numpy's default float64/int64 would otherwise drag every
+    # mixed op into x64; they keep the 32-bit canonicalization).
+    use_x64 = x64 or _wants_x64(kwargs.get("dtype"))
     arr_pos, diff_arrays = [], []
     for i, leaf in enumerate(leaves):
         if isinstance(leaf, ndarray):
+            use_x64 = use_x64 or _leaf_is_64bit(leaf)
             if _is_inexact(leaf):
                 arr_pos.append(i)
                 diff_arrays.append(leaf)
@@ -118,15 +181,23 @@ def _invoke_impl(prim, args, kwargs=None, name=None):
     raws = [a._data for a in diff_arrays]
     recording = (autograd.is_recording()
                  and any(a._entry is not None for a in diff_arrays))
-    if recording:
-        try:
-            out, vjp_fn = jax.vjp(fn, *raws)
-        except (TypeError, jax.errors.TracerError,
-                jax.errors.ConcretizationTypeError):
-            recording = False
+    x64_scope = jax.enable_x64(True) if use_x64 else contextlib.nullcontext()
+    with x64_scope:
+        if recording:
+            try:
+                out, vjp_fn = jax.vjp(fn, *raws)
+            except (TypeError, jax.errors.TracerError,
+                    jax.errors.ConcretizationTypeError):
+                recording = False
+                out = fn(*raws)
+        else:
             out = fn(*raws)
-    else:
-        out = fn(*raws)
+    if recording and use_x64:
+        _inner_vjp = vjp_fn
+
+        def vjp_fn(ct, _inner=_inner_vjp):
+            with jax.enable_x64(True):
+                return _inner(ct)
 
     wrapped = _wrap_out(out)
     if recording:
@@ -238,7 +309,8 @@ class ndarray:
         dt = np_dtype(dtype)
         if not copy and self._data.dtype == dt:
             return self
-        return _invoke(lambda x: x.astype(dt), (self,), name="astype")
+        return _invoke(lambda x: x.astype(dt), (self,), name="astype",
+                       x64=_wants_x64(dt))
 
     def copy(self):
         return _invoke(jnp.copy, (self,))
@@ -346,7 +418,8 @@ class ndarray:
     # -- indexing ----------------------------------------------------------
     def __getitem__(self, key):
         key = _unwrap_key(key)
-        return _invoke(lambda x: x[key], (self,), name="getitem")
+        return _invoke(lambda x: x[key], (self,), name="getitem",
+                       x64=_key_is_64bit(key))
 
     def __setitem__(self, key, value):
         if isinstance(value, ndarray):
@@ -524,7 +597,7 @@ class ndarray:
 
     def _reduce(self, fn, axis=None, keepdims=False, **kw):
         return _invoke(lambda x: fn(x, axis=axis, keepdims=keepdims, **kw), (self,),
-                       name=fn.__name__)
+                       name=fn.__name__, x64=_wants_x64(kw.get("dtype")))
 
     def sum(self, axis=None, dtype=None, keepdims=False):
         return self._reduce(jnp.sum, axis, keepdims, dtype=np_dtype(dtype))
@@ -566,7 +639,8 @@ class ndarray:
         return _invoke(lambda x: jnp.sort(x, axis), (self,))
 
     def cumsum(self, axis=None, dtype=None):
-        return _invoke(lambda x: jnp.cumsum(x, axis, dtype=np_dtype(dtype)), (self,))
+        return _invoke(lambda x: jnp.cumsum(x, axis, dtype=np_dtype(dtype)),
+                       (self,), x64=_wants_x64(dtype))
 
     def dot(self, other):
         return self._binop(other, jnp.dot)
@@ -609,6 +683,12 @@ def _unwrap_key(key):
     return key
 
 
+def _key_is_64bit(key):
+    if isinstance(key, tuple):
+        return any(_key_is_64bit(k) for k in key)
+    return _leaf_is_64bit(key)
+
+
 # ---------------------------------------------------------------------------
 # creation functions (reference: numpy/multiarray.py zeros/ones/... wrappers)
 # ---------------------------------------------------------------------------
@@ -620,10 +700,21 @@ def _place(raw, ctx, device):
     return _wrap(raw)
 
 
+def _x64_scope(dt):
+    """Scoped x64 mode when a 64-bit dtype is explicitly requested."""
+    return jax.enable_x64(True) if _wants_x64(dt) else contextlib.nullcontext()
+
+
 def array(obj, dtype=None, ctx=None, device=None):
     if isinstance(obj, ndarray):
         obj = obj._data
-    raw = jnp.asarray(obj, dtype=np_dtype(dtype))
+    if dtype is None and isinstance(obj, onp.ndarray) and \
+            onp.dtype(obj.dtype).name in ("int64", "uint64"):
+        # preserve host-numpy 64-bit integer dtypes (index arrays); floats
+        # keep the 32-bit TPU-native default unless explicitly requested
+        dtype = obj.dtype
+    with _x64_scope(dtype):
+        raw = jnp.asarray(obj, dtype=np_dtype(dtype))
     return _place(raw, ctx, device)
 
 
@@ -640,29 +731,38 @@ def empty(shape, dtype=None, ctx=None, device=None, order="C"):
 
 
 def zeros(shape, dtype=None, ctx=None, device=None, order="C"):
-    return _place(jnp.zeros(shape, np_dtype(dtype) or jnp.float32), ctx, device)
+    with _x64_scope(dtype):
+        raw = jnp.zeros(shape, np_dtype(dtype) or jnp.float32)
+    return _place(raw, ctx, device)
 
 
 def ones(shape, dtype=None, ctx=None, device=None, order="C"):
-    return _place(jnp.ones(shape, np_dtype(dtype) or jnp.float32), ctx, device)
+    with _x64_scope(dtype):
+        raw = jnp.ones(shape, np_dtype(dtype) or jnp.float32)
+    return _place(raw, ctx, device)
 
 
 def full(shape, fill_value, dtype=None, ctx=None, device=None, order="C"):
     if isinstance(fill_value, ndarray):
         fill_value = fill_value._data
-    return _place(jnp.full(shape, fill_value, np_dtype(dtype)), ctx, device)
+    with _x64_scope(dtype):
+        raw = jnp.full(shape, fill_value, np_dtype(dtype))
+    return _place(raw, ctx, device)
 
 
 def zeros_like(a, dtype=None, ctx=None, device=None):
-    return _invoke(lambda x: jnp.zeros_like(x, np_dtype(dtype)), (a,))
+    return _invoke(lambda x: jnp.zeros_like(x, np_dtype(dtype)), (a,),
+                   x64=_wants_x64(dtype))
 
 
 def ones_like(a, dtype=None, ctx=None, device=None):
-    return _invoke(lambda x: jnp.ones_like(x, np_dtype(dtype)), (a,))
+    return _invoke(lambda x: jnp.ones_like(x, np_dtype(dtype)), (a,),
+                   x64=_wants_x64(dtype))
 
 
 def full_like(a, fill_value, dtype=None, ctx=None, device=None):
-    return _invoke(lambda x: jnp.full_like(x, fill_value, np_dtype(dtype)), (a,))
+    return _invoke(lambda x: jnp.full_like(x, fill_value, np_dtype(dtype)),
+                   (a,), x64=_wants_x64(dtype))
 
 
 def empty_like(a, dtype=None, ctx=None, device=None):
@@ -670,12 +770,16 @@ def empty_like(a, dtype=None, ctx=None, device=None):
 
 
 def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
-    return _place(jnp.arange(start, stop, step, np_dtype(dtype)), ctx, device)
+    with _x64_scope(dtype):
+        raw = jnp.arange(start, stop, step, np_dtype(dtype))
+    return _place(raw, ctx, device)
 
 
 def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
              axis=0, ctx=None, device=None):
-    out = jnp.linspace(start, stop, num, endpoint, retstep, np_dtype(dtype), axis)
+    with _x64_scope(dtype):
+        out = jnp.linspace(start, stop, num, endpoint, retstep,
+                           np_dtype(dtype), axis)
     if retstep:
         return _place(out[0], ctx, device), out[1]
     return _place(out, ctx, device)
@@ -683,12 +787,16 @@ def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
 
 def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
              axis=0, ctx=None, device=None):
-    return _place(jnp.logspace(start, stop, num, endpoint, base,
-                               np_dtype(dtype), axis), ctx, device)
+    with _x64_scope(dtype):
+        raw = jnp.logspace(start, stop, num, endpoint, base,
+                           np_dtype(dtype), axis)
+    return _place(raw, ctx, device)
 
 
 def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
-    return _place(jnp.eye(N, M, k, np_dtype(dtype) or jnp.float32), ctx, device)
+    with _x64_scope(dtype):
+        raw = jnp.eye(N, M, k, np_dtype(dtype) or jnp.float32)
+    return _place(raw, ctx, device)
 
 
 def identity(n, dtype=None, ctx=None, device=None):

@@ -221,3 +221,56 @@ def test_rng_key_survives_external_jit():
     assert not onp.array_equal(fb, eager_key)
     mx.random.seed(0)
     assert r._fallback_n == 0
+
+
+def test_out_writes_through():
+    """mx.np.op(..., out=c) must write the result into c's buffer —
+    reference generated-wrapper semantics (ndarray/register.py:171).
+    Round-3 verdict: silent drop is the worst option."""
+    a = np.ones((3,))
+    b = np.full((3,), 2.0)
+    c = np.zeros((3,))
+    alias = c
+    r = np.add(a, b, out=c)
+    assert r is c
+    onp.testing.assert_allclose(alias.asnumpy(), 3.0)  # alias observes it
+    assert c.version == 1
+
+    # dtype cast on write-through: result cast to the destination dtype
+    d = np.zeros((3,), dtype="int32")
+    np.multiply(a, b, out=d)
+    assert d.dtype == onp.int32
+    onp.testing.assert_allclose(d.asnumpy(), 2)
+
+    # shape mismatch raises (not silent)
+    with pytest.raises(ValueError):
+        np.add(a, b, out=np.zeros((4,)))
+    # non-array destination raises
+    with pytest.raises(TypeError):
+        np.add(a, b, out=onp.zeros(3))
+
+
+def test_out_on_explicit_and_legacy_ops():
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    dest = np.zeros((4, 3))
+    r = np.concatenate([a, a], axis=0, out=dest)
+    assert r is dest
+    onp.testing.assert_allclose(dest.asnumpy(), onp.concatenate(
+        [onp.arange(6).reshape(2, 3)] * 2, axis=0))
+
+    d = mx.nd.zeros((2, 3))
+    mx.nd.broadcast_add(a, np.ones((1, 3)), out=d)
+    onp.testing.assert_allclose(
+        d.asnumpy(), onp.arange(6).reshape(2, 3) + 1)
+
+
+def test_out_under_autograd():
+    """Gradients flow through an out= destination like any op output."""
+    x = np.ones((3,))
+    x.attach_grad()
+    dest = np.zeros((3,))
+    with mx.autograd.record():
+        y = np.multiply(x, np.full((3,), 4.0), out=dest)
+        z = (y * y).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * 4.0 * 4.0 * 1.0)
