@@ -277,3 +277,35 @@ class PoissonNLLLoss(Loss):
                                     _np.zeros_like(target))
         loss = _apply_weighting(loss, self._weight, sample_weight)
         return loss.mean()
+
+
+class SDMLLoss(Loss):
+    """Batchwise Smoothed Deep Metric Learning loss (Bonadiman 2019,
+    arXiv:1905.12786; reference: gluon/loss.py:902).
+
+    Aligned minibatches x1/x2: (x1[i], x2[i]) are positive pairs, all
+    cross-row pairs act as in-batch negatives; KL between the softmax of
+    negative pairwise euclidean distances and a smoothed identity matrix.
+    """
+
+    def __init__(self, smoothing_parameter=0.3, weight=1., batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def forward(self, x1, x2):
+        batch_size = x1.shape[0]
+        if batch_size < 2:
+            raise ValueError(
+                "SDMLLoss needs batch_size >= 2 (in-batch negatives); "
+                f"got {batch_size}")
+        # pairwise squared-euclidean distance matrix (B, B)
+        diffs = _np.expand_dims(x1, 1) - _np.expand_dims(x2, 0)
+        distances = (diffs ** 2).sum(axis=2)
+        # smoothed identity labels (Pereyra 2017 label smoothing)
+        gold = _np.eye(batch_size)
+        labels = gold * (1 - self.smoothing_parameter) + \
+            (1 - gold) * self.smoothing_parameter / (batch_size - 1)
+        log_probabilities = npx.log_softmax(-distances, axis=1)
+        return self.kl_loss(log_probabilities, labels)

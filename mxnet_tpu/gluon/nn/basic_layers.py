@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import numpy as onp
 
+from ... import numpy as _np
 from ... import numpy_extension as npx
 from ...base import MXNetError
 from ..block import Block, HybridBlock
@@ -208,6 +209,41 @@ class SyncBatchNorm(BatchNorm):
                          use_global_stats, beta_initializer, gamma_initializer,
                          running_mean_initializer,
                          running_variance_initializer, in_channels)
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm + ReLU (reference: basic_layers.py:478 BatchNormReLU
+    over the batch_norm op's act_type='relu' attr). Here the relu tail is
+    applied after npx.batch_norm — XLA fuses it into the single-pass BN
+    scale/shift FMA, so it is one kernel on TPU like the cuDNN fused op."""
+
+    def forward(self, x):
+        return npx.relu(super().forward(x))
+
+
+class Concatenate(Sequential):
+    """Run children on the SAME input, concat outputs along ``axis``
+    (reference: basic_layers.py:1002)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return _np.concatenate([block(x) for block in self._children.values()],
+                               axis=self._axis)
+
+
+class HybridConcatenate(HybridSequential):
+    """Traceable Concatenate (reference: basic_layers.py:1034)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return _np.concatenate([block(x) for block in self._children.values()],
+                               axis=self._axis)
 
 
 class LayerNorm(HybridBlock):

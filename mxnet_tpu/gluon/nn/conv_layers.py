@@ -278,3 +278,172 @@ class ReflectionPad2D(HybridBlock):
         pad_width = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])) \
             if len(p) == 4 else ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
         return _np.pad(x, pad_width=pad_width, mode="reflect")
+
+
+class DeformableConvolution(HybridBlock):
+    """2-D deformable convolution v1 (Dai 2017).
+
+    Reference: gluon/nn/conv_layers.py:1277 over
+    src/operator/contrib/deformable_convolution.cc. The offset-generating
+    convolution and the deformable convolution are both in this layer; see
+    ops/deformable.py for the TPU-native sampling kernel.
+    """
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros", offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 modulated=False):
+        super().__init__()
+        if layout != "NCHW":
+            raise ValueError("DeformableConvolution supports NCHW only")
+        kernel_size = _pair(kernel_size, 2)
+        self._channels = channels
+        self._kernel = kernel_size
+        self._strides = _pair(strides, 2)
+        self._padding = _pair(padding, 2)
+        self._dilation = _pair(dilation, 2)
+        self._groups = groups
+        self._ndg = num_deformable_group
+        self._modulated = modulated
+        K = kernel_size[0] * kernel_size[1]
+        self._offset_split = 2 * K * num_deformable_group
+        offset_channels = (3 if modulated else 2) * K * num_deformable_group
+        self._offset_channels = offset_channels
+        self.offset_weight = Parameter(
+            "offset_weight",
+            shape=(offset_channels, in_channels // groups if in_channels
+                   else 0) + kernel_size,
+            init=offset_weight_initializer, allow_deferred_init=True)
+        self.offset_bias = (Parameter("offset_bias", shape=(offset_channels,),
+                                      init=offset_bias_initializer,
+                                      allow_deferred_init=True)
+                            if offset_use_bias else None)
+        self.deformable_conv_weight = Parameter(
+            "deformable_conv_weight",
+            shape=(channels, in_channels // groups if in_channels else 0)
+            + kernel_size,
+            init=weight_initializer, allow_deferred_init=True)
+        self.deformable_conv_bias = (
+            Parameter("deformable_conv_bias", shape=(channels,),
+                      init=bias_initializer, allow_deferred_init=True)
+            if use_bias else None)
+        self.act = Activation(activation) if activation else None
+
+    def forward(self, x):
+        in_ch = x.shape[1]
+        for p, ch in ((self.offset_weight, self._offset_channels),
+                      (self.deformable_conv_weight, self._channels)):
+            if not p._shape_known():
+                p._finish_deferred_init(
+                    (ch, in_ch // self._groups) + self._kernel)
+        for p in (self.offset_bias, self.deformable_conv_bias):
+            if p is not None and p._data is None:
+                p._finish_deferred_init()
+        conv_kw = dict(kernel=self._kernel, stride=self._strides,
+                       pad=self._padding, dilate=self._dilation,
+                       num_group=self._groups)
+        off = npx.convolution(
+            x, self.offset_weight.data(),
+            self.offset_bias.data() if self.offset_bias is not None else None,
+            num_filter=self._offset_channels,
+            no_bias=self.offset_bias is None, layout="NCHW", **conv_kw)
+        b = (self.deformable_conv_bias.data()
+             if self.deformable_conv_bias is not None else None)
+        if self._modulated:
+            offset_t = off[:, :self._offset_split]
+            mask = npx.sigmoid(off[:, self._offset_split:]) * 2
+            out = npx.modulated_deformable_convolution(
+                x, offset_t, mask, self.deformable_conv_weight.data(), b,
+                num_filter=self._channels, no_bias=b is None,
+                num_deformable_group=self._ndg, **conv_kw)
+        else:
+            out = npx.deformable_convolution(
+                x, off, self.deformable_conv_weight.data(), b,
+                num_filter=self._channels, no_bias=b is None,
+                num_deformable_group=self._ndg, **conv_kw)
+        return self.act(out) if self.act is not None else out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, stride={self._strides}, "
+                f"num_deformable_group={self._ndg})")
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """DCN v2 (reference: conv_layers.py:1501): a learned sigmoid mask
+    modulates every sampled value; the offset conv emits 3*K*ndg channels
+    (2K offsets + K mask)."""
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros", offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, num_deformable_group, layout, use_bias,
+                         in_channels, activation, weight_initializer,
+                         bias_initializer, offset_weight_initializer,
+                         offset_bias_initializer, offset_use_bias,
+                         modulated=True)
+
+
+class PixelShuffle1D(HybridBlock):
+    """(N, C*f, W) -> (N, C, W*f) (reference: conv_layers.py:1707)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def forward(self, x):
+        f = self._factor
+        n, cf, w = x.shape
+        x = x.reshape(n, cf // f, f, w)
+        x = x.transpose(0, 1, 3, 2)          # (N, C, W, f)
+        return x.reshape(n, cf // f, w * f)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factor})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) (reference:
+    conv_layers.py:1755)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factors = _pair(factor, 2)
+
+    def forward(self, x):
+        f1, f2 = self._factors
+        n, c, h, w = x.shape
+        co = c // (f1 * f2)
+        x = x.reshape(n, co, f1, f2, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)    # (N, C, H, f1, W, f2)
+        return x.reshape(n, co, h * f1, w * f2)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3) (reference:
+    conv_layers.py:1818)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factors = _pair(factor, 3)
+
+    def forward(self, x):
+        f1, f2, f3 = self._factors
+        n, c, d, h, w = x.shape
+        co = c // (f1 * f2 * f3)
+        x = x.reshape(n, co, f1, f2, f3, d, h, w)
+        x = x.transpose(0, 1, 5, 2, 6, 3, 7, 4)  # (N,C,D,f1,H,f2,W,f3)
+        return x.reshape(n, co, d * f1, h * f2, w * f3)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"

@@ -1,6 +1,12 @@
 """gluon.rnn (reference: python/mxnet/gluon/rnn/__init__.py)."""
 from .rnn_cell import (  # noqa: F401
     RecurrentCell, RNNCell, LSTMCell, GRUCell, SequentialRNNCell, DropoutCell,
-    ResidualCell, ZoneoutCell, BidirectionalCell,
+    ModifierCell, ResidualCell, ZoneoutCell, BidirectionalCell,
+    VariationalDropoutCell, LSTMPCell,
+)
+from .conv_rnn_cell import (  # noqa: F401
+    Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+    Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+    Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell,
 )
 from .rnn_layer import RNN, LSTM, GRU  # noqa: F401

@@ -1,0 +1,180 @@
+"""Convolutional recurrent cells (ConvRNN / ConvLSTM / ConvGRU, 1D/2D/3D).
+
+Reference parity: python/mxnet/gluon/rnn/conv_rnn_cell.py (the 9-class
+Conv{1,2,3}D{RNN,LSTM,GRU}Cell family over src/operator/nn/convolution.cc).
+
+TPU-native: the i2h/h2h convolutions lower to lax.conv_general_dilated
+(MXU-tiled); gate math is the same jnp elementwise tail as the dense cells,
+fused by XLA. h2h convs are constrained to odd kernels with SAME padding so
+the state feature map keeps its spatial shape, exactly like the reference.
+"""
+from __future__ import annotations
+
+from ... import numpy as _np
+from ... import numpy_extension as npx
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _conv_out_size(dims, kernel, pad, dilate):
+    return tuple((d + 2 * p - (dl * (k - 1) + 1)) + 1
+                 for d, k, p, dl in zip(dims, kernel, pad, dilate))
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared conv-gate machinery (reference: conv_rnn_cell.py:41)."""
+
+    _gate_names: tuple = ("",)
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, conv_layout, activation):
+        super().__init__()
+        if conv_layout not in ("NCW", "NCHW", "NCDHW"):
+            raise ValueError(f"unsupported conv_layout {conv_layout!r} "
+                             "(channel-first only)")
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)     # (C, *spatial)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        self._dims = dims
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        if any(k % 2 == 0 for k in self._h2h_kernel):
+            raise ValueError(f"h2h_kernel must be odd, got {h2h_kernel}")
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        in_channels = self._input_shape[0]
+        self._state_shape = (hidden_channels,) + _conv_out_size(
+            self._input_shape[1:], self._i2h_kernel, self._i2h_pad,
+            self._i2h_dilate)
+        ng = len(self._gate_names)
+        total = ng * hidden_channels
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(total, in_channels) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(total, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(total,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(total,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}
+                for _ in range(self._n_states)]
+
+    _n_states = 1
+
+    def _ensure(self):
+        for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                  self.h2h_bias):
+            if p._data is None:
+                p._finish_deferred_init()
+
+    def _conv_forward(self, x, h):
+        ng = len(self._gate_names)
+        nf = ng * self._hidden_channels
+        i2h = npx.convolution(x, self.i2h_weight.data(), self.i2h_bias.data(),
+                              kernel=self._i2h_kernel, pad=self._i2h_pad,
+                              dilate=self._i2h_dilate, num_filter=nf,
+                              layout=self._conv_layout)
+        h2h = npx.convolution(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                              kernel=self._h2h_kernel, pad=self._h2h_pad,
+                              dilate=self._h2h_dilate, num_filter=nf,
+                              layout=self._conv_layout)
+        return i2h, h2h
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_shape[0]} -> "
+                f"{self._hidden_channels}, i2h_kernel={self._i2h_kernel})")
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _gate_names = ("",)
+
+    def forward(self, x, states):
+        self._ensure()
+        i2h, h2h = self._conv_forward(x, states[0])
+        out = npx.activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _gate_names = ("_i", "_f", "_c", "_o")
+    _n_states = 2
+
+    def forward(self, x, states):
+        self._ensure()
+        h, c = states
+        i2h, h2h = self._conv_forward(x, h)
+        gates = i2h + h2h
+        i, f, g, o = _np.split(gates, 4, axis=1)
+        i, f, o = npx.sigmoid(i), npx.sigmoid(f), npx.sigmoid(o)
+        g = npx.activation(g, act_type=self._activation)
+        c_new = f * c + i * g
+        h_new = o * npx.activation(c_new, act_type=self._activation)
+        return h_new, [h_new, c_new]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _gate_names = ("_r", "_z", "_o")
+
+    def forward(self, x, states):
+        self._ensure()
+        h = states[0]
+        i2h, h2h = self._conv_forward(x, h)
+        i2h_r, i2h_z, i2h_n = _np.split(i2h, 3, axis=1)
+        h2h_r, h2h_z, h2h_n = _np.split(h2h, 3, axis=1)
+        r = npx.sigmoid(i2h_r + h2h_r)
+        z = npx.sigmoid(i2h_z + h2h_z)
+        n = npx.activation(i2h_n + r * h2h_n, act_type=self._activation)
+        h_new = (1 - z) * n + z * h
+        return h_new, [h_new]
+
+
+def _make_cell(base, name, dims, layout, doc):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout=layout, activation="tanh"):
+        base.__init__(self, input_shape, hidden_channels, i2h_kernel,
+                      h2h_kernel, i2h_pad, i2h_dilate, h2h_dilate,
+                      i2h_weight_initializer, h2h_weight_initializer,
+                      i2h_bias_initializer, h2h_bias_initializer, dims,
+                      conv_layout, activation)
+    cls = type(name, (base,), {"__init__": __init__, "__doc__": doc})
+    return cls
+
+
+Conv1DRNNCell = _make_cell(_ConvRNNCell, "Conv1DRNNCell", 1, "NCW",
+                           "1D conv RNN cell (reference: conv_rnn_cell.py:222).")
+Conv2DRNNCell = _make_cell(_ConvRNNCell, "Conv2DRNNCell", 2, "NCHW",
+                           "2D conv RNN cell (reference: conv_rnn_cell.py:283).")
+Conv3DRNNCell = _make_cell(_ConvRNNCell, "Conv3DRNNCell", 3, "NCDHW",
+                           "3D conv RNN cell (reference: conv_rnn_cell.py:344).")
+Conv1DLSTMCell = _make_cell(_ConvLSTMCell, "Conv1DLSTMCell", 1, "NCW",
+                            "1D ConvLSTM (Shi 2015; reference: conv_rnn_cell.py:452).")
+Conv2DLSTMCell = _make_cell(_ConvLSTMCell, "Conv2DLSTMCell", 2, "NCHW",
+                            "2D ConvLSTM (Shi 2015; reference: conv_rnn_cell.py:523).")
+Conv3DLSTMCell = _make_cell(_ConvLSTMCell, "Conv3DLSTMCell", 3, "NCDHW",
+                            "3D ConvLSTM (Shi 2015; reference: conv_rnn_cell.py:594).")
+Conv1DGRUCell = _make_cell(_ConvGRUCell, "Conv1DGRUCell", 1, "NCW",
+                           "1D conv GRU cell (reference: conv_rnn_cell.py:714).")
+Conv2DGRUCell = _make_cell(_ConvGRUCell, "Conv2DGRUCell", 2, "NCHW",
+                           "2D conv GRU cell (reference: conv_rnn_cell.py:780).")
+Conv3DGRUCell = _make_cell(_ConvGRUCell, "Conv3DGRUCell", 3, "NCDHW",
+                           "3D conv GRU cell (reference: conv_rnn_cell.py:846).")

@@ -185,39 +185,167 @@ class DropoutCell(RecurrentCell):
         return npx.dropout(x, p=self._rate, axes=self._axes), states
 
 
-class ResidualCell(RecurrentCell):
+class ModifierCell(RecurrentCell):
+    """Base for cells that wrap another cell (reference: rnn_cell.py:893).
+    The wrapped cell's parameters belong to the wrapper's scope."""
+
     def __init__(self, base_cell):
         super().__init__()
+        base_cell._modified = True
         self.base_cell = base_cell
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
+    def begin_state(self, batch_size=0, func=_np.zeros, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def reset(self):
+        self.base_cell.reset()
+
+
+class ResidualCell(ModifierCell):
     def forward(self, x, states):
         out, states = self.base_cell(x, states)
         return out + x, states
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
+    """Zoneout (Krueger 2016): stochastically keep the previous output /
+    states instead of the new ones (reference: rnn_cell.py:935)."""
+
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
-        super().__init__()
-        self.base_cell = base_cell
+        super().__init__(base_cell)
         self._zo, self._zs = zoneout_outputs, zoneout_states
         self._prev = None
 
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
+    def reset(self):
+        super().reset()
+        self._prev = None
 
     def forward(self, x, states):
         out, new_states = self.base_cell(x, states)
         from ... import autograd
         if autograd.is_training():
+            # dropout(ones, p) is 0 with prob p else 1/(1-p); rescaling by
+            # (1-p) recovers the reference's {0,1} bernoulli keep-mask
+            def keep_mask(p, like):
+                return npx.dropout(_np.ones_like(like), p=p) * (1 - p)
             if self._zo > 0:
-                mask = npx.dropout(_np.ones_like(out), p=self._zo) * (1 - self._zo)
-                out = mask * out + (1 - mask) * (
-                    self._prev if self._prev is not None else _np.zeros_like(out))
+                mask = keep_mask(self._zo, out)
+                prev = self._prev if self._prev is not None \
+                    else _np.zeros_like(out)
+                out = mask * out + (1 - mask) * prev
+            if self._zs > 0:
+                masks = [keep_mask(self._zs, ns) for ns in new_states]
+                new_states = [m * ns + (1 - m) * os for m, ns, os in
+                              zip(masks, new_states, states)]
             self._prev = out
         return out, new_states
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational dropout (Gal & Ghahramani 2015): ONE dropout mask per
+    sequence, shared across time steps, separately for inputs / states /
+    outputs (reference: rnn_cell.py:1110).
+
+    The masks are drawn at the first step and cached until ``reset()`` —
+    step the cell manually => call reset() between sequences, exactly like
+    the reference.
+    """
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def reset(self):
+        super().reset()
+        self.drop_inputs_mask = None
+        self.drop_states_mask = None
+        self.drop_outputs_mask = None
+
+    def forward(self, x, states):
+        if self.drop_states and self.drop_states_mask is None:
+            self.drop_states_mask = npx.dropout(
+                _np.ones_like(states[0]), p=self.drop_states)
+        if self.drop_inputs and self.drop_inputs_mask is None:
+            self.drop_inputs_mask = npx.dropout(
+                _np.ones_like(x), p=self.drop_inputs)
+        if self.drop_states:
+            states = [states[0] * self.drop_states_mask] + list(states[1:])
+        if self.drop_inputs:
+            x = x * self.drop_inputs_mask
+        out, states = self.base_cell(x, states)
+        if self.drop_outputs:
+            if self.drop_outputs_mask is None:
+                self.drop_outputs_mask = npx.dropout(
+                    _np.ones_like(out), p=self.drop_outputs)
+            out = out * self.drop_outputs_mask
+        return out, states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a recurrent projection layer r_t = W_hr h_t
+    (Sak 2014, https://arxiv.org/abs/1402.1128; reference:
+    rnn_cell.py:1284). States are [r (projected), c (cell)]."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, projection_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2r_weight = Parameter("h2r_weight",
+                                    shape=(projection_size, hidden_size),
+                                    init=h2r_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight._finish_deferred_init(
+                (4 * self._hidden_size, x.shape[-1]))
+        for p in (self.h2h_weight, self.h2r_weight, self.i2h_bias,
+                  self.h2h_bias):
+            if p._data is None:
+                p._finish_deferred_init()
+        r, c = states
+        gates = _np.dot(x, self.i2h_weight.data().T) + self.i2h_bias.data() + \
+            _np.dot(r, self.h2h_weight.data().T) + self.h2h_bias.data()
+        i, f, g, o = _np.split(gates, 4, axis=-1)
+        i, f, o = npx.sigmoid(i), npx.sigmoid(f), npx.sigmoid(o)
+        c_new = f * c + i * _np.tanh(g)
+        h_new = o * _np.tanh(c_new)
+        r_new = _np.dot(h_new, self.h2r_weight.data().T)
+        return r_new, [r_new, c_new]
 
 
 class BidirectionalCell(RecurrentCell):

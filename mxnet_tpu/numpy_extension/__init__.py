@@ -293,6 +293,62 @@ def deconvolution(data=None, weight=None, bias=None, kernel=None, stride=None,
     return _invoke(fn, (data, weight, bias), name="deconvolution")
 
 
+def deformable_convolution(data=None, offset=None, weight=None, bias=None,
+                           kernel=None, stride=None, dilate=None, pad=None,
+                           num_filter=1, num_group=1, num_deformable_group=1,
+                           workspace=1024, no_bias=False, layout=None,
+                           **kwargs):
+    """DCN v1 (reference: src/operator/contrib/deformable_convolution.cc).
+
+    Bilinear grid-sampling gathers + one MXU einsum; see ops/deformable.py.
+    NCHW only (the reference CUDA kernel is also NCHW-only).
+    """
+    from ..ops.deformable import deformable_conv2d
+    if layout not in (None, "NCHW"):
+        raise MXNetError("deformable_convolution supports NCHW only")
+    kernel = tuple(kernel)
+    kw = dict(kernel=kernel, stride=tuple(stride) if stride else (1, 1),
+              pad=tuple(pad) if pad else (0, 0),
+              dilate=tuple(dilate) if dilate else (1, 1),
+              num_group=num_group,
+              num_deformable_group=num_deformable_group)
+    if bias is None or no_bias:
+        return _invoke(lambda x, o, w: deformable_conv2d(x, o, w, **kw),
+                       (data, offset, weight), name="deformable_convolution")
+    return _invoke(lambda x, o, w, b: deformable_conv2d(x, o, w, b, **kw),
+                   (data, offset, weight, bias),
+                   name="deformable_convolution")
+
+
+def modulated_deformable_convolution(data=None, offset=None, mask=None,
+                                     weight=None, bias=None, kernel=None,
+                                     stride=None, dilate=None, pad=None,
+                                     num_filter=1, num_group=1,
+                                     num_deformable_group=1, workspace=1024,
+                                     no_bias=False, layout=None, **kwargs):
+    """DCN v2 (reference: src/operator/contrib/modulated_deformable_convolution.cc).
+    The mask input multiplies each sampled value (caller applies sigmoid*2,
+    matching the reference Gluon block)."""
+    from ..ops.deformable import deformable_conv2d
+    if layout not in (None, "NCHW"):
+        raise MXNetError("modulated_deformable_convolution supports NCHW only")
+    kw = dict(kernel=tuple(kernel),
+              stride=tuple(stride) if stride else (1, 1),
+              pad=tuple(pad) if pad else (0, 0),
+              dilate=tuple(dilate) if dilate else (1, 1),
+              num_group=num_group,
+              num_deformable_group=num_deformable_group)
+    if bias is None or no_bias:
+        return _invoke(
+            lambda x, o, m, w: deformable_conv2d(x, o, w, mask=m, **kw),
+            (data, offset, mask, weight),
+            name="modulated_deformable_convolution")
+    return _invoke(
+        lambda x, o, m, w, b: deformable_conv2d(x, o, w, b, mask=m, **kw),
+        (data, offset, mask, weight, bias),
+        name="modulated_deformable_convolution")
+
+
 def pooling(data, kernel=1, stride=None, pad=None, pool_type="max",
             pooling_convention="valid", global_pool=False, p_value=2,
             count_include_pad=True, layout="NCHW", cudnn_off=False):

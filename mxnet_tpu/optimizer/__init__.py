@@ -9,5 +9,5 @@ with XLA doing the fusion that the reference hand-writes in CUDA.
 from .optimizer import (  # noqa: F401
     Optimizer, register, create, Updater, get_updater, Test,
     SGD, SGLD, Signum, NAG, Adam, AdamW, AdaBelief, AdaGrad, AdaDelta,
-    RMSProp, Ftrl, LAMB, LARS, LANS, Nadam, DCASGD,
+    RMSProp, Ftrl, LAMB, LARS, LANS, Nadam, DCASGD, Adamax, FTML,
 )

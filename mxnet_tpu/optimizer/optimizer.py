@@ -403,6 +403,82 @@ class AdamW(Adam):
 
 
 @register
+class Adamax(Adam):
+    """AdaMax: Adam with the infinity norm (Kingma 2014 §7; reference:
+    optimizer/adamax.py). u tracks max(beta2*u, |g|) instead of the
+    second moment."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+
+    @staticmethod
+    @_jit_rule
+    def _rule(w, g, m, u, lr, wd, t, beta1, beta2, eps, rescale, clip):
+        g = g * rescale
+        g = jnp.clip(g, -clip, clip) if clip == clip and clip > 0 else g
+        g = g + wd * w
+        m = beta1 * m + (1 - beta1) * g
+        u = jnp.maximum(beta2 * u, jnp.abs(g))
+        return w - lr / (1 - beta1 ** t) * m / (u + eps), m, u
+
+    def _lazy_update_impl(self, w, rsp_grad, state, lr, wd):
+        # Adam's row-wise lazy rule would misuse the infinity-norm state
+        raise NotImplementedError(
+            "Adamax has no lazy sparse update; use lazy_update=False")
+
+
+@register
+class FTML(Optimizer):
+    """Follow The Moving Leader (Zheng & Kwok 2017; reference:
+    optimizer/ftml.py over FTMLKernel, src/operator/optimizer_op-inl.h:1256).
+    States: (prev_d, v, z)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_wrap(jnp.zeros(weight.shape, weight.dtype)),   # d
+                _wrap(jnp.zeros(weight.shape, weight.dtype)),   # v
+                _wrap(jnp.zeros(weight.shape, weight.dtype)))   # z
+
+    @staticmethod
+    @_jit_rule
+    def _rule(w, g, d, v, z, lr, wd, t, beta1, beta2, eps, rescale, clip):
+        g = g * rescale
+        g = jnp.clip(g, -clip, clip) if clip == clip and clip > 0 else g
+        g = g + wd * w
+        v = beta2 * v + (1 - beta2) * g * g
+        d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(v / (1 - beta2 ** t)) + eps)
+        z = beta1 * z + (1 - beta1) * g - (d_t - beta1 * d) * w
+        return -z / d_t, d_t, v, z
+
+    def _update_impl(self, w, g, state, lr, wd):
+        d, v, z = state
+        t = self._index_update_count.get(self._cur_index, self.num_update) \
+            if hasattr(self, "_cur_index") else self.num_update
+        new_w, nd, nv, nz = self._rule(w, g, d._data, v._data, z._data, lr,
+                                       wd, float(max(t, 1)), self.beta1,
+                                       self.beta2, self.epsilon,
+                                       self.rescale_grad,
+                                       self.clip_gradient or -1.0)
+        d._rebind(nd)
+        v._rebind(nv)
+        z._rebind(nz)
+        return new_w, state
+
+    def update(self, index, weight, grad, state):
+        self._cur_index = index
+        try:
+            return super().update(index, weight, grad, state)
+        finally:
+            del self._cur_index
+
+
+@register
 class AdaBelief(Adam):
     """Reference: optimizer/adabelief.py (variance of surprise)."""
 
