@@ -32,7 +32,13 @@ import json
 import math
 import time
 
-BASELINE_TRAIN_IMG_S = 298.51   # reference V100 bs=32 ResNet-50 train (BASELINE.md)
+# reference V100 grids by batch size (BASELINE.md, perf.md:150-254)
+BASE_R50_TRAIN = {1: 34.54, 16: 251.22, 32: 298.51, 64: 343.19, 128: 363.69}
+BASE_R50_INFER_FP16 = {1: 270.89, 32: 2085.51, 128: 2355.04}
+BASE_INCEPTION_TRAIN = {1: 21.83, 16: 173.15, 32: 214.48, 64: 247.43,
+                        128: 253.68}
+
+BASELINE_TRAIN_IMG_S = BASE_R50_TRAIN[32]   # headline comparison row
 BASELINE_INFER_IMG_S = 1076.81  # reference V100 bs=32 ResNet-50 inference fp32
 
 RESNET50_MACS_PER_IMG = 4.089e9          # fvcore count at 224x224
@@ -40,7 +46,6 @@ RESNET50_INFER_FLOPS_PER_IMG = 2 * RESNET50_MACS_PER_IMG
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * RESNET50_INFER_FLOPS_PER_IMG  # fwd+2xbwd
 INCEPTION3_MACS_PER_IMG = 5.73e9         # fvcore count at 299x299
 INCEPTION3_TRAIN_FLOPS_PER_IMG = 3 * 2 * INCEPTION3_MACS_PER_IMG
-BASELINE_INCEPTION_IMG_S = 214.48        # reference V100 bs=32 (BASELINE.md)
 
 # bf16 peak FLOP/s by device_kind substring (public TPU specs).
 PEAK_BF16 = {
@@ -208,23 +213,31 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
     return row
 
 
-def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=16):
+def bench_resnet50_train(precision: str, on_cpu: bool, peak, k_steps=None,
+                         bs=32):
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    # stacked k-step input must stay modest at large batch (HBM)
+    k_steps = k_steps or max(2, min(16, 512 // bs))
     return _bench_cnn_train(resnet50_v1, "resnet50", RESNET50_MACS_PER_IMG,
                             224, precision, on_cpu, peak, k_steps,
-                            baseline_img_s=BASELINE_TRAIN_IMG_S)
+                            tpu_cfg=(bs, None),
+                            baseline_img_s=BASE_R50_TRAIN.get(bs))
 
 
-def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=16):
-    """Inception-v3 training (BASELINE.md row 3: 214.48 img/s on V100)."""
+def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=None,
+                          bs=32):
+    """Inception-v3 training (BASELINE.md: 214.48 img/s bs32 on V100)."""
     from mxnet_tpu.gluon.model_zoo.vision import inception_v3
+    k_steps = k_steps or max(2, min(16, 512 // bs))
     return _bench_cnn_train(inception_v3, "inception_v3",
                             INCEPTION3_MACS_PER_IMG, 299, precision, on_cpu,
-                            peak, k_steps, cpu_cfg=(2, 75, 10),
-                            baseline_img_s=BASELINE_INCEPTION_IMG_S)
+                            peak, k_steps, tpu_cfg=(bs, None),
+                            cpu_cfg=(2, 75, 10),
+                            baseline_img_s=BASE_INCEPTION_TRAIN.get(bs))
 
 
-def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16):
+def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
+                         bs=32):
     import jax
     import jax.numpy as jnp
 
@@ -233,9 +246,9 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16):
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.parallel import scan_steps
 
-    bs, size = (32, 224) if not on_cpu else (4, 64)
+    size = 224
     if on_cpu:
-        k_steps = 2
+        bs, size, k_steps = 4, 64, 2
     cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
     net = resnet50_v1()
@@ -260,6 +273,9 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16):
     row = _row(f"resnet50_infer_bs{bs}_{precision}", sec, bs, flops,
                precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    base = BASE_R50_INFER_FP16.get(bs)
+    if base and not on_cpu:
+        row["vs_v100_fp16_baseline"] = round(bs / sec / base, 2)
     return row
 
 
@@ -367,14 +383,27 @@ def main():
 
     rows = []
     for fn, kwargs in [
-        (bench_resnet50_train, dict(precision="bf16")),   # headline
+        (bench_resnet50_train, dict(precision="bf16")),   # headline (bs32)
+        (bench_resnet50_train, dict(precision="bf16", bs=64)),
+        (bench_resnet50_train, dict(precision="bf16", bs=128)),
+        (bench_resnet50_train, dict(precision="bf16", bs=256)),
         (bench_resnet50_train, dict(precision="fp32")),
-        (bench_resnet50_infer, dict(precision="bf16")),
-        (bench_inception_train, dict(precision="bf16")),
+        (bench_resnet50_infer, dict(precision="bf16", bs=1)),
+        (bench_resnet50_infer, dict(precision="bf16")),   # bs32
+        (bench_resnet50_infer, dict(precision="bf16", bs=128)),
+        (bench_inception_train, dict(precision="bf16")),  # bs32
+        (bench_inception_train, dict(precision="bf16", bs=64)),
         (bench_bert_train, dict(precision="bf16", bs=32)),
+        (bench_bert_train, dict(precision="bf16", bs=48)),
         (bench_bert_train, dict(precision="bf16", bs=64)),
         (bench_augmentation, dict(precision="fp32")),
     ]:
+        if on_cpu and kwargs.get("bs", 32) != 32 and fn in (
+                bench_resnet50_train, bench_resnet50_infer,
+                bench_inception_train):
+            # the CPU fallback shrinks every CNN row to one tiny config —
+            # the batch-size grid rows would be identical duplicates
+            continue
         row = None
         for attempt in (1, 2):   # one retry: the tunneled platform can
             try:                 # drop a heavy compile transiently

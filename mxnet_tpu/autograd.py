@@ -107,9 +107,9 @@ class _TapeNode:
     for constants); vjp_fn maps output cotangents -> input cotangents.
     """
     __slots__ = ("vjp_fn", "parents", "n_out", "out_shapes", "out_dtypes",
-                 "seq", "name", "saved")
+                 "seq", "name", "saved", "out_treedef")
 
-    def __init__(self, vjp_fn, parents, outputs, name):
+    def __init__(self, vjp_fn, parents, outputs, name, out_treedef=None):
         st = _st()
         self.vjp_fn = vjp_fn
         self.parents = parents
@@ -120,6 +120,9 @@ class _TapeNode:
         st.counter += 1
         self.name = name
         self.saved = None
+        # pytree structure of the primal output (list/tuple/dict containers):
+        # the VJP's cotangent argument must match it exactly
+        self.out_treedef = out_treedef
         st.tape.append(self)
 
 
@@ -144,10 +147,10 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         var._mark_variable(grad, req)
 
 
-def _record_op(vjp_fn, array_inputs, outputs, name):
+def _record_op(vjp_fn, array_inputs, outputs, name, out_treedef=None):
     """Called by the dispatcher for every op executed under record()."""
     parents = [getattr(a, "_entry", None) for a in array_inputs]
-    node = _TapeNode(vjp_fn, parents, outputs, name)
+    node = _TapeNode(vjp_fn, parents, outputs, name, out_treedef)
     for i, o in enumerate(outputs):
         o._entry = _Entry(node, i)
     return node
@@ -312,7 +315,11 @@ def _apply_vjp(node, out_cots, create_graph):
         raise MXNetError(
             "backward through a freed graph: pass retain_graph=True to keep "
             "intermediate state for a second backward")
-    cots = tuple(out_cots) if node.n_out > 1 else out_cots[0]
+    if node.out_treedef is not None:
+        import jax
+        cots = jax.tree_util.tree_unflatten(node.out_treedef, list(out_cots))
+    else:
+        cots = tuple(out_cots) if node.n_out > 1 else out_cots[0]
     if create_graph:
         # re-record the vjp computation as ops so grad-of-grad works
         from .numpy import multiarray as M

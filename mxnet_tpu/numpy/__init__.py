@@ -68,6 +68,18 @@ def _make_op(fn, name):
 fix = _make_op(jnp.trunc, "fix")
 
 
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    """jnp.histogram returns float counts; NumPy (and the reference's
+    _npi.histogram) return integer counts when unweighted — found by the
+    per-op sweep, cast to match."""
+    hist, edges = _invoke(
+        lambda x: jnp.histogram(x, bins=bins, range=range, weights=weights,
+                                density=density), (a,), name="histogram")
+    if weights is None and not density:
+        hist = hist.astype("int64")
+    return hist, edges
+
+
 def __getattr__(name):
     """Lazy op generation (analog of ndarray/register.py _init_op_module +
     numpy/fallback.py)."""

@@ -113,6 +113,9 @@ def _wrap_out(out):
     """Wrap an op result which may be an array or a pytree of arrays."""
     if isinstance(out, (jnp.ndarray, jax.Array)):
         return _wrap(out)
+    if isinstance(out, tuple) and hasattr(out, "_fields"):
+        # NamedTuple results (jnp.linalg QRResult/SVDResult/...)
+        return type(out)(*[_wrap_out(o) for o in out])
     if isinstance(out, (tuple, list)):
         return type(out)(_wrap_out(o) for o in out)
     return out
@@ -204,8 +207,13 @@ def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
         out_leaves = [w for w in jax.tree_util.tree_leaves(
             wrapped, is_leaf=lambda x: isinstance(x, ndarray))
             if isinstance(w, ndarray)]
-        autograd._record_op(vjp_fn, diff_arrays, out_leaves,
-                            name or getattr(prim, "__name__", "op"))
+        treedef = jax.tree_util.tree_structure(out)
+        autograd._record_op(
+            vjp_fn, diff_arrays, out_leaves,
+            name or getattr(prim, "__name__", "op"),
+            # only trustworthy when every pytree leaf is a wrapped array
+            out_treedef=treedef if treedef.num_leaves == len(out_leaves)
+            else None)
     return wrapped
 
 

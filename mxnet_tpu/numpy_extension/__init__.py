@@ -140,21 +140,30 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
     raise MXNetError(f"unknown leaky_relu act_type {act_type!r}")
 
 
+def _length_mask(h, ln, axis):
+    """Positions-beyond-length mask (reference: softmax-inl.h:132 — length
+    has the data's shape minus the softmax axis; a 1-D length broadcasts
+    over the middle dims)."""
+    ax = axis % h.ndim
+    pos = jnp.arange(h.shape[ax])
+    shape = [1] * h.ndim
+    shape[ax] = h.shape[ax]
+    if ln.ndim == h.ndim - 1:
+        ln_b = jnp.expand_dims(ln, ax)
+    else:
+        ln_b = ln.reshape((ln.shape[0],) + (1,) * (h.ndim - 1))
+    return pos.reshape(shape) < ln_b
+
+
 def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
             dtype=None):
-    """Reference: src/operator/nn/softmax.cc (with optional length masking)."""
+    """Reference: src/operator/nn/softmax.cc (with optional length masking;
+    masked positions write 0.0, softmax-inl.h:142)."""
     def fn(x, ln=None):
         h = x / temperature if temperature else x
         if ln is not None:
-            pos = jnp.arange(h.shape[axis])
-            shape = [1] * h.ndim
-            shape[axis] = h.shape[axis]
-            mask = pos.reshape(shape) < jnp.expand_dims(ln, axis=tuple(
-                i for i in range(h.ndim) if i != 0))[..., None] if ln.ndim == 1 else None
-            if mask is None:
-                mask = pos.reshape(shape) < jnp.expand_dims(ln, axis)
-            h = jnp.where(mask, h, -jnp.inf)
-            out = jax.nn.softmax(h, axis)
+            mask = _length_mask(h, ln, axis)
+            out = jax.nn.softmax(jnp.where(mask, h, -jnp.inf), axis)
             return jnp.where(mask, out, 0.0).astype(np_dtype(dtype) or x.dtype)
         return jax.nn.softmax(h, axis).astype(np_dtype(dtype) or x.dtype)
     if length is not None or use_length:
@@ -164,9 +173,17 @@ def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
 
 def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False,
                 length=None):
-    def fn(x):
+    """Reference: src/operator/nn/softmax.cc log variant; masked positions
+    write 0.0 like the softmax kernel (same OType(0.0f) store)."""
+    def fn(x, ln=None):
         h = x / temperature if temperature else x
+        if ln is not None:
+            mask = _length_mask(h, ln, axis)
+            out = jax.nn.log_softmax(jnp.where(mask, h, -jnp.inf), axis)
+            return jnp.where(mask, out, 0.0).astype(np_dtype(dtype) or x.dtype)
         return jax.nn.log_softmax(h, axis).astype(np_dtype(dtype) or x.dtype)
+    if length is not None or use_length:
+        return _invoke(fn, (data, length), name="log_softmax")
     return _invoke(fn, (data,), name="log_softmax")
 
 

@@ -224,9 +224,16 @@ def _range(attrs, start, limit, delta):
 
 @_op("CumSum")
 def _cumsum(attrs, x, axis):
-    r = _jnp().cumsum(x, axis=int(np.asarray(axis)))
+    jnp = _jnp()
+    ax = int(np.asarray(axis))
+    exclusive = bool(attrs.get("exclusive", 0))
     if attrs.get("reverse", 0):
-        raise NotImplementedError("CumSum reverse")
+        x = jnp.flip(x, axis=ax)
+    r = jnp.cumsum(x, axis=ax)
+    if exclusive:
+        r = r - x
+    if attrs.get("reverse", 0):
+        r = jnp.flip(r, axis=ax)
     return r
 
 
@@ -306,6 +313,10 @@ def _scatternd(attrs, data, indices, updates):
         return data.at[idx].set(updates)
     if red == "mul":
         return data.at[idx].multiply(updates)
+    if red == "max":
+        return data.at[idx].max(updates)
+    if red == "min":
+        return data.at[idx].min(updates)
     raise NotImplementedError(f"ScatterND reduction {red!r}")
 
 
@@ -352,6 +363,25 @@ def _gemm(attrs, a, b, c=None):
     return out
 
 
+def _auto_pads(auto_pad, in_sizes, kernel, strides, dil):
+    """ONNX auto_pad SAME_UPPER/SAME_LOWER -> per-dim (begin, end) pads:
+    total = max((ceil(in/stride)-1)*stride + eff_kernel - in, 0); UPPER
+    puts the odd unit at the end, LOWER at the beginning."""
+    pads = []
+    for i, size in enumerate(in_sizes):
+        eff_k = dil[i] * (kernel[i] - 1) + 1
+        out = -(-size // strides[i])          # ceil div
+        total = max((out - 1) * strides[i] + eff_k - size, 0)
+        lo = total // 2 if auto_pad in ("SAME_UPPER", b"SAME_UPPER") \
+            else total - total // 2
+        pads.append((lo, total - lo))
+    return pads
+
+
+def _norm_autopad(ap):
+    return ap.decode() if isinstance(ap, bytes) else ap
+
+
 @_op("Conv")
 def _conv(attrs, x, w, b=None):
     import jax
@@ -360,10 +390,12 @@ def _conv(attrs, x, w, b=None):
     strides = attrs.get("strides", [1] * nd)
     dil = attrs.get("dilations", [1] * nd)
     group = attrs.get("group", 1)
-    pads = attrs.get("pads", [0] * (2 * nd))
-    padding = [(pads[i], pads[i + nd]) for i in range(nd)]
-    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", "VALID"):
-        raise NotImplementedError("Conv auto_pad=SAME_*")
+    ap = _norm_autopad(attrs.get("auto_pad", "NOTSET"))
+    if ap in ("SAME_UPPER", "SAME_LOWER"):
+        padding = _auto_pads(ap, x.shape[2:], w.shape[2:], strides, dil)
+    else:
+        pads = attrs.get("pads", [0] * (2 * nd))
+        padding = [(pads[i], pads[i + nd]) for i in range(nd)]
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dil, feature_group_count=group)
@@ -374,30 +406,57 @@ def _conv(attrs, x, w, b=None):
 
 def _pool(reducer, init, x, attrs, average=False, count_include_pad=False):
     import jax
-    if attrs.get("ceil_mode", 0):
-        raise NotImplementedError("pooling ceil_mode=1")
-    if attrs.get("auto_pad", "NOTSET") not in ("NOTSET", "VALID"):
-        raise NotImplementedError("pooling auto_pad=SAME_*")
+    jnp = _jnp()
     kernel = attrs["kernel_shape"]
     nd = len(kernel)
     strides = attrs.get("strides", [1] * nd)
     dil = attrs.get("dilations", [1] * nd)
-    pads = attrs.get("pads", [0] * (2 * nd))
-    padding = [(0, 0), (0, 0)] + [(pads[i], pads[i + nd]) for i in range(nd)]
+    ap = _norm_autopad(attrs.get("auto_pad", "NOTSET"))
+    if ap in ("SAME_UPPER", "SAME_LOWER"):
+        spans = _auto_pads(ap, x.shape[2:], kernel, strides, dil)
+    else:
+        pads = attrs.get("pads", [0] * (2 * nd))
+        spans = [(pads[i], pads[i + nd]) for i in range(nd)]
+    # ceil_mode: extend the end so the last (partial) window fits; the
+    # overhang cells count as identity for max and are excluded from the
+    # average divisor (ONNX AveragePool spec)
+    extras = []
+    for i in range(nd):
+        eff_k = dil[i] * (kernel[i] - 1) + 1
+        span = x.shape[2 + i] + spans[i][0] + spans[i][1]
+        if attrs.get("ceil_mode", 0):
+            n_out = -(-(span - eff_k) // strides[i]) + 1
+            # a window may not START in the end padding (ONNX/torch rule) —
+            # otherwise AveragePool's divisor would be 0 for that window
+            while n_out > 1 and (n_out - 1) * strides[i] >= \
+                    x.shape[2 + i] + spans[i][0]:
+                n_out -= 1
+        else:
+            n_out = (span - eff_k) // strides[i] + 1
+        extras.append(max((n_out - 1) * strides[i] + eff_k - span, 0))
     window = (1, 1) + tuple(kernel)
     stride = (1, 1) + tuple(strides)
     dilation = (1, 1) + tuple(dil)
+    padding = [(0, 0), (0, 0)] + [(b, e + x_) for (b, e), x_ in
+                                  zip(spans, extras)]
     out = jax.lax.reduce_window(x, init, reducer, window, stride, padding,
                                 window_dilation=dilation)
     if average:
+        overhang = [(0, 0), (0, 0)] + [(0, x_) for x_ in extras]
         if count_include_pad:
-            out = out / float(np.prod(kernel))
+            # divisor counts explicit pads but never the ceil overhang:
+            # ones over the explicitly-padded extent, zero beyond it
+            padded = x.shape[:2] + tuple(
+                x.shape[2 + i] + spans[i][0] + spans[i][1]
+                for i in range(nd))
+            cnt = jax.lax.reduce_window(jnp.ones(padded, x.dtype), 0.0,
+                                        jax.lax.add, window, stride,
+                                        overhang, window_dilation=dilation)
         else:
-            ones = _jnp().ones_like(x)
-            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                        stride, padding,
+            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                        window, stride, padding,
                                         window_dilation=dilation)
-            out = out / cnt
+        out = out / cnt
     return out
 
 
@@ -439,6 +498,186 @@ def _gather_nd(attrs, x, idx):
         raise NotImplementedError("GatherND batch_dims")
     depth = idx.shape[-1]
     return x[tuple(_jnp().moveaxis(idx, -1, 0)[i] for i in range(depth))]
+
+
+# vision ops common in third-party detection/segmentation graphs ------------
+
+@_op("Resize")
+def _resize(attrs, x, roi=None, scales=None, sizes=None):
+    """Reference analog: mx2onnx exports UpSampling/contrib.BilinearResize2D
+    as Resize (_op_translations_opset13.py). Supported: mode nearest
+    (floor/asymmetric and round_prefer_floor/half_pixel) and linear
+    (half_pixel); the conventions the common exporters emit."""
+    jnp = _jnp()
+    mode = _norm_autopad(attrs.get("mode", "nearest"))
+    ctm = _norm_autopad(
+        attrs.get("coordinate_transformation_mode", "half_pixel"))
+    nearest_mode = _norm_autopad(
+        attrs.get("nearest_mode", "round_prefer_floor"))
+    if sizes is not None:
+        out_shape = [int(s) for s in np.asarray(sizes)]
+        axis_scales = [o / d for o, d in zip(out_shape, x.shape)]
+    else:
+        axis_scales = [float(s) for s in np.asarray(scales)]
+        out_shape = [int(np.floor(d * s))
+                     for d, s in zip(x.shape, axis_scales)]
+    if mode == "linear":
+        if ctm not in ("half_pixel", "pytorch_half_pixel"):
+            raise NotImplementedError(f"Resize linear with {ctm}")
+        import jax
+        return jax.image.resize(x, out_shape, method="linear",
+                                antialias=False).astype(x.dtype)
+    if mode != "nearest":
+        raise NotImplementedError(f"Resize mode {mode!r}")
+    out = x
+    for ax in range(x.ndim):
+        if out_shape[ax] == out.shape[ax]:
+            continue
+        # the ORIGINAL scale drives coordinate mapping (spec: floor(d*s)
+        # output size but src = pos/s), not out/in
+        scale = axis_scales[ax]
+        pos = jnp.arange(out_shape[ax], dtype=jnp.float32)
+        if ctm == "asymmetric":
+            src = pos / scale
+        elif ctm in ("half_pixel", "pytorch_half_pixel"):
+            src = (pos + 0.5) / scale - 0.5
+        elif ctm == "align_corners":
+            src = pos * (x.shape[ax] - 1) / max(out_shape[ax] - 1, 1)
+        else:
+            raise NotImplementedError(f"Resize nearest with {ctm}")
+        if nearest_mode == "floor":
+            idx = jnp.floor(src)
+        elif nearest_mode == "ceil":
+            idx = jnp.ceil(src)
+        elif nearest_mode == "round_prefer_ceil":
+            idx = jnp.floor(src + 0.5)
+        else:  # round_prefer_floor
+            idx = jnp.ceil(src - 0.5)
+        idx = jnp.clip(idx, 0, x.shape[ax] - 1).astype(jnp.int32)
+        out = jnp.take(out, idx, axis=ax)
+    return out
+
+
+@_op("NonMaxSuppression")
+def _nms(attrs, boxes, scores, max_output_boxes_per_class=None,
+         iou_threshold=None, score_threshold=None):
+    """Classic per-class greedy NMS (host-side: the output shape is
+    data-dependent, so this op is eager-only — like the reference's
+    _contrib_box_nms import path). boxes (N,B,4), scores (N,C,B);
+    returns (K, 3) int64 [batch, class, box]."""
+    b = np.asarray(boxes)
+    s = np.asarray(scores)
+    max_out = (int(np.asarray(max_output_boxes_per_class))
+               if max_output_boxes_per_class is not None else 0)
+    if max_out == 0:
+        # spec: 0 (or absent) means "no output produced"
+        return _jnp().zeros((0, 3), np.int64)
+    iou_t = (float(np.asarray(iou_threshold))
+             if iou_threshold is not None else 0.0)
+    score_t = (float(np.asarray(score_threshold))
+               if score_threshold is not None else -np.inf)
+    center = attrs.get("center_point_box", 0)
+    sel = []
+    for n in range(b.shape[0]):
+        if center:
+            cx, cy, w, h = (b[n, :, 0], b[n, :, 1], b[n, :, 2], b[n, :, 3])
+            y1, x1 = cy - h / 2, cx - w / 2
+            y2, x2 = cy + h / 2, cx + w / 2
+        else:
+            y1, x1, y2, x2 = (b[n, :, 0], b[n, :, 1], b[n, :, 2], b[n, :, 3])
+            y1, y2 = np.minimum(y1, y2), np.maximum(y1, y2)
+            x1, x2 = np.minimum(x1, x2), np.maximum(x1, x2)
+        area = (y2 - y1) * (x2 - x1)
+        for c in range(s.shape[1]):
+            order = np.argsort(-s[n, c])
+            order = order[s[n, c][order] > score_t]
+            keep = []
+            while order.size and len(keep) < max_out:
+                i = order[0]
+                keep.append(i)
+                rest = order[1:]
+                yy1 = np.maximum(y1[i], y1[rest])
+                xx1 = np.maximum(x1[i], x1[rest])
+                yy2 = np.minimum(y2[i], y2[rest])
+                xx2 = np.minimum(x2[i], x2[rest])
+                inter = (np.maximum(yy2 - yy1, 0)
+                         * np.maximum(xx2 - xx1, 0))
+                iou = inter / (area[i] + area[rest] - inter + 1e-12)
+                order = rest[iou <= iou_t]
+            sel.extend((n, c, int(i)) for i in keep)
+    return _jnp().asarray(np.array(sel, np.int64).reshape(-1, 3))
+
+
+@_op("RoiAlign")
+def _roi_align(attrs, x, rois, batch_indices):
+    """RoiAlign (reference export path: _contrib_ROIAlign ->
+    _op_translations). Bilinear-sampled average/max pooling per ROI bin;
+    vectorized gathers like ops/deformable.py. sampling_ratio=0 (adaptive)
+    needs concrete rois, so it is eager-only."""
+    jnp = _jnp()
+    oh = attrs.get("output_height", 1)
+    ow = attrs.get("output_width", 1)
+    sratio = attrs.get("sampling_ratio", 0)
+    scale = attrs.get("spatial_scale", 1.0)
+    mode = _norm_autopad(attrs.get("mode", "avg"))
+    # the attribute only exists from opset 16 (default half_pixel there);
+    # opset 10-15 graphs have no 0.5 offset — make_fn injects __opset__
+    default_ctm = ("half_pixel" if attrs.get("__opset__", 17) >= 16
+                   else "output_half_pixel")
+    offset = 0.5 if _norm_autopad(
+        attrs.get("coordinate_transformation_mode", default_ctm)) \
+        == "half_pixel" else 0.0
+    N, C, H, W = x.shape
+    r = np.asarray(rois).astype(np.float64) * scale - offset
+    nroi = r.shape[0]
+    if sratio <= 0:
+        rh = max(1, int(np.ceil(np.max(
+            (r[:, 3] - r[:, 1]) / oh)))) if nroi else 1
+        rw = max(1, int(np.ceil(np.max(
+            (r[:, 2] - r[:, 0]) / ow)))) if nroi else 1
+    else:
+        rh = rw = int(sratio)
+    # sample grid: per roi/bin, rh x rw bilinear samples
+    bh = (r[:, 3] - r[:, 1]) / oh          # (R,) bin heights
+    bw = (r[:, 2] - r[:, 0]) / ow
+    iy = (np.arange(rh) + 0.5) / rh        # (rh,) in-bin fractions
+    ix = (np.arange(rw) + 0.5) / rw
+    ys = (r[:, 1, None, None] + (np.arange(oh)[None, :, None] +
+                                 iy[None, None, :]) * bh[:, None, None])
+    xs = (r[:, 0, None, None] + (np.arange(ow)[None, :, None] +
+                                 ix[None, None, :]) * bw[:, None, None])
+    ys = jnp.asarray(ys)                   # (R, oh, rh)
+    xs = jnp.asarray(xs)                   # (R, ow, rw)
+    y = ys[:, :, :, None, None]            # (R, oh, rh, 1, 1)
+    xx = xs[:, None, None, :, :]           # (R, 1, 1, ow, rw)
+    y0, x0 = jnp.floor(y), jnp.floor(xx)
+    wy1, wx1 = y - y0, xx - x0
+    xg = x.reshape(N, C, H * W)
+    bi = np.asarray(batch_indices).astype(np.int32)
+    xg = jnp.take(xg, jnp.asarray(bi), axis=0)   # (R, C, H*W)
+
+    def corner(cy, cx):
+        inside = ((cy >= 0) & (cy < H) & (cx >= 0) & (cx < W))
+        idx = (jnp.clip(cy, 0, H - 1).astype(jnp.int32) * W
+               + jnp.clip(cx, 0, W - 1).astype(jnp.int32))
+        idx = jnp.broadcast_to(idx, (nroi, oh, rh, ow, rw))
+        flat = idx.reshape(nroi, 1, -1)
+        v = jnp.take_along_axis(
+            xg, jnp.broadcast_to(flat, (nroi, C, flat.shape[-1])), axis=-1)
+        v = v.reshape(nroi, C, oh, rh, ow, rw)
+        m = jnp.broadcast_to(inside, (nroi, oh, rh, ow, rw))
+        return v * m[:, None].astype(x.dtype)
+
+    w00 = ((1 - wy1) * (1 - wx1)).astype(x.dtype)
+    w01 = ((1 - wy1) * wx1).astype(x.dtype)
+    w10 = (wy1 * (1 - wx1)).astype(x.dtype)
+    w11 = (wy1 * wx1).astype(x.dtype)
+    sampled = (corner(y0, x0) * w00[:, None] + corner(y0, x0 + 1) * w01[:, None]
+               + corner(y0 + 1, x0) * w10[:, None]
+               + corner(y0 + 1, x0 + 1) * w11[:, None])
+    if mode == "max":
+        return sampled.max(axis=(3, 5))
+    return sampled.mean(axis=(3, 5))
 
 
 @_op("Constant")
@@ -522,7 +761,12 @@ def make_fn(model, weights_override=None):
     input_names = [vi.name for vi in graph.input
                    if vi.name not in weights]
     output_names = [vi.name for vi in graph.output]
-    nodes = [(n.op_type, list(n.input), list(n.output), node_attrs(n))
+    opset = 17
+    for oi in getattr(model, "opset_import", []):
+        if getattr(oi, "domain", "") in ("", "ai.onnx"):
+            opset = oi.version or opset
+    nodes = [(n.op_type, list(n.input), list(n.output),
+              dict(node_attrs(n), __opset__=opset))
              for n in graph.node]
 
     def _check_ops(node_list):

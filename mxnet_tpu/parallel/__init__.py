@@ -19,3 +19,4 @@ from . import tp  # noqa: F401
 from . import pp  # noqa: F401
 from .pp import gpipe, stack_stage_params, shard_stages  # noqa: F401
 from .train import ShardedTrainStep, megatron_specs, scan_steps  # noqa: F401
+from .scaling import weak_scaling_table  # noqa: F401

@@ -1,0 +1,84 @@
+"""Data-parallel weak-scaling harness (KVStore scaling-efficiency artifact).
+
+BASELINE.md north star #3 is KVStore data-parallel scaling efficiency over
+1->32 chips; the reference measures it with tools/bandwidth/measure.py over
+kvstore push/pull. Here the measured object is the framework's actual DP
+path — ShardedTrainStep (mesh-psum gradient reduction, the KVStore('device')
+substrate) — run at n = 1, 2, 4, ... devices with FIXED per-device batch
+(weak scaling: ideal = constant step time, efficiency_n = t_1 / t_n).
+
+The same harness serves both regimes:
+- virtual CPU mesh (CI / dryrun): meshes are built over sublists of the
+  existing devices — honest wall-clock, but all virtual devices share host
+  cores, so efficiency UNDERESTIMATES real-chip scaling (collectives are
+  simulated serially). The numbers bound overhead, not ICI throughput.
+- real hardware: pass ``devices=jax.devices()`` (or any sublist); meshes
+  ride the actual ICI and the efficiencies are the headline metric.
+"""
+from __future__ import annotations
+
+import time
+
+
+def weak_scaling_table(ns=None, devices=None, per_device_batch=4,
+                       image=24, classes=10, iters=8, warmup=3):
+    """Run the DP ShardedTrainStep at each n in ``ns``; return a list of
+    rows {n, ms_per_step, images_per_s, efficiency}.
+
+    devices: device list to slice (default jax.devices()). ns defaults to
+    powers of two up to len(devices).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.parallel.train import ShardedTrainStep
+
+    devices = list(devices) if devices is not None else jax.devices()
+    if ns is None:
+        ns = []
+        n = 1
+        while n <= len(devices):
+            ns.append(n)
+            n *= 2
+
+    def ce_loss(logits, y):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+    rows = []
+    t1 = None
+    for n in ns:
+        mesh = Mesh(onp.array(devices[:n]).reshape(n), ("dp",))
+        net = get_resnet(1, 18, classes=classes)
+        net.initialize()
+        net(mx.np.zeros((2, 3, image, image), dtype="float32"))
+        step = ShardedTrainStep(
+            net, ce_loss,
+            mx.optimizer.create("sgd", learning_rate=0.05, momentum=0.9),
+            mesh, batch_specs=(P("dp"), P("dp")), n_labels=1)
+        bs = per_device_batch * n
+        x = onp.random.RandomState(0).uniform(
+            size=(bs, 3, image, image)).astype("float32")
+        y = onp.zeros((bs,), "int32")
+        for _ in range(max(warmup, 1)):   # >=1: excludes compile from timing
+            loss = step(x, y)
+        loss.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)
+        loss.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+        if t1 is None:
+            t1 = dt
+        rows.append({
+            "n": n,
+            "global_batch": bs,
+            "ms_per_step": round(dt * 1e3, 2),
+            "images_per_s": round(bs / dt, 1),
+            "efficiency": round(t1 / dt, 3),
+        })
+    return rows

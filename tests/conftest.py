@@ -30,6 +30,22 @@ if os.environ.get("MXNET_TEST_DEVICE", "cpu") == "cpu":
 jax.config.update("jax_default_matmul_precision", "float32")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: nightly-bucket test (set MXNET_TEST_SLOW=1 to "
+        "run; analog of the reference's tests/nightly split)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("MXNET_TEST_SLOW", "0") == "1":
+        return
+    skip = pytest.mark.skip(
+        reason="nightly bucket: set MXNET_TEST_SLOW=1 to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything(request):
     seed = int(os.environ.get("MXNET_TEST_SEED", 17))

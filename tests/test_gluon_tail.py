@@ -187,10 +187,11 @@ def test_conv_rnn_cell_matches_dense_on_1x1():
 
 @pytest.mark.parametrize("cls,nd,nstates", [
     (rnn.Conv1DRNNCell, 1, 1), (rnn.Conv2DRNNCell, 2, 1),
-    (rnn.Conv3DRNNCell, 3, 1), (rnn.Conv1DLSTMCell, 1, 2),
-    (rnn.Conv2DLSTMCell, 2, 2), (rnn.Conv3DLSTMCell, 3, 2),
+    pytest.param(rnn.Conv3DRNNCell, 3, 1, marks=pytest.mark.slow),
+    (rnn.Conv1DLSTMCell, 1, 2), (rnn.Conv2DLSTMCell, 2, 2),
+    pytest.param(rnn.Conv3DLSTMCell, 3, 2, marks=pytest.mark.slow),
     (rnn.Conv1DGRUCell, 1, 1), (rnn.Conv2DGRUCell, 2, 1),
-    (rnn.Conv3DGRUCell, 3, 1),
+    pytest.param(rnn.Conv3DGRUCell, 3, 1, marks=pytest.mark.slow),
 ])
 def test_conv_rnn_family_step_and_unroll(cls, nd, nstates):
     spatial = (6,) * nd

@@ -104,6 +104,7 @@ def test_im2rec_roundtrip(tmp_path):
     assert batch.data[0].shape == (2, 3, 20, 20)
 
 
+@pytest.mark.slow
 def test_model_store_cache_and_pretrained(tmp_path, monkeypatch):
     from mxnet_tpu.gluon.model_zoo import model_store
     from mxnet_tpu.gluon.model_zoo.vision import get_model

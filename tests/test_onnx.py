@@ -282,11 +282,9 @@ def test_import_external_style_model():
                                 onp.maximum(x @ w + b, 0), rtol=1e-5)
 
 
-def test_export_all_vision_families():
-    """Every model_zoo vision family exports and round-trips numerically
-    (reference: tests/python/onnx model zoo coverage)."""
+def _vision_factories():
     from mxnet_tpu.gluon.model_zoo import vision as V
-    factories = [
+    return [
         ("alexnet", lambda: V.alexnet(classes=10), (1, 3, 64, 64)),
         ("vgg11", lambda: V.vgg11(classes=10), (1, 3, 32, 32)),
         ("resnet18_v2", lambda: V.resnet18_v2(classes=10), (1, 3, 32, 32)),
@@ -297,13 +295,33 @@ def test_export_all_vision_families():
          (1, 3, 32, 32)),
         ("inception_v3", lambda: V.inception_v3(classes=10), (1, 3, 80, 80)),
     ]
-    for name, ctor, shape in factories:
-        net = ctor()
-        net.initialize()
-        x = mx.np.array(onp.random.RandomState(0)
-                        .randn(*shape).astype("float32"))
-        net(x)  # materialize deferred shapes
-        _roundtrip(net, x, tol=2e-4)
+
+
+def _roundtrip_family(name):
+    fac = dict((n, (c, s)) for n, c, s in _vision_factories())
+    ctor, shape = fac[name]
+    net = ctor()
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .randn(*shape).astype("float32"))
+    net(x)  # materialize deferred shapes
+    _roundtrip(net, x, tol=2e-4)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v2", "mobilenet_v2"])
+def test_export_vision_families_fast(name):
+    """Two representative families in the default run; the full grid is
+    nightly-marked below (reference: tests/python/onnx model zoo
+    coverage runs in its own CI bucket)."""
+    _roundtrip_family(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", [
+    "alexnet", "vgg11", "squeezenet", "densenet121", "mobilenet",
+    "inception_v3"])
+def test_export_all_vision_families(name):
+    _roundtrip_family(name)
 
 
 def test_export_lstm_scan():
@@ -407,3 +425,165 @@ def test_export_unsigned_iota_range_cast():
                 ini = {t.name: t for t in model.graph.initializer}
                 start = ini[node.input[0]]
                 assert start.data_type in legal
+
+
+# -- third-party-graph edges (round-4 verdict item 8) ------------------------
+
+def _run_graph(nodes, inputs, outputs, feeds, initializers=()):
+    """Build a hand-authored (third-party-style) graph and execute it."""
+    from mxnet_tpu.onnx import make_fn, serde
+    g = serde.GraphProto()
+    for n in nodes:
+        g.node.append(n)
+    for name, arr in feeds.items():
+        g.input.append(serde.make_value_info(name, arr.dtype, arr.shape))
+    for t in initializers:
+        g.initializer.append(t)
+    for name in outputs:
+        g.output.append(serde.make_value_info(name, onp.float32, ()))
+    fn = make_fn(serde.make_model(g))
+    res = fn(*feeds.values())
+    return [onp.asarray(r) for r in res]
+
+
+def test_onnx_conv_auto_pad_same():
+    import torch
+    from mxnet_tpu.onnx import serde
+    x = onp.random.RandomState(0).randn(1, 2, 7, 7).astype(onp.float32)
+    w = onp.random.RandomState(1).randn(3, 2, 3, 3).astype(onp.float32)
+    for ap, (lo, hi) in (("SAME_UPPER", (1, 1)), ("SAME_LOWER", (1, 1))):
+        node = serde.make_node("Conv", ["x", "w"], ["y"], auto_pad=ap,
+                               strides=[1, 1], kernel_shape=[3, 3])
+        (got,) = _run_graph([node], ["x", "w"], ["y"],
+                            {"x": x, "w": w})
+        want = torch.nn.functional.conv2d(
+            torch.from_numpy(x), torch.from_numpy(w), padding=1).numpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # stride 2 with even input: SAME_UPPER pads the extra cell at the end
+    node = serde.make_node("Conv", ["x", "w"], ["y"], auto_pad="SAME_UPPER",
+                           strides=[2, 2], kernel_shape=[3, 3])
+    x8 = onp.random.RandomState(2).randn(1, 2, 8, 8).astype(onp.float32)
+    (got,) = _run_graph([node], ["x", "w"], ["y"], {"x": x8, "w": w})
+    xp = torch.nn.functional.pad(torch.from_numpy(x8), (0, 1, 0, 1))
+    want = torch.nn.functional.conv2d(xp, torch.from_numpy(w),
+                                      stride=2).numpy()
+    assert got.shape == (1, 3, 4, 4)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_onnx_pool_ceil_mode():
+    import torch
+    from mxnet_tpu.onnx import serde
+    x = onp.random.RandomState(0).randn(1, 2, 7, 7).astype(onp.float32)
+    node = serde.make_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3],
+                           strides=[2, 2], ceil_mode=1)
+    (got,) = _run_graph([node], ["x"], ["y"], {"x": x})
+    want = torch.nn.functional.max_pool2d(
+        torch.from_numpy(x), 3, 2, ceil_mode=True).numpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+    for cip in (0, 1):
+        node = serde.make_node("AveragePool", ["x"], ["y"],
+                               kernel_shape=[3, 3], strides=[2, 2],
+                               pads=[1, 1, 1, 1], ceil_mode=1,
+                               count_include_pad=cip)
+        (got,) = _run_graph([node], ["x"], ["y"], {"x": x})
+        want = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x), 3, 2, padding=1, ceil_mode=True,
+            count_include_pad=bool(cip)).numpy()
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_cumsum_reverse_exclusive():
+    from mxnet_tpu.onnx import serde
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    for rev in (0, 1):
+        for exc in (0, 1):
+            node = serde.make_node("CumSum", ["x", "ax"], ["y"],
+                                   reverse=rev, exclusive=exc)
+            (got,) = _run_graph(
+                [node], ["x", "ax"], ["y"],
+                {"x": x, "ax": onp.array(1, onp.int64)})
+            want = x[:, ::-1] if rev else x
+            want = onp.cumsum(want, axis=1)
+            if exc:
+                want = want - (x[:, ::-1] if rev else x)
+            if rev:
+                want = want[:, ::-1]
+            onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_onnx_scatternd_reductions():
+    from mxnet_tpu.onnx import serde
+    data = onp.zeros((4,), onp.float32) + 2.0
+    idx = onp.array([[1], [3]], onp.int64)
+    upd = onp.array([5.0, 1.0], onp.float32)
+    for red, want in (("max", [2, 5, 2, 2]), ("min", [2, 2, 2, 1]),
+                      ("add", [2, 7, 2, 3]), ("mul", [2, 10, 2, 2])):
+        node = serde.make_node("ScatterND", ["d", "i", "u"], ["y"],
+                               reduction=red)
+        (got,) = _run_graph([node], ["d", "i", "u"], ["y"],
+                            {"d": data, "i": idx, "u": upd})
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_onnx_resize_nearest_and_linear():
+    import torch
+    from mxnet_tpu.onnx import serde
+    x = onp.random.RandomState(0).randn(1, 2, 4, 5).astype(onp.float32)
+    # nearest x2, asymmetric + floor == numpy repeat
+    node = serde.make_node("Resize", ["x", "", "s"], ["y"], mode="nearest",
+                           coordinate_transformation_mode="asymmetric",
+                           nearest_mode="floor")
+    (got,) = _run_graph([node], ["x", "s"], ["y"],
+                        {"x": x, "s": onp.array([1, 1, 2, 2], onp.float32)})
+    want = x.repeat(2, axis=2).repeat(2, axis=3)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+    # linear half_pixel == torch bilinear align_corners=False
+    node = serde.make_node("Resize", ["x", "", "", "sz"], ["y"],
+                           mode="linear",
+                           coordinate_transformation_mode="half_pixel")
+    (got,) = _run_graph([node], ["x", "sz"], ["y"],
+                        {"x": x, "sz": onp.array([1, 2, 8, 10], onp.int64)})
+    want = torch.nn.functional.interpolate(
+        torch.from_numpy(x), size=(8, 10), mode="bilinear",
+        align_corners=False).numpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_nms():
+    from mxnet_tpu.onnx import serde
+    boxes = onp.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                        [20, 20, 30, 30]]], onp.float32)
+    scores = onp.array([[[0.9, 0.8, 0.7]]], onp.float32)
+    node = serde.make_node("NonMaxSuppression",
+                           ["b", "s", "m", "iou", "st"], ["y"])
+    (got,) = _run_graph(
+        [node], ["b", "s", "m", "iou", "st"], ["y"],
+        {"b": boxes, "s": scores, "m": onp.array(10, onp.int64),
+         "iou": onp.array(0.5, onp.float32),
+         "st": onp.array(0.0, onp.float32)})
+    # box 1 overlaps box 0 (IoU ~0.82) -> suppressed; box 2 kept
+    onp.testing.assert_array_equal(got, [[0, 0, 0], [0, 0, 2]])
+
+
+def test_onnx_roi_align():
+    from mxnet_tpu.onnx import serde
+    # linear ramp: bilinear avg pooling of a linear function = value at
+    # the bin-center, exact in the interior
+    H = W = 8
+    ramp = onp.tile(onp.arange(W, dtype=onp.float32), (H, 1))
+    x = ramp.reshape(1, 1, H, W)
+    rois = onp.array([[1.0, 1.0, 5.0, 5.0]], onp.float32)  # x1 y1 x2 y2
+    node = serde.make_node("RoiAlign", ["x", "r", "bi"], ["y"],
+                           output_height=2, output_width=2,
+                           sampling_ratio=2, spatial_scale=1.0,
+                           coordinate_transformation_mode="half_pixel")
+    (got,) = _run_graph([node], ["x", "r", "bi"], ["y"],
+                        {"x": x, "r": rois,
+                         "bi": onp.array([0], onp.int64)})
+    assert got.shape == (1, 1, 2, 2)
+    # roi [0.5, 4.5) after half_pixel offset; bins of size 2 -> x centers
+    # at 1.5 and 3.5
+    onp.testing.assert_allclose(got[0, 0, 0], [1.5, 3.5], atol=1e-5)
+    onp.testing.assert_allclose(got[0, 0, 1], [1.5, 3.5], atol=1e-5)

@@ -67,6 +67,7 @@ def test_ring_attention_matches_reference():
                                     onp.asarray(ref(causal)), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_sharded_train_step_bert_dp_tp_sp():
     from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining
     from mxnet_tpu.parallel.mesh import activation_sharding
@@ -452,3 +453,18 @@ def test_batchnorm_is_sync_under_dp_mesh():
     for n in outs["single"]:
         onp.testing.assert_allclose(outs["sharded"][n], outs["single"][n],
                                     rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_weak_scaling_table():
+    """KVStore DP weak-scaling harness (BASELINE.md north star #3): rows at
+    n=1/2/4 device-sublist meshes, fixed per-device batch, efficiency
+    relative to n=1."""
+    from mxnet_tpu.parallel.scaling import weak_scaling_table
+    rows = weak_scaling_table(ns=[1, 2], per_device_batch=1, image=16,
+                              iters=2, warmup=1)
+    assert [r["n"] for r in rows] == [1, 2]
+    assert rows[0]["efficiency"] == 1.0
+    for r in rows:
+        assert r["ms_per_step"] > 0
+        assert r["global_batch"] == r["n"]
+        assert 0 < r["efficiency"] <= 1.5

@@ -53,7 +53,8 @@ def _train(hybridize, epochs=3, n=1024):
     return metric.get()[1], net
 
 
-@pytest.mark.parametrize("hybridize", [False, True])
+@pytest.mark.parametrize("hybridize", [
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_lenet_mnist_converges(hybridize):
     acc, _ = _train(hybridize)
     assert acc > 0.75, f"accuracy too low: {acc}"

@@ -154,6 +154,7 @@ def test_bert_shapes_and_grad():
     assert float(abs(g.asnumpy()).sum()) > 0
 
 
+@pytest.mark.slow
 def test_bert_tiny_convergence():
     """A tiny MLM task must overfit in a few steps (reference pattern:
     tests/python/train convergence smoke tests)."""
@@ -184,8 +185,10 @@ def test_bert_tiny_convergence():
     assert lv < first * 0.5, f"no convergence: {first} -> {lv}"
 
 
-@pytest.mark.parametrize("sq,sk,causal", [(300, 300, False), (8, 16, True),
-                                          (100, 36, False), (129, 257, False)])
+@pytest.mark.parametrize("sq,sk,causal", [
+    (8, 16, True), (129, 257, False),
+    pytest.param(300, 300, False, marks=pytest.mark.slow),
+    pytest.param(100, 36, False, marks=pytest.mark.slow)])
 def test_flash_attention_ragged_shapes(sq, sk, causal):
     """Non-block-multiple seq lengths and sq != sk causal (regressions:
     clamped-pl.ds misalignment; bwd mask alignment)."""
