@@ -10,6 +10,7 @@ combiners widen to the widest floating input (amp_multicast semantics)."""
 # the attention matmul ops)
 TARGET_DTYPE_OPS = [
     "matmul", "dot", "einsum", "tensordot", "convolution", "deconvolution",
+    "fused_conv_bn_relu",   # BN statistics accumulate f32 internally
     "fully_connected", "batch_dot", "rnn", "multi_head_attention",
     "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
     "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",

@@ -129,6 +129,9 @@ declare("profiler.autostart", bool, False, "MXNET_PROFILER_AUTOSTART",
 declare("native.build_dir", str, "", "MXNET_TPU_NATIVE_BUILD",
         "Build/cache dir for native (C++) helper libraries "
         "('' = <repo>/native/build).")
+declare("fused_conv_bn", str, "auto", "MXNET_FUSED_CONV_BN",
+        "Pallas fused conv3x3+BN+ReLU backward on eligible blocks: "
+        "'auto' (TPU only), 'on', 'off'.")
 declare("home", str, os.path.join("~", ".mxnet"), "MXNET_HOME",
         "Cache root for datasets/pretrained weights (reference: base.py "
         "data_dir).")

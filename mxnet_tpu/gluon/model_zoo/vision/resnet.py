@@ -24,11 +24,15 @@ def _conv3x3(channels, stride, in_channels):
 
 
 class BasicBlockV1(HybridBlock):
-    """Reference: resnet.py BasicBlockV1 (conv-bn-relu x2 + residual)."""
+    """Reference: resnet.py BasicBlockV1 (conv-bn-relu x2 + residual).
+
+    The body is a FusableSequential: during TPU training its conv3x3+BN+
+    ReLU triplets take the Pallas fused-backward path (ops/pallas_conv_bwd)
+    with identical parameters/naming."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0):
         super().__init__()
-        self.body = nn.HybridSequential()
+        self.body = nn.FusableSequential()
         self.body.add(_conv3x3(channels, stride, in_channels))
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
@@ -53,11 +57,12 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
-    """Reference: resnet.py BottleneckV1 (1x1-3x3-1x1)."""
+    """Reference: resnet.py BottleneckV1 (1x1-3x3-1x1). Body is a
+    FusableSequential (see BasicBlockV1)."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0):
         super().__init__()
-        self.body = nn.HybridSequential()
+        self.body = nn.FusableSequential()
         self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))

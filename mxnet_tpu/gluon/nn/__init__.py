@@ -20,4 +20,5 @@ from .transformer import (  # noqa: F401
     TransformerEncoderCell, TransformerDecoderCell,
 )
 from .moe import MoEDense  # noqa: F401
+from .fuse import FusableSequential  # noqa: F401
 from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401

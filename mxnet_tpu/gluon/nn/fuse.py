@@ -1,0 +1,110 @@
+"""Pattern-fused Sequential: conv3x3+BN+ReLU triplets route through the
+Pallas fused-backward composite.
+
+Reference analog: the reference fuses conv+BN statistics via cuDNN fused
+ops and pointwise fusion passes (src/operator/fusion/); here the forward
+stays XLA (already fused) and the BACKWARD is the Pallas mega-kernel in
+ops/pallas_conv_bwd.py which never materializes the conv-output cotangent
+(round-3 profiled HBM wall).
+
+Enabled when config 'fused_conv_bn' is true ("auto": TPU only), training
+mode is active, and the triplet matches the kernel's shape class; anything
+else falls back to the plain child-by-child forward, so eval, CPU tests,
+exotic shapes and ONNX export are unchanged.
+"""
+from __future__ import annotations
+
+from .basic_layers import Activation, BatchNorm, HybridSequential
+from .conv_layers import _Conv
+
+
+def _fusion_active():
+    from ... import config as _cfg
+    from ... import autograd as _ag
+    if not _ag.is_training():
+        return False
+    mode = str(_cfg.get("fused_conv_bn")).lower()
+    if mode in ("0", "false", "off"):
+        return False
+    if mode in ("1", "true", "on"):
+        return True
+    # auto: only where the Pallas kernel compiles natively
+    import jax
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _has_hooks(*blocks):
+    return any(getattr(b, attr, None)
+               for b in blocks
+               for attr in ("_forward_hooks", "_forward_pre_hooks"))
+
+
+def _eligible_triplet(conv, bn, act):
+    from ...ops.pallas_conv_bwd import eligible
+    if not (isinstance(conv, _Conv) and type(bn) is BatchNorm
+            and isinstance(act, Activation)
+            and getattr(act, "_act_type", None) == "relu"):
+        return False
+    if conv._op_name != "convolution" or conv._layout != "NCHW" \
+            or conv.act is not None:
+        return False
+    if not (bn._scale and bn._center and not bn._use_global_stats
+            and bn._axis == 1):
+        return False
+    if _has_hooks(conv, bn, act):
+        # fused path bypasses child __call__ — keep hooks observable
+        return False
+    return eligible(conv._kernel, conv._strides, conv._padding,
+                    conv._dilation, conv._groups, conv.bias is not None)
+
+
+class FusableSequential(HybridSequential):
+    """HybridSequential that detects [Conv2D 3x3/s1, BatchNorm, ReLU] runs
+    and routes them through npx.fused_conv_bn_relu during training.
+
+    Forward hooks on the three children disable fusion for that triplet
+    (the fused path bypasses the child __call__)."""
+
+    @staticmethod
+    def _fits(conv, x):
+        from ...ops.pallas_conv_bwd import fits_vmem
+        n, c = x.shape[0], x.shape[1]
+        h, w = x.shape[2], x.shape[3]
+        return fits_vmem(n, h, w, c, conv._channels,
+                         itemsize=x.dtype.itemsize)
+
+    def forward(self, x, *args):
+        from ... import numpy_extension as npx
+        children = list(self._children.values())
+        fuse = _fusion_active()
+        i = 0
+        while i < len(children):
+            blk = children[i]
+            if (fuse and i + 2 < len(children)
+                    and _eligible_triplet(blk, children[i + 1],
+                                          children[i + 2])
+                    and self._fits(blk, x)):
+                conv, bn = blk, children[i + 1]
+                if not conv.weight._shape_known():
+                    conv.weight._finish_deferred_init(
+                        (conv._channels, x.shape[1]) + conv._kernel)
+                ch = conv._channels
+                for p in (bn.gamma, bn.beta, bn.running_mean,
+                          bn.running_var):
+                    if not p._shape_known():
+                        p._finish_deferred_init((ch,))
+                    elif p._data is None:
+                        p._finish_deferred_init()
+                x = npx.fused_conv_bn_relu(
+                    x, conv.weight.data(), bn.gamma.data(), bn.beta.data(),
+                    bn.running_mean.data(), bn.running_var.data(),
+                    momentum=bn._momentum, eps=bn._epsilon)
+                i += 3
+                continue
+            x = blk(x, *args)
+            args = ()
+            i += 1
+        return x

@@ -469,6 +469,39 @@ def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-3,
     return res
 
 
+def fused_conv_bn_relu(x, weight, gamma, beta, running_mean, running_var,
+                       momentum=0.9, eps=1e-5, interpret=None):
+    """Training-mode relu(bn(conv3x3_s1(x, w))) with the Pallas fused
+    backward (ops/pallas_conv_bwd.py — dy recomputed in VMEM, dgrad+wgrad
+    share one read of the saved tensors).
+
+    NCHW in/out (transposed to the kernel's NHWC inside the traced fn so
+    XLA folds the relayout into its own layout assignment); weight OIHW.
+    Running stats update exactly like npx.batch_norm.
+    """
+    from ..ops.pallas_conv_bwd import fused_cbr_train
+    if interpret is None:
+        import jax as _jax
+        interpret = _jax.default_backend() != "tpu"
+
+    def fn(x_, w, g, b):
+        xh = jnp.transpose(x_, (0, 2, 3, 1))          # NCHW -> NHWC
+        wh = jnp.transpose(w, (2, 3, 1, 0))           # OIHW -> HWIO
+        a, mean, var = fused_cbr_train(xh, wh, g, b, eps, interpret)
+        return jnp.transpose(a, (0, 3, 1, 2)), mean, var
+
+    out, mean, var = _invoke(fn, (x, weight, gamma, beta),
+                             name="fused_conv_bn_relu")
+    m = momentum
+    running_mean._rebind(
+        (m * running_mean._data
+         + (1 - m) * lax.stop_gradient(mean._data)).astype(running_mean.dtype))
+    running_var._rebind(
+        (m * running_var._data
+         + (1 - m) * lax.stop_gradient(var._data)).astype(running_var.dtype))
+    return out
+
+
 def layer_norm(data, gamma=None, beta=None, axis=-1, eps=1e-5):
     """Reference: src/operator/nn/layer_norm.cc."""
     def fn(x, g, b):

@@ -1,0 +1,192 @@
+"""Pallas fused conv3x3+BN+ReLU backward — oracle suite.
+
+Round-4 verdict item 1: the kernel (ops/pallas_conv_bwd.py) must match the
+eager/XLA composition. Interpret mode on the CPU mesh; on TPU the same
+kernel compiles natively (bench path). Note the e2e network-level
+comparison uses a loss-level tolerance: an UNTRAINED ResNet at tiny batch
+is chaotically ill-conditioned (near-zero BN variances amplify 1e-6
+perturbations ~1e4x — measured, both paths), so elementwise output parity
+is only asserted at the block level where conditioning is sane.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, gluon
+from mxnet_tpu import numpy_extension as npx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ops.pallas_conv_bwd import (
+    conv3x3_bn_relu_ref, fused_conv3x3_bn_relu_bwd, fused_cbr_train)
+
+RNG = onp.random.RandomState(0)
+
+
+@pytest.mark.parametrize("shape", [
+    (4, 8, 8, 16),     # single grid step
+    (16, 8, 8, 8),     # multi-step grid (NB=4, grid=4): dw accumulation
+    (2, 4, 4, 128),    # late-stage: big C, tiny spatial
+])
+def test_kernel_matches_jax_vjp(shape):
+    N, H, W, C = shape
+    O = C
+    x = jnp.asarray(RNG.randn(N, H, W, C), jnp.float32)
+    w = jnp.asarray(RNG.randn(3, 3, C, O) * 0.2, jnp.float32)
+    gamma = jnp.asarray(RNG.rand(O) + 0.5, jnp.float32)
+    beta = jnp.asarray(RNG.randn(O) * 0.1, jnp.float32)
+    da = jnp.asarray(RNG.randn(N, H, W, O), jnp.float32)
+
+    def f(x, w, gamma, beta):
+        return conv3x3_bn_relu_ref(x, w, gamma, beta)[0]
+
+    _, vjp = jax.vjp(f, x, w, gamma, beta)
+    dx_ref, dw_ref, dg_ref, db_ref = vjp(da)
+    _, y, mean, var = conv3x3_bn_relu_ref(x, w, gamma, beta)
+    dx, dw, dg, db = fused_conv3x3_bn_relu_bwd(
+        da, x, y, w, gamma, beta, mean, var, interpret=True)
+    for name, got, want in [("dx", dx, dx_ref), ("dw", dw, dw_ref),
+                            ("dgamma", dg, dg_ref), ("dbeta", db, db_ref)]:
+        onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                    rtol=5e-4, atol=5e-4, err_msg=name)
+
+
+def test_custom_vjp_composite():
+    """jax.vjp through fused_cbr_train uses the Pallas backward."""
+    N, H, W, C = 2, 6, 6, 8
+    x = jnp.asarray(RNG.randn(N, H, W, C), jnp.float32)
+    w = jnp.asarray(RNG.randn(3, 3, C, C) * 0.2, jnp.float32)
+    gamma = jnp.asarray(RNG.rand(C) + 0.5, jnp.float32)
+    beta = jnp.asarray(RNG.randn(C) * 0.1, jnp.float32)
+    da = jnp.asarray(RNG.randn(N, H, W, C), jnp.float32)
+
+    def ref(x, w, g, b):
+        return conv3x3_bn_relu_ref(x, w, g, b)[0]
+
+    def fused(x, w, g, b):
+        return fused_cbr_train(x, w, g, b, 1e-5, True)[0]
+
+    _, vjp_r = jax.vjp(ref, x, w, gamma, beta)
+    _, vjp_f = jax.vjp(fused, x, w, gamma, beta)
+    for r, f_ in zip(vjp_r(da), vjp_f(da)):
+        onp.testing.assert_allclose(onp.asarray(f_), onp.asarray(r),
+                                    rtol=5e-4, atol=5e-4)
+
+
+def _grads(blk, xv, fused):
+    config.set("fused_conv_bn", "on" if fused else "off")
+    try:
+        x = mx.np.array(xv)
+        x.attach_grad()
+        with mx.autograd.record():
+            out = blk(x)
+            loss = (out * out).sum()
+        loss.backward()
+        return (out.asnumpy(), x.grad.asnumpy(),
+                {k: p.grad().asnumpy() for k, p in
+                 blk.collect_params().items() if p.grad_req != "null"})
+    finally:
+        config.set("fused_conv_bn", "auto")
+
+
+def test_block_level_parity():
+    """BasicBlockV1 fused vs unfused: forward, input grad, param grads."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BasicBlockV1
+    mx.random.seed(0)
+    blk = BasicBlockV1(16, 1, False, 16)
+    blk.initialize()
+    xv = RNG.randn(2, 16, 10, 10).astype("float32")
+    blk(mx.np.array(xv))
+    o0, dx0, g0 = _grads(blk, xv, fused=False)
+    o1, dx1, g1 = _grads(blk, xv, fused=True)
+    onp.testing.assert_allclose(o1, o0, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(dx1, dx0, rtol=1e-3, atol=1e-3)
+    for k in g0:
+        onp.testing.assert_allclose(
+            g1[k], g0[k], rtol=2e-3, atol=2e-3, err_msg=k)
+
+
+def test_running_stats_update_matches():
+    blk = nn.FusableSequential()
+    blk.add(nn.Conv2D(8, 3, padding=1, use_bias=False), nn.BatchNorm(),
+            nn.Activation("relu"))
+    blk.initialize()
+    xv = RNG.randn(2, 8, 6, 6).astype("float32")
+    blk(mx.np.array(xv))
+    bn = blk[1]
+    config.set("fused_conv_bn", "on")
+    try:
+        with mx.autograd.record():
+            blk(mx.np.array(xv))
+        rm_f = bn.running_mean.data().asnumpy().copy()
+        rv_f = bn.running_var.data().asnumpy().copy()
+        bn.running_mean.set_data(mx.np.zeros((8,)))
+        bn.running_var.set_data(mx.np.ones((8,)))
+        config.set("fused_conv_bn", "off")
+        with mx.autograd.record():
+            blk(mx.np.array(xv))
+        onp.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm_f,
+                                    rtol=1e-4, atol=1e-5)
+        onp.testing.assert_allclose(bn.running_var.data().asnumpy(), rv_f,
+                                    rtol=1e-4, atol=1e-5)
+    finally:
+        config.set("fused_conv_bn", "auto")
+
+
+def test_eval_and_ineligible_fall_back():
+    """Outside training the fused path must not run (running stats frozen,
+    inference BN); stride-2 / 7x7 convs never fuse."""
+    from mxnet_tpu.gluon.nn.fuse import _eligible_triplet
+    c3 = nn.Conv2D(8, 3, padding=1, use_bias=False)
+    c3s2 = nn.Conv2D(8, 3, strides=2, padding=1, use_bias=False)
+    c7 = nn.Conv2D(8, 7, padding=3, use_bias=False)
+    cb = nn.Conv2D(8, 3, padding=1, use_bias=True)
+    bn, act = nn.BatchNorm(), nn.Activation("relu")
+    assert _eligible_triplet(c3, bn, act)
+    assert not _eligible_triplet(c3s2, bn, act)
+    assert not _eligible_triplet(c7, bn, act)
+    assert not _eligible_triplet(cb, bn, act)
+    assert not _eligible_triplet(c3, bn, nn.Activation("tanh"))
+    assert not _eligible_triplet(c3, nn.BatchNormReLU(), act)
+
+    blk = nn.FusableSequential()
+    blk.add(c3, bn, act)
+    blk.initialize()
+    xv = RNG.randn(2, 8, 6, 6).astype("float32")
+    blk(mx.np.array(xv))
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    config.set("fused_conv_bn", "on")
+    try:
+        out = blk(mx.np.array(xv))   # eval mode: no fusion, stats frozen
+        onp.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm0)
+    finally:
+        config.set("fused_conv_bn", "auto")
+
+
+def test_resnet_trains_with_fused_path():
+    """Loss decreases over a few fused steps and stays finite (the e2e
+    chaotic-conditioning caveat rules out elementwise parity here)."""
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    config.set("fused_conv_bn", "on")
+    try:
+        mx.random.seed(0)
+        net = get_resnet(1, 18)
+        net.initialize()
+        xv = RNG.uniform(size=(4, 3, 32, 32)).astype("float32")
+        yv = onp.arange(4) % 3
+        net(mx.np.array(xv))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        first = None
+        for _ in range(6):
+            with mx.autograd.record():
+                loss = loss_fn(net(mx.np.array(xv)), mx.np.array(yv)).mean()
+            loss.backward()
+            tr.step(4)
+            first = first if first is not None else float(loss)
+        assert onp.isfinite(float(loss))
+        assert float(loss) < first
+    finally:
+        config.set("fused_conv_bn", "auto")
