@@ -1,0 +1,73 @@
+"""Adversarial example generation with FGSM.
+
+Reference parity: example/adversary/adversary_generation.ipynb (fast
+gradient sign method of Goodfellow 2014 against an MNIST-style MLP).
+TPU-native: the attack gradient comes from autograd.record over the input
+(attach_grad on the data batch), all compute lowering to XLA.
+
+Run: python example/adversary_fgsm.py [--epochs N] [--eps 0.15]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def synthetic_mnist(n, rng):
+    """Blob-per-class synthetic stand-in (the provisioned environment has
+    no dataset downloads; swap for gluon.data.vision.MNIST when online)."""
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 1, 28, 28).astype("float32") * 0.1
+    for i in range(n):
+        c = y[i]
+        x[i, 0, 2 * c:2 * c + 6, 4:24] += 0.9
+    return x, y.astype("int32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--eps", type=float, default=0.15)
+    ap.add_argument("--n", type=int, default=512)
+    args = ap.parse_args()
+
+    rng = onp.random.RandomState(0)
+    xv, yv = synthetic_mnist(args.n, rng)
+
+    net = nn.Sequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    x, y = mx.np.array(xv), mx.np.array(yv)
+    for epoch in range(args.epochs):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(args.n)
+        print(f"epoch {epoch}: loss {float(loss):.4f}")
+
+    def accuracy(batch):
+        pred = mx.np.argmax(net(batch), axis=-1).asnumpy()
+        return float((pred == yv).mean())
+
+    # FGSM: x_adv = x + eps * sign(dL/dx)
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y).mean()
+    loss.backward()
+    x_adv = mx.np.clip(x + args.eps * mx.np.sign(x.grad), 0.0, 1.0)
+
+    clean, adv = accuracy(x), accuracy(x_adv)
+    print(f"clean accuracy: {clean:.3f}   FGSM(eps={args.eps}): {adv:.3f}")
+    assert adv < clean, "the attack should reduce accuracy"
+
+
+if __name__ == "__main__":
+    main()

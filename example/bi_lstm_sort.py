@@ -1,0 +1,70 @@
+"""Sorting short digit sequences with a bidirectional LSTM.
+
+Reference parity: example/bi-lstm-sort/bi-lstm-sort.ipynb — the classic
+"read a sequence of digits, emit them sorted" seq-level task showing
+BidirectionalCell.unroll over embedded tokens.
+
+Run: python example/bi_lstm_sort.py [--steps N]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn, rnn
+
+
+class BiLSTMSorter(gluon.Block):
+    def __init__(self, vocab=10, hidden=64, seq_len=5):
+        super().__init__()
+        self.seq_len = seq_len
+        self.embed = nn.Embedding(vocab, 32)
+        self.bilstm = rnn.BidirectionalCell(rnn.LSTMCell(hidden),
+                                            rnn.LSTMCell(hidden))
+        self.head = nn.Dense(vocab, flatten=False)
+
+    def forward(self, tokens):                     # (N, T) int
+        emb = self.embed(tokens)                   # (N, T, 32)
+        out, _ = self.bilstm.unroll(self.seq_len, emb, layout="NTC",
+                                    merge_outputs=True)
+        return self.head(out)                      # (N, T, vocab)
+
+
+def batch(rng, n, seq_len):
+    x = rng.randint(0, 10, (n, seq_len)).astype("int32")
+    return x, onp.sort(x, axis=1).astype("int32")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=5)
+    args = ap.parse_args()
+
+    rng = onp.random.RandomState(0)
+    net = BiLSTMSorter(seq_len=args.seq_len)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for step in range(args.steps):
+        xv, yv = batch(rng, args.batch, args.seq_len)
+        x, y = mx.np.array(xv), mx.np.array(yv)
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(args.batch)
+        if step % 100 == 0 or step == args.steps - 1:
+            xv, yv = batch(rng, 256, args.seq_len)
+            pred = mx.np.argmax(net(mx.np.array(xv)), axis=-1).asnumpy()
+            acc = float((pred == yv).all(axis=1).mean())
+            print(f"step {step}: loss {float(loss):.4f} "
+                  f"exact-sort accuracy {acc:.3f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

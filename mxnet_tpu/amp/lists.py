@@ -26,6 +26,7 @@ FP32_OPS = [
     "sum", "mean", "var", "std", "norm", "cumsum", "prod", "nansum",
     "exp", "expm1", "log", "log1p", "log2", "log10", "erf", "erfinv",
     "gamma", "gammaln", "digamma", "sqrt", "cbrt",
+    "arccos", "arcsin", "arctanh", "arccosh", "cosh", "sinh", "tan",
     "softmax_cross_entropy", "smooth_l1", "ctc_loss", "softmax_output",
     "linear_regression_output", "logistic_regression_output",
     "mae_regression_output", "make_loss",
@@ -42,11 +43,16 @@ CONDITIONAL_FP32_OPS = [
 ]
 
 # elementwise combiners: cast mixed floating inputs to the widest dtype
-# present (reference: WIDEST_TYPE_CASTS via amp_multicast)
+# present (reference: WIDEST_TYPE_CASTS via amp_multicast,
+# symbol_fp16.py:629-688 — the full npi tail)
 WIDEST_TYPE_CASTS = [
     "add", "subtract", "multiply", "true_divide", "divide", "where",
-    "maximum", "minimum", "hypot", "mod",
-    "concatenate", "stack",
+    "maximum", "minimum", "fmax", "fmin", "fmod", "hypot", "mod",
+    "remainder", "copysign", "cross", "kron", "ldexp", "arctan2",
+    "ediff1d", "logical_and", "logical_or", "logical_xor",
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "concatenate", "stack", "column_stack", "vstack", "hstack", "dstack",
+    "dot", "inner", "outer", "vdot",
 ]
 
 

@@ -812,3 +812,310 @@ def _register_broadcast_aliases():
 
 
 _register_broadcast_aliases()
+
+
+# -- legacy linalg_* family ---------------------------------------------------
+# Reference: src/operator/tensor/la_op.cc (_linalg_gemm ... _linalg_slogdet),
+# exposed to 1.x scripts as nd.linalg_gemm / nd.linalg.gemm. All ops operate
+# on the last two axes and batch over the rest (jnp broadcasting native).
+
+def _register_linalg():
+    # jax imports stay lazy (inside _lin, called from op bodies) like every
+    # other adapter in this file — package import must not pay jax startup
+    def _lin():
+        import jax.numpy as jnp
+        from jax.scipy.linalg import solve_triangular
+
+        from ..numpy.multiarray import _invoke
+        return jnp, solve_triangular, _invoke
+
+    def gemm(A, B, C=None, transpose_a=False, transpose_b=False, alpha=1.0,
+             beta=1.0, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        a, b = _lit(alpha), _lit(beta)
+        ta, tb = _b(transpose_a), _b(transpose_b)
+
+        def t(x, f):
+            return jnp.swapaxes(x, -1, -2) if f else x
+        if C is None:
+            return _invoke(lambda x, y: a * jnp.matmul(t(x, ta), t(y, tb)),
+                           (A, B), name="linalg_gemm")
+        return _invoke(
+            lambda x, y, c: a * jnp.matmul(t(x, ta), t(y, tb)) + b * c,
+            (A, B, C), name="linalg_gemm")
+
+    def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        a, ta, tb = _lit(alpha), _b(transpose_a), _b(transpose_b)
+
+        def t(x, f):
+            return jnp.swapaxes(x, -1, -2) if f else x
+        return _invoke(lambda x, y: a * jnp.matmul(t(x, ta), t(y, tb)),
+                       (A, B), name="linalg_gemm2")
+
+    def potrf(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        return _invoke(jnp.linalg.cholesky, (A,), name="linalg_potrf")
+
+    def potri(A, **kw):
+        """Inverse of the SPD matrix from its Cholesky factor L:
+        (L L^T)^-1 (reference: la_op.cc potri)."""
+        jnp, solve_triangular, _invoke = _lin()
+        _drop_name(kw)
+
+        def fn(L):
+            eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype),
+                                   L.shape)
+            Linv = solve_triangular(L, eye, lower=True)
+            return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+        return _invoke(fn, (A,), name="linalg_potri")
+
+    def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+             **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        a, tr, rs, lo = _lit(alpha), _b(transpose), _b(rightside), _b(lower)
+
+        def fn(A_, B_):
+            # BLAS trmm contract: only the named triangle of A is read
+            T = jnp.tril(A_) if lo else jnp.triu(A_)
+            T = jnp.swapaxes(T, -1, -2) if tr else T
+            return a * (jnp.matmul(B_, T) if rs else jnp.matmul(T, B_))
+        return _invoke(fn, (A, B), name="linalg_trmm")
+
+    def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+             **kw):
+        jnp, solve_triangular, _invoke = _lin()
+        _drop_name(kw)
+        a, tr, rs, lo = _lit(alpha), _b(transpose), _b(rightside), _b(lower)
+
+        def fn(A_, B_):
+            if rs:
+                # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T; scipy's
+                # trans flag applies the extra transpose without moving data
+                xt = solve_triangular(A_, jnp.swapaxes(a * B_, -1, -2),
+                                      lower=lo, trans=0 if tr else 1)
+                return jnp.swapaxes(xt, -1, -2)
+            return solve_triangular(A_, a * B_, lower=lo,
+                                    trans=1 if tr else 0)
+        return _invoke(fn, (A, B), name="linalg_trsm")
+
+    def sumlogdiag(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        return _invoke(
+            lambda x: jnp.sum(jnp.log(jnp.diagonal(x, axis1=-2, axis2=-1)),
+                              axis=-1), (A,), name="linalg_sumlogdiag")
+
+    def extractdiag(A, offset=0, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        o = int(_lit(offset))
+        return _invoke(lambda x: jnp.diagonal(x, offset=o, axis1=-2,
+                                              axis2=-1), (A,),
+                       name="linalg_extractdiag")
+
+    def makediag(A, offset=0, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        o = int(_lit(offset))
+
+        def fn(x):
+            n = x.shape[-1] + abs(o)
+            out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+            idx = jnp.arange(x.shape[-1])
+            r = idx + max(-o, 0)
+            c = idx + max(o, 0)
+            return out.at[..., r, c].set(x)
+        return _invoke(fn, (A,), name="linalg_makediag")
+
+    def _trian_count(n, o, lo):
+        import numpy as _onp
+        tri = _onp.tril(_onp.ones((n, n)), k=o) if lo \
+            else _onp.triu(_onp.ones((n, n)), k=o)
+        return int(tri.sum())
+
+    def extracttrian(A, offset=0, lower=True, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        o, lo = int(_lit(offset)), _b(lower)
+
+        def fn(x):
+            n = x.shape[-1]
+            r, c = jnp.tril_indices(n, k=o) if lo else \
+                jnp.triu_indices(n, k=o)
+            return x[..., r, c]
+        return _invoke(fn, (A,), name="linalg_extracttrian")
+
+    def maketrian(A, offset=0, lower=True, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        o, lo = int(_lit(offset)), _b(lower)
+
+        def fn(x):
+            m = x.shape[-1]
+            # invert the extracttrian packing: smallest n whose triangle
+            # (with this offset) holds exactly m elements
+            n = 1
+            while _trian_count(n, o, lo) < m:
+                n += 1
+            if _trian_count(n, o, lo) != m:
+                raise MXNetError(
+                    f"maketrian: {m} packed elements do not form a "
+                    f"triangle with offset {o}")
+            r, c = jnp.tril_indices(n, k=o) if lo else \
+                jnp.triu_indices(n, k=o)
+            out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+            return out.at[..., r, c].set(x)
+        return _invoke(fn, (A,), name="linalg_maketrian")
+
+    def syrk(A, transpose=False, alpha=1.0, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        a, tr = _lit(alpha), _b(transpose)
+
+        def fn(x):
+            xt = jnp.swapaxes(x, -1, -2)
+            return a * (jnp.matmul(xt, x) if tr else jnp.matmul(x, xt))
+        return _invoke(fn, (A,), name="linalg_syrk")
+
+    def syevd(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+
+        def fn(x):
+            w, u = jnp.linalg.eigh(x)
+            return jnp.swapaxes(u, -1, -2), w   # reference returns (U, L)
+        return _invoke(fn, (A,), name="linalg_syevd")
+
+    def gelqf(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+
+        def fn(x):
+            # LQ of (m, n), m <= n: A = L Q with Q row-orthonormal
+            q, r = jnp.linalg.qr(jnp.swapaxes(x, -1, -2), mode="reduced")
+            return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+        return _invoke(fn, (A,), name="linalg_gelqf")
+
+    def inverse(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        return _invoke(jnp.linalg.inv, (A,), name="linalg_inverse")
+
+    def det(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        return _invoke(jnp.linalg.det, (A,), name="linalg_det")
+
+    def slogdet(A, **kw):
+        jnp, _, _invoke = _lin()
+        _drop_name(kw)
+        return _invoke(lambda x: tuple(jnp.linalg.slogdet(x)), (A,),
+                       name="linalg_slogdet")
+
+    for name, fn in [("gemm", gemm), ("gemm2", gemm2), ("potrf", potrf),
+                     ("potri", potri), ("trmm", trmm), ("trsm", trsm),
+                     ("sumlogdiag", sumlogdiag),
+                     ("extractdiag", extractdiag), ("makediag", makediag),
+                     ("extracttrian", extracttrian),
+                     ("maketrian", maketrian), ("syrk", syrk),
+                     ("syevd", syevd), ("gelqf", gelqf),
+                     ("inverse", inverse), ("det", det),
+                     ("slogdet", slogdet)]:
+        fn.__name__ = f"linalg_{name}"
+        LEGACY_OPS[f"linalg_{name}"] = fn
+
+
+_register_linalg()
+
+
+# -- spatial sampling (1.x vision ops) ---------------------------------------
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, **kw):
+    """Reference: src/operator/bilinear_sampler.cc — sample NCHW data at
+    normalized grid coords in [-1, 1]; grid (N, 2, Ho, Wo) rows (x, y).
+    Out-of-range samples read 0 (same zero-padding contract as the
+    deformable-conv kernel, ops/deformable.py)."""
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+
+    _drop_name(kw)
+
+    def fn(x, g):
+        N, C, H, W = x.shape
+        gx = (g[:, 0] + 1.0) * (W - 1) / 2.0      # (N, Ho, Wo)
+        gy = (g[:, 1] + 1.0) * (H - 1) / 2.0
+        x0, y0 = jnp.floor(gx), jnp.floor(gy)
+        wx, wy = gx - x0, gy - y0
+        flat = x.reshape(N, C, H * W)
+
+        def corner(cy, cx):
+            inside = (cy >= 0) & (cy < H) & (cx >= 0) & (cx < W)
+            idx = (jnp.clip(cy, 0, H - 1).astype(jnp.int32) * W
+                   + jnp.clip(cx, 0, W - 1).astype(jnp.int32))
+            v = jnp.take_along_axis(
+                flat, jnp.broadcast_to(idx[:, None].reshape(N, 1, -1),
+                                       (N, C, idx[0].size)), axis=-1)
+            return v.reshape(x.shape[:2] + cy.shape[1:]) \
+                * inside[:, None].astype(x.dtype)
+
+        v00 = corner(y0, x0)
+        v01 = corner(y0, x0 + 1)
+        v10 = corner(y0 + 1, x0)
+        v11 = corner(y0 + 1, x0 + 1)
+        wx_, wy_ = wx[:, None].astype(x.dtype), wy[:, None].astype(x.dtype)
+        return (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    return _invoke(fn, (data, grid), name="BilinearSampler")
+
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=None, **kw):
+    """Reference: src/operator/grid_generator.cc. affine: data (N, 6) ->
+    grid (N, 2, H, W) of normalized (x, y); warp: data IS the flow field."""
+    import jax.numpy as jnp
+
+    from ..numpy.multiarray import _invoke
+
+    _drop_name(kw)
+    tt = _lit(transform_type)
+    shape = _tup(target_shape) if target_shape is not None else None
+
+    def fn(d):
+        if tt == "warp":
+            N, _two, H, W = d.shape
+            xs = jnp.linspace(-1, 1, W)
+            ys = jnp.linspace(-1, 1, H)
+            base_x = jnp.broadcast_to(xs, (H, W))
+            base_y = jnp.broadcast_to(ys[:, None], (H, W))
+            gx = base_x + d[:, 0] * 2.0 / max(W - 1, 1)
+            gy = base_y + d[:, 1] * 2.0 / max(H - 1, 1)
+            return jnp.stack([gx, gy], axis=1)
+        H, W = shape
+        theta = d.reshape(-1, 2, 3)
+        xs = jnp.linspace(-1, 1, W)
+        ys = jnp.linspace(-1, 1, H)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, coords)              # (N,2,HW)
+        return out.reshape(-1, 2, H, W)
+    return _invoke(fn, (data,), name="GridGenerator")
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=None,
+                         transform_type="affine", sampler_type="bilinear",
+                         **kw):
+    """Reference: src/operator/spatial_transformer.cc = GridGenerator +
+    BilinearSampler."""
+    _drop_name(kw)
+    grid = _grid_generator(loc, transform_type=transform_type,
+                           target_shape=target_shape)
+    return _bilinear_sampler(data, grid)

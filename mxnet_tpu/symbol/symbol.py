@@ -70,7 +70,39 @@ class Symbol:
         return _make("slice_index", self, index=index)
 
     def attr(self, key):
+        if key in getattr(self, "_attrs", {}):
+            return self._attrs[key]
         return self._kwargs.get(key)
+
+    # -- user attributes (reference: symbol.py list_attr:611, attr_dict:634,
+    # _set_attr:665 — the attr-dict graph-surgery surface) ------------------
+    def _set_attr(self, **kwargs):
+        """Attach/overwrite string attributes on this node (the reference's
+        MXSymbolSetAttr; used for __lr_mult__-style graph annotations)."""
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                raise MXNetError(
+                    f"Set Attr only accepts string values, got {type(v)} "
+                    f"for key {k!r}")
+        if not hasattr(self, "_attrs"):
+            self._attrs = {}
+        self._attrs.update(kwargs)
+
+    def list_attr(self, recursive=False):
+        if recursive:
+            raise MXNetError(
+                "list_attr(recursive=True) was deprecated in the reference; "
+                "use attr_dict()")
+        return dict(getattr(self, "_attrs", {}))
+
+    def attr_dict(self):
+        """{node_name: {attr: value}} over the whole graph."""
+        out = {}
+        for s in self._topo():
+            attrs = dict(getattr(s, "_attrs", {}))
+            if attrs:
+                out[s.name] = attrs
+        return out
 
     # -- introspection ------------------------------------------------------
     def _topo(self):
@@ -119,6 +151,77 @@ class Symbol:
                       (out if isinstance(out, (list, tuple)) else [out])]
         arg_shapes = [tuple(kwargs[n]) for n in args]
         return arg_shapes, out_shapes, []
+
+    def infer_type(self, **kwargs):
+        """Dtype inference (reference: symbol.py infer_type:898 over
+        nnvm InferType). Propagates dtypes through the DAG: arithmetic
+        follows jnp.result_type promotion; op-specific rules (Cast,
+        comparisons, index-producing ops) come from a small table. Args
+        without a given dtype default to float32 like the reference."""
+        return self._infer_type_impl(kwargs, partial=False)
+
+    def infer_type_partial(self, **kwargs):
+        """Like infer_type but unknown inputs stay None (reference:
+        symbol.py infer_type_partial:967)."""
+        return self._infer_type_impl(kwargs, partial=True)
+
+    _TYPE_RULES = {
+        "Cast": "dtype", "cast": "dtype", "amp_cast": "dtype",
+        **{n: "bool" for n in (
+            "equal", "not_equal", "greater", "greater_equal", "less",
+            "less_equal", "logical_and", "logical_or", "logical_xor",
+            "logical_not", "isnan", "isinf", "isfinite",
+            "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+            "broadcast_greater_equal", "broadcast_lesser",
+            "broadcast_lesser_equal", "broadcast_logical_and",
+            "broadcast_logical_or", "broadcast_logical_xor")},
+        **{n: "int" for n in ("argmax", "argmin", "argsort",
+                              "argmax_channel")},
+    }
+
+    def _infer_type_impl(self, given, partial):
+        import jax.numpy as jnp
+        import numpy as onp
+
+        from ..base import np_dtype
+        dts = {}
+        for node in self._topo():
+            if node._op is None:
+                dt = np_dtype(given.get(node.name))
+                if dt is None and not partial:
+                    dt = onp.float32
+                dts[id(node)] = dt
+                continue
+            rule = self._TYPE_RULES.get(node._op)
+            ins = [dts[id(i)] for i in node._inputs]
+            if rule == "dtype":
+                dts[id(node)] = np_dtype(node._kwargs.get("dtype")) \
+                    or onp.float32
+            elif rule == "bool":
+                dts[id(node)] = onp.bool_
+            elif rule == "int":
+                dts[id(node)] = onp.int64
+            else:
+                known = [d for d in ins if d is not None]
+                dts[id(node)] = (onp.dtype(jnp.result_type(*known)).type
+                                 if known else (None if partial
+                                                else onp.float32))
+        arg_types = [dts[id(s)] for s in self._topo() if s._op is None]
+        return arg_types, [dts[id(self)]], []
+
+    def gradient(self, wrt):
+        """Autodiff symbol: evaluates to the gradients of this (scalar)
+        symbol w.r.t. the named arguments. The reference declares this API
+        but never implemented it (symbol.py:1879 'currently not
+        implemented'); here jax.grad makes it real. Returns a symbol whose
+        eval yields one array per name in ``wrt``."""
+        if isinstance(wrt, str):
+            wrt = [wrt]
+        args = self.list_arguments()
+        for n in wrt:
+            if n not in args:
+                raise MXNetError(f"gradient wrt unknown argument {n!r}")
+        return _GradSymbol(self, tuple(wrt))
 
     # -- evaluation ---------------------------------------------------------
     def _eval_with(self, bindings):
@@ -222,6 +325,41 @@ class Symbol:
 
     def __repr__(self):
         return f"<Symbol {self.name}>"
+
+
+class _GradSymbol(Symbol):
+    """Symbol computing d(base)/d(wrt args) via jax.grad at eval time."""
+
+    def __init__(self, base, wrt):
+        super().__init__("_gradient", [base], {"wrt": wrt},
+                         name=f"{base.name}_grad")
+        self._base = base
+        self._wrt = wrt
+
+    def _eval_with(self, bindings):
+        import jax
+
+        from ..numpy.multiarray import _wrap, ndarray as _nd
+        raws = {k: (v._data if isinstance(v, _nd) else v)
+                for k, v in bindings.items()}
+
+        def loss(wrt_vals):
+            b = dict(raws)
+            b.update(wrt_vals)
+            out = self._base._eval_with(
+                {k: _wrap(v) for k, v in b.items()})
+            res = out._data if isinstance(out, _nd) else out
+            if res.ndim:
+                raise MXNetError(
+                    "gradient() needs a scalar head symbol; got shape "
+                    f"{res.shape}")
+            return res
+
+        grads = jax.grad(loss)({k: raws[k] for k in self._wrt})
+        return [_wrap(grads[k]) for k in self._wrt]
+
+    def list_outputs(self):
+        return [f"{n}_grad" for n in self._wrt]
 
 
 class Group(Symbol):

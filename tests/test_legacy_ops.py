@@ -221,3 +221,101 @@ def test_nd_npx_fallback():
     assert out.shape == (3, 2)
     out = nd.topk(x, k=2)
     assert out.shape == (3, 2)
+
+
+def test_legacy_linalg_family():
+    """nd.linalg_* (reference: src/operator/tensor/la_op.cc) value locks."""
+    rng = onp.random.RandomState(0)
+    A = rng.randn(3, 3).astype(onp.float32)
+    SPD = (A @ A.T + 3 * onp.eye(3)).astype(onp.float32)
+    B = rng.randn(3, 2).astype(onp.float32)
+    Br = rng.randn(2, 3).astype(onp.float32)
+    L = nd.linalg_potrf(nd.array(SPD)).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, SPD, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        nd.linalg_gemm2(nd.array(A), nd.array(B), alpha=2.0).asnumpy(),
+        2 * A @ B, rtol=1e-5)
+    C0 = rng.randn(3, 2).astype(onp.float32)
+    onp.testing.assert_allclose(
+        nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C0),
+                       beta=0.5).asnumpy(), A @ B + 0.5 * C0, rtol=1e-5)
+    onp.testing.assert_allclose(
+        nd.linalg_potri(nd.array(L)).asnumpy() @ SPD, onp.eye(3), atol=1e-3)
+    X = nd.linalg_trsm(nd.array(L), nd.array(B)).asnumpy()
+    onp.testing.assert_allclose(L @ X, B, atol=1e-4)
+    X = nd.linalg_trsm(nd.array(L), nd.array(Br), rightside=True,
+                       transpose=True).asnumpy()
+    onp.testing.assert_allclose(X @ L.T, Br, atol=1e-4)
+    onp.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(L), nd.array(B)).asnumpy(), L @ B,
+        rtol=1e-5)
+    onp.testing.assert_allclose(
+        float(nd.linalg_sumlogdiag(nd.array(SPD)).asnumpy()),
+        onp.log(onp.diag(SPD)).sum(), rtol=1e-5)
+    d = nd.linalg_extractdiag(nd.array(SPD)).asnumpy()
+    onp.testing.assert_allclose(
+        nd.linalg_makediag(nd.array(d)).asnumpy(),
+        onp.diag(onp.diag(SPD)))
+    tr = nd.linalg_extracttrian(nd.array(SPD)).asnumpy()
+    onp.testing.assert_allclose(
+        nd.linalg_maketrian(nd.array(tr)).asnumpy(), onp.tril(SPD),
+        atol=1e-6)
+    onp.testing.assert_allclose(
+        nd.linalg_syrk(nd.array(B)).asnumpy(), B @ B.T, rtol=1e-5)
+    Ut, w = nd.linalg_syevd(nd.array(SPD))
+    onp.testing.assert_allclose(
+        (Ut.asnumpy().T * w.asnumpy()) @ Ut.asnumpy(), SPD, atol=1e-3)
+    Lq, Q = nd.linalg_gelqf(nd.array(Br))
+    onp.testing.assert_allclose(Lq.asnumpy() @ Q.asnumpy(), Br, atol=1e-4)
+    onp.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, onp.eye(2),
+                                atol=1e-5)
+    onp.testing.assert_allclose(
+        nd.linalg_inverse(nd.array(SPD)).asnumpy() @ SPD, onp.eye(3),
+        atol=1e-3)
+    s, ld = nd.linalg_slogdet(nd.array(SPD))
+    onp.testing.assert_allclose(float(ld.asnumpy()),
+                                onp.linalg.slogdet(SPD)[1], rtol=1e-4)
+
+
+def test_legacy_spatial_samplers():
+    """BilinearSampler / GridGenerator / SpatialTransformer (reference:
+    src/operator/bilinear_sampler.cc, grid_generator.cc,
+    spatial_transformer.cc): identity-grid and shift oracles."""
+    rng = onp.random.RandomState(0)
+    x = rng.randn(1, 2, 5, 5).astype(onp.float32)
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, 5), onp.linspace(-1, 1, 5),
+                          indexing="ij")
+    grid = onp.stack([xs, ys])[None].astype(onp.float32)
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    onp.testing.assert_allclose(out, x, atol=1e-5)
+    theta = onp.array([[1, 0, 0, 0, 1, 0]], onp.float32)
+    g = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                         target_shape=(5, 5)).asnumpy()
+    onp.testing.assert_allclose(g[0, 0], xs, atol=1e-6)
+    st = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                               target_shape=(5, 5)).asnumpy()
+    onp.testing.assert_allclose(st, x, atol=1e-5)
+    # x-translation by one pixel (affine tx = 2/(W-1))
+    theta_t = onp.array([[1, 0, 2.0 / 4, 0, 1, 0]], onp.float32)
+    st = nd.SpatialTransformer(nd.array(x), nd.array(theta_t),
+                               target_shape=(5, 5)).asnumpy()
+    onp.testing.assert_allclose(st[..., :4], x[..., 1:], atol=1e-5)
+
+
+def test_legacy_linalg_triangle_offsets():
+    """maketrian/extracttrian roundtrip at nonzero offsets; trmm reads only
+    the named triangle (BLAS contract) — round-4 review regressions."""
+    rng = onp.random.RandomState(1)
+    A = rng.randn(4, 4).astype(onp.float32)
+    for o, lo in [(1, True), (-1, True), (1, False), (-2, False)]:
+        tr = nd.linalg_extracttrian(nd.array(A), offset=o, lower=lo).asnumpy()
+        mt = nd.linalg_maketrian(nd.array(tr), offset=o, lower=lo).asnumpy()
+        want = onp.tril(A, o) if lo else onp.triu(A, o)
+        onp.testing.assert_allclose(mt, want, atol=1e-6)
+    B = rng.randn(4, 3).astype(onp.float32)
+    onp.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(A), nd.array(B)).asnumpy(),
+        onp.tril(A) @ B, rtol=1e-5)
+    onp.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(A), nd.array(B), lower=False).asnumpy(),
+        onp.triu(A) @ B, rtol=1e-5)

@@ -2,6 +2,7 @@
 composition, list_arguments, infer_shape, eval-vs-imperative equality,
 json round-trip, executor forward/backward)."""
 import numpy as onp
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import numpy as np
@@ -127,3 +128,48 @@ def test_symbol_optimize_for_bf16():
     from mxnet_tpu.base import MXNetError
     with pytest.raises(MXNetError):
         net.optimize_for("tensorrt")
+
+
+def test_infer_type_and_partial():
+    """Reference: symbol.py infer_type:898 / infer_type_partial:967."""
+    a, b = sym.Variable("a"), sym.Variable("b")
+    e = sym.Cast(a, dtype="float16") + b
+    arg_t, out_t, aux_t = e.infer_type(a="float16", b="float32")
+    assert out_t == [onp.float32] and aux_t == []
+    _, out_t, _ = e.infer_type(a="float16", b="float16")
+    assert out_t == [onp.float16]
+    # defaults are float32 like the reference
+    _, out_t, _ = (a + b).infer_type()
+    assert out_t == [onp.float32]
+    # comparison -> bool; argmax -> int
+    _, out_t, _ = sym.argmax(a).infer_type_partial()
+    assert out_t[0] == onp.int64
+    _, out_t, _ = e.infer_type_partial(a="float16")
+    assert out_t == [onp.float16]
+
+
+def test_attr_mutation_surface():
+    """Reference: _set_attr:665 / list_attr:611 / attr_dict:634."""
+    a = sym.Variable("a")
+    d = a * 2
+    a._set_attr(__lr_mult__="2.0", __wd_mult__="0.5")
+    assert a.attr("__lr_mult__") == "2.0"
+    assert a.list_attr() == {"__lr_mult__": "2.0", "__wd_mult__": "0.5"}
+    assert d.attr_dict()["a"]["__wd_mult__"] == "0.5"
+    with pytest.raises(mx.MXNetError):
+        a._set_attr(x=1)  # non-string rejected, like MXSymbolSetAttr
+
+
+def test_symbol_gradient_eval():
+    """gradient(): declared-but-unimplemented in the reference
+    (symbol.py:1879); real here via jax.grad."""
+    x, w = sym.Variable("x"), sym.Variable("w")
+    loss = sym.sum((x * w) ** 2)
+    g = loss.gradient(["x", "w"])
+    xv = mx.np.array(onp.array([1.0, 2.0], onp.float32))
+    wv = mx.np.array(onp.array([3.0, -1.0], onp.float32))
+    gx, gw = g.eval(x=xv, w=wv)
+    onp.testing.assert_allclose(gx.asnumpy(), 2 * (xv * wv * wv).asnumpy())
+    onp.testing.assert_allclose(gw.asnumpy(), 2 * (xv * xv * wv).asnumpy())
+    with pytest.raises(mx.MXNetError):
+        (x * 2).gradient("nope")
