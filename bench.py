@@ -406,18 +406,22 @@ def main():
             # the CPU fallback shrinks every CNN row to one tiny config —
             # the batch-size grid rows would be identical duplicates
             continue
+        from mxnet_tpu import config as _cfg
+        fused_prior = _cfg.get("fused_conv_bn")
         row = None
-        for attempt in (1, 2, 3):  # retries: the tunneled platform can
-            try:                   # drop a heavy compile transiently
-                row = fn(on_cpu=on_cpu, peak=peak, **kwargs)
-                break
-            except Exception as e:  # a failed row must not kill the bench
-                err = repr(e)
-                if attempt == 2:
-                    # last resort: a Pallas-kernel compile failure must not
-                    # take the row down — measure the XLA path instead
-                    from mxnet_tpu import config as _cfg
-                    _cfg.set("fused_conv_bn", "off")
+        try:
+            for attempt in (1, 2, 3):  # retries: the tunneled platform can
+                try:                   # drop a heavy compile transiently
+                    row = fn(on_cpu=on_cpu, peak=peak, **kwargs)
+                    break
+                except Exception as e:  # failed row must not kill the bench
+                    err = repr(e)
+                    if attempt == 2:
+                        # last resort: a Pallas compile failure must not
+                        # take the row down — measure the XLA path instead
+                        _cfg.set("fused_conv_bn", "off")
+        finally:
+            _cfg.set("fused_conv_bn", fused_prior)  # per-row, not global
         if row is None:
             rows.append({"name": f"{fn.__name__}{kwargs}", "error": err})
             continue

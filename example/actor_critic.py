@@ -1,0 +1,120 @@
+"""Actor-critic policy gradient on a self-contained CartPole.
+
+Reference parity: example/gluon/actor_critic (REINFORCE with a learned
+value baseline). No gym in this environment, so the classic cart-pole
+dynamics (Barto 1983) are implemented inline with numpy; the policy/value
+net and the update are the framework path under test.
+
+Run: python example/actor_critic.py [--episodes N]
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+class CartPole:
+    """Minimal cart-pole (x, x_dot, theta, theta_dot); +1 reward per step,
+    episode ends when |theta| > 12deg or |x| > 2.4 or after 200 steps."""
+
+    def __init__(self, rng):
+        self.rng = rng
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype("float32")
+        self.t = 0
+        return self.s
+
+    def step(self, action):
+        g, mc, mp, lp, dt = 9.8, 1.0, 0.1, 0.5, 0.02
+        x, xd, th, thd = self.s
+        f = 10.0 if action == 1 else -10.0
+        costh, sinth = onp.cos(th), onp.sin(th)
+        temp = (f + mp * lp * thd ** 2 * sinth) / (mc + mp)
+        thacc = (g * sinth - costh * temp) / (
+            lp * (4.0 / 3.0 - mp * costh ** 2 / (mc + mp)))
+        xacc = temp - mp * lp * thacc * costh / (mc + mp)
+        self.s = onp.array([x + dt * xd, xd + dt * xacc,
+                            th + dt * thd, thd + dt * thacc], "float32")
+        self.t += 1
+        done = (abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.2095
+                or self.t >= 200)
+        return self.s, 1.0, done
+
+
+class ActorCritic(gluon.Block):
+    def __init__(self):
+        super().__init__()
+        self.trunk = nn.Dense(128, activation="relu")
+        self.policy = nn.Dense(2)
+        self.value = nn.Dense(1)
+
+    def forward(self, x):
+        h = self.trunk(x)
+        return self.policy(h), self.value(h)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=150)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    args = ap.parse_args()
+
+    rng = onp.random.RandomState(0)
+    env = CartPole(rng)
+    net = ActorCritic()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+
+    running = 10.0
+    for ep in range(args.episodes):
+        states, actions, rewards = [], [], []
+        s = env.reset()
+        done = False
+        while not done:
+            logits, _ = net(mx.np.array(s[None]))
+            p = mx.npx.softmax(logits, axis=-1).asnumpy()[0].astype("float64")
+            p /= p.sum()   # float64 renormalize for rng.choice's tolerance
+            a = int(rng.choice(2, p=p))
+            states.append(s)
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+
+        # discounted returns
+        R, rets = 0.0, []
+        for r in reversed(rewards):
+            R = r + args.gamma * R
+            rets.append(R)
+        rets.reverse()
+        rets = onp.asarray(rets, "float32")
+        rets = (rets - rets.mean()) / (rets.std() + 1e-6)
+
+        x = mx.np.array(onp.stack(states))
+        a = mx.np.array(onp.asarray(actions, "int32"))
+        g = mx.np.array(rets)
+        with mx.autograd.record():
+            logits, values = net(x)
+            logp = mx.npx.log_softmax(logits, axis=-1)
+            chosen = mx.npx.pick(logp, a)
+            adv = g - mx.np.squeeze(values, -1)
+            policy_loss = -(chosen * adv.detach()).mean()
+            value_loss = (adv * adv).mean()
+            loss = policy_loss + 0.5 * value_loss
+        loss.backward()
+        trainer.step(1)   # losses are already episode means
+
+        running = 0.95 * running + 0.05 * len(states)
+        if ep % 25 == 0 or ep == args.episodes - 1:
+            print(f"episode {ep}: length {len(states)} "
+                  f"(running avg {running:.1f})")
+    print("done; final running average episode length "
+          f"{running:.1f}")
+
+
+if __name__ == "__main__":
+    main()
