@@ -376,9 +376,33 @@ def bench_augmentation(precision, on_cpu, peak, bs=256, k_steps=8):
             "ms_per_step": sec * 1e3, "precision": "fp32"}
 
 
+def _probe_backend(timeout_s=240):
+    """The axon TPU tunnel can wedge so hard that jax.devices() never
+    returns (observed: multi-hour outage, round 4). Probe it in a
+    subprocess first; on failure pin this process to CPU BEFORE backend
+    init so the bench always produces a result."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices()[0]; print(d.platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu (tpu probe failed)"
+
+
 def main():
     import jax
 
+    probed = _probe_backend()
+    if "probe failed" in probed:
+        print(f"# backend probe: {probed}", flush=True)
     dev = jax.devices()[0]
     platform, on_cpu = dev.platform, dev.platform == "cpu"
     peak = _chip_peak(dev)

@@ -120,9 +120,10 @@ for _lax, _onnx in [
     ("neg", "Neg"), ("exp", "Exp"), ("log", "Log"), ("tanh", "Tanh"),
     ("logistic", "Sigmoid"), ("erf", "Erf"), ("sqrt", "Sqrt"),
     ("abs", "Abs"), ("sign", "Sign"), ("floor", "Floor"),
-    ("ceil", "Ceil"), ("round", "Round"), ("is_finite", None),
-    ("sin", "Sin"), ("cos", "Cos"), ("atan", "Atan"), ("asin", "Asin"),
-    ("acos", "Acos"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+    ("ceil", "Ceil"), ("round", "Round"),
+    ("sin", "Sin"), ("cos", "Cos"), ("tan", "Tan"), ("atan", "Atan"),
+    ("asin", "Asin"), ("acos", "Acos"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+    ("asinh", "Asinh"), ("acosh", "Acosh"), ("atanh", "Atanh"),
     ("eq", "Equal"), ("lt", "Less"), ("le", "LessOrEqual"),
     ("gt", "Greater"), ("ge", "GreaterOrEqual"),
     ("and", "And"), ("or", "Or"), ("xor", "Xor"), ("not", "Not"),
@@ -165,6 +166,32 @@ def _expm1(ctx, eqn, ins, out):
 @_reg("ne")
 def _ne(ctx, eqn, ins, out):
     ctx.node("Not", [ctx.node("Equal", ins)], out=out)
+
+
+@_reg("exp2")
+def _exp2(ctx, eqn, ins, out):
+    two = ctx.const(np.asarray(2, _dtype(eqn.invars[0])))
+    ctx.node("Pow", [two, ins[0]], out=out)
+
+
+@_reg("cbrt")
+def _cbrt(ctx, eqn, ins, out):
+    # sign-preserving cube root: sign(x) * |x|^(1/3)
+    third = ctx.const(np.asarray(1.0 / 3.0, _dtype(eqn.invars[0])))
+    mag = ctx.node("Pow", [ctx.node("Abs", ins), third])
+    ctx.node("Mul", [ctx.node("Sign", ins), mag], out=out)
+
+
+@_reg("is_finite")
+def _is_finite(ctx, eqn, ins, out):
+    # opset-17 IsInf/IsNaN only accept f32/f64 (16-bit support is opset
+    # 20); cast first so fp16/bf16 AMP graphs stay spec-valid
+    x = ins[0]
+    if np.dtype(_dtype(eqn.invars[0])).itemsize < 4:
+        x = ctx.node("Cast", [x], to=onnx_dtype(np.float32))
+    inf = ctx.node("IsInf", [x])
+    nan = ctx.node("IsNaN", [x])
+    ctx.node("Not", [ctx.node("Or", [inf, nan])], out=out)
 
 
 @_reg("rem")

@@ -65,10 +65,26 @@ def _unary(fname):
 for _o, _f in [("Exp", "exp"), ("Log", "log"), ("Tanh", "tanh"),
                ("Sqrt", "sqrt"), ("Abs", "abs"), ("Sign", "sign"),
                ("Floor", "floor"), ("Ceil", "ceil"),
-               ("Sin", "sin"), ("Cos", "cos"), ("Atan", "arctan"),
-               ("Asin", "arcsin"), ("Acos", "arccos"),
-               ("Sinh", "sinh"), ("Cosh", "cosh")]:
+               ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+               ("Atan", "arctan"), ("Asin", "arcsin"), ("Acos", "arccos"),
+               ("Sinh", "sinh"), ("Cosh", "cosh"), ("Asinh", "arcsinh"),
+               ("Acosh", "arccosh"), ("Atanh", "arctanh"),
+               ("IsNaN", "isnan")]:
     _OPS[_o] = _unary(_f)
+
+
+@_op("IsInf")
+def _isinf(attrs, x):
+    jnp = _jnp()
+    pos = attrs.get("detect_positive", 1)
+    neg = attrs.get("detect_negative", 1)
+    if pos and neg:
+        return jnp.isinf(x)
+    if pos:
+        return jnp.isposinf(x)
+    if neg:
+        return jnp.isneginf(x)
+    return jnp.zeros_like(x, dtype=bool)   # spec: neither -> all False
 
 
 @_op("Round")

@@ -587,3 +587,21 @@ def test_onnx_roi_align():
     # at 1.5 and 3.5
     onp.testing.assert_allclose(got[0, 0, 0], [1.5, 3.5], atol=1e-5)
     onp.testing.assert_allclose(got[0, 0, 1], [1.5, 3.5], atol=1e-5)
+
+
+def test_export_extended_unary_primitives():
+    """tan/asinh/acosh/atanh/cbrt/exp2/is_finite jaxpr primitives export
+    and round-trip (round-4 exporter-breadth widening)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.onnx import make_fn, trace_to_onnx
+
+    def fn(x):
+        return (jnp.tan(x) * 0.1 + jnp.arcsinh(x) + jnp.arctanh(x * 0.3)
+                + jnp.arccosh(x + 1.5) + jnp.cbrt(x) + jnp.exp2(x)
+                + jnp.where(jnp.isfinite(1 / x), x, 0.0))
+
+    x = onp.linspace(0.2, 0.9, 8).astype(onp.float32).reshape(1, 8)
+    model = trace_to_onnx(fn, mx.np.array(x)._data)
+    got = onp.asarray(make_fn(model)(x)[0])
+    want = onp.asarray(fn(mx.np.array(x)._data))
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
