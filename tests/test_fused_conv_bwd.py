@@ -164,6 +164,7 @@ def test_eval_and_ineligible_fall_back():
         config.set("fused_conv_bn", "auto")
 
 
+@pytest.mark.slow
 def test_resnet_trains_with_fused_path():
     """Loss decreases over a few fused steps and stays finite (the e2e
     chaotic-conditioning caveat rules out elementwise parity here)."""
@@ -188,5 +189,36 @@ def test_resnet_trains_with_fused_path():
             first = first if first is not None else float(loss)
         assert onp.isfinite(float(loss))
         assert float(loss) < first
+    finally:
+        config.set("fused_conv_bn", "auto")
+
+
+def test_small_fused_net_trains():
+    """Cheap default-bucket stand-in for the resnet run (nightly): a two-
+    triplet FusableSequential net converges through the fused backward."""
+    config.set("fused_conv_bn", "on")
+    try:
+        mx.random.seed(0)
+        net = nn.FusableSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, use_bias=False), nn.BatchNorm(),
+                nn.Activation("relu"),
+                nn.Conv2D(8, 3, padding=1, use_bias=False), nn.BatchNorm(),
+                nn.Activation("relu"),
+                nn.GlobalAvgPool2D(), nn.Dense(3))
+        net.initialize()
+        xv = RNG.uniform(size=(4, 8, 8, 8)).astype("float32")
+        yv = onp.arange(4) % 3
+        net(mx.np.array(xv))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        first = None
+        for _ in range(6):
+            with mx.autograd.record():
+                loss = loss_fn(net(mx.np.array(xv)), mx.np.array(yv)).mean()
+            loss.backward()
+            tr.step(4)
+            first = first if first is not None else float(loss)
+        assert onp.isfinite(float(loss)) and float(loss) < first
     finally:
         config.set("fused_conv_bn", "auto")
