@@ -49,39 +49,57 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
     import jax.numpy as jnp
     if isinstance(buf, ndarray):
         buf = bytes(buf.asnumpy().astype(onp.uint8))
-    Image = _pil()
-    if buf[:6] == b"\x93NUMPY":
-        arr = onp.load(_io.BytesIO(buf), allow_pickle=False)
-    elif Image is not None:
-        img = Image.open(_io.BytesIO(buf))
-        img = img.convert("RGB" if flag else "L")
-        arr = onp.asarray(img)
-        if not flag:
-            arr = arr[..., None]
-    else:
-        raise MXNetError("no image codec available (PIL missing); pack raw "
-                         ".npy payloads instead")
-    if arr.ndim == 2:
-        arr = arr[..., None]
-    return _wrap(jnp.asarray(arr))
+    return _wrap(jnp.asarray(imdecode_np(buf, flag)))
 
 
-def imdecode_np(buf, flag=1):
+def imdecode_np(buf, flag=1, try_native=True):
     """Host-side decode to a numpy HWC array (no device transfer) — the
-    ImageIter batch path decodes all samples first, then ships ONE batch."""
-    Image = _pil()
+    ImageIter batch path decodes all samples first, then ships ONE batch.
+
+    JPEG payloads prefer the native libjpeg codec (native/mxtpu_decode.cc,
+    the reference's src/io/image_io.cc role); everything else uses PIL,
+    raw .npy payloads load directly."""
     if buf[:6] == b"\x93NUMPY":
         arr = onp.load(_io.BytesIO(buf), allow_pickle=False)
-    elif Image is not None:
-        img = Image.open(_io.BytesIO(buf)).convert("RGB" if flag else "L")
-        arr = onp.asarray(img)
-        if not flag:
-            arr = arr[..., None]
     else:
-        raise MXNetError("no image codec available (PIL missing)")
+        arr = None
+        if try_native and buf[:2] == b"\xff\xd8":   # JPEG magic
+            from . import native as _native
+            arr = _native.jpeg_decode(buf, gray=not flag)
+        if arr is None:
+            Image = _pil()
+            if Image is None:
+                raise MXNetError("no image codec available (PIL missing); "
+                                 "pack raw .npy payloads instead")
+            img = Image.open(_io.BytesIO(buf)).convert("RGB" if flag else "L")
+            arr = onp.asarray(img)
+            if not flag:
+                arr = arr[..., None]
     if arr.ndim == 2:
         arr = arr[..., None]
     return arr
+
+
+def imdecode_batch_np(bufs, flag=1, n_threads=None):
+    """Decode a list of image payloads to HWC uint8 arrays, JPEGs in
+    parallel native threads (GIL-free — the reference decodes an
+    ImageRecordIter batch across its thread pool the same way)."""
+    from . import native as _native
+    out = [None] * len(bufs)
+    jpeg_idx = [i for i, b in enumerate(bufs) if b[:2] == b"\xff\xd8"]
+    if jpeg_idx:
+        decoded = _native.jpeg_decode_batch([bufs[i] for i in jpeg_idx],
+                                            gray=not flag,
+                                            n_threads=n_threads)
+        if decoded is not None:
+            for i, arr in zip(jpeg_idx, decoded):
+                out[i] = arr
+    for i in range(len(bufs)):
+        if out[i] is None:
+            # the native codec already rejected this payload — go straight
+            # to the PIL/npy path instead of retrying libjpeg
+            out[i] = imdecode_np(bufs[i], flag, try_native=False)
+    return out
 
 
 def imencode(img, fmt=".jpg", quality=95):
@@ -781,17 +799,19 @@ class ImageIter:
         import jax.numpy as jnp
         c, h, w = self.data_shape
         labels = onp.zeros((self.batch_size, self.label_width), "float32")
-        raws = []
+        bufs = []
         i = 0
         try:
             while i < self.batch_size:
                 label, buf = self._next_sample()
-                raws.append(imdecode_np(buf, flag=1 if c == 3 else 0))
+                bufs.append(buf)
                 labels[i] = onp.asarray(label).reshape(-1)[:self.label_width]
                 i += 1
         except StopIteration:
             if i == 0:
                 raise
+        # whole-batch decode: JPEGs fan out over native libjpeg threads
+        raws = imdecode_batch_np(bufs, flag=1 if c == 3 else 0)
         pad = self.batch_size - i
 
         shapes = {r.shape for r in raws}

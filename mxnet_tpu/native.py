@@ -26,6 +26,12 @@ def _build_dir():
     return config.get("native.build_dir") or os.path.join(_SRC_DIR, "build")
 
 
+# per-library extra link flags (e.g. image codecs)
+_LINK_FLAGS = {
+    "mxtpu_decode": ["-ljpeg"],
+}
+
+
 def _build(name):
     src = os.path.join(_SRC_DIR, f"{name}.cc")
     out = os.path.join(_build_dir(), f"lib{name}.so")
@@ -35,7 +41,7 @@ def _build(name):
         return out
     os.makedirs(_build_dir(), exist_ok=True)
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           src, "-o", out]
+           src, "-o", out] + _LINK_FLAGS.get(name, [])
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")
@@ -153,3 +159,100 @@ class NativeRecordFile:
                 yield rec, ctypes.string_at(buf, ln.value)
         finally:
             self._lib.mxtpu_prefetch_destroy(pf)
+
+
+def decode_lib():
+    """Native JPEG codec (native/mxtpu_decode.cc over libjpeg)."""
+    lib = load("mxtpu_decode")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.mxtpu_jpeg_dims.restype = ctypes.c_int
+        lib.mxtpu_jpeg_dims.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.mxtpu_jpeg_decode.restype = ctypes.c_int
+        lib.mxtpu_jpeg_decode.argtypes = [
+            u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, ctypes.c_int]
+        lib.mxtpu_decode_batch.restype = ctypes.c_int
+        lib.mxtpu_decode_batch.argtypes = [
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int, ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib._sigs_set = True
+    return lib
+
+
+def jpeg_decode(buf, gray=False):
+    """Decode one JPEG to an HWC uint8 numpy array (RGB, or HW1 gray);
+    returns None when the codec is unavailable or the payload isn't a
+    decodable JPEG (caller falls back to PIL)."""
+    import numpy as onp
+    lib = decode_lib()
+    if lib is None:
+        return None
+    raw = onp.frombuffer(buf, dtype=onp.uint8)
+    data = raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.mxtpu_jpeg_dims(data, raw.size, ctypes.byref(h), ctypes.byref(w),
+                           ctypes.byref(c)) != 0:
+        return None
+    ch = 1 if gray else 3
+    out = onp.empty((h.value, w.value, ch), onp.uint8)
+    rc = lib.mxtpu_jpeg_decode(
+        data, raw.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.nbytes, 1 if gray else 0)
+    return out if rc == 0 else None
+
+
+def jpeg_decode_batch(bufs, gray=False, n_threads=None):
+    """Decode a list of JPEG byte strings in parallel C threads (no GIL).
+    Returns list of HWC uint8 arrays; None entries for failed payloads.
+    Falls back to None when the codec is unavailable."""
+    import numpy as onp
+    lib = decode_lib()
+    if lib is None:
+        return None
+    if not bufs:
+        return []
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    ch = 1 if gray else 3
+    # dims probe first; only probe-clean entries are dispatched to the C
+    # thread pool (a failed payload gets no output buffer at all)
+    raws, outs, live = [], [None] * len(bufs), []
+    for b in bufs:
+        raw = onp.frombuffer(b, dtype=onp.uint8)
+        raws.append(raw)
+        h = ctypes.c_int()
+        w = ctypes.c_int()
+        c = ctypes.c_int()
+        rc = lib.mxtpu_jpeg_dims(
+            raw.ctypes.data_as(u8p), raw.size, ctypes.byref(h),
+            ctypes.byref(w), ctypes.byref(c))
+        live.append((rc, h.value, w.value))
+    idx = [i for i, (rc, _, _) in enumerate(live) if rc == 0]
+    n = len(idx)
+    if n:
+        datas = (u8p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        outps = (u8p * n)()
+        caps = (ctypes.c_uint64 * n)()
+        rcs = (ctypes.c_int * n)()
+        for j, i in enumerate(idx):
+            _, h, w = live[i]
+            out = onp.empty((h, w, ch), onp.uint8)
+            outs[i] = out
+            datas[j] = raws[i].ctypes.data_as(u8p)
+            lens[j] = raws[i].size
+            outps[j] = out.ctypes.data_as(u8p)
+            caps[j] = out.nbytes
+        if n_threads is None:
+            n_threads = min(8, max(1, os.cpu_count() or 1))
+        lib.mxtpu_decode_batch(datas, lens, n, outps, caps,
+                               1 if gray else 0, n_threads, rcs)
+        for j, i in enumerate(idx):
+            if rcs[j] != 0:
+                outs[i] = None
+    return outs

@@ -194,3 +194,44 @@ def test_hue_rotation_preserves_gray_axis():
     out = onp.asarray(aug.batch_apply(jax.numpy.asarray(gray),
                                       jax.random.PRNGKey(3)))
     onp.testing.assert_allclose(out, gray, rtol=1e-4)
+
+
+def test_native_jpeg_decode_matches_pil():
+    """native/mxtpu_decode.cc (libjpeg) must agree byte-for-byte with PIL
+    (same underlying codec); batch path fans JPEGs over C threads."""
+    pytest.importorskip("PIL")
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import native
+    if native.decode_lib() is None:
+        pytest.skip("native decode lib unavailable")
+    rng = onp.random.RandomState(0)
+    bufs, refs = [], []
+    for i in range(5):
+        arr = (rng.rand(20 + i, 26, 3) * 255).astype(onp.uint8)
+        b = _io.BytesIO()
+        Image.fromarray(arr).save(b, format="JPEG", quality=95)
+        bufs.append(b.getvalue())
+        refs.append(onp.asarray(Image.open(
+            _io.BytesIO(b.getvalue())).convert("RGB")))
+    # PIL wheels bundle their own libjpeg-turbo; the system libjpeg may
+    # round the IDCT differently by +-1 per pixel — that's the contract
+    one = native.jpeg_decode(bufs[0])
+    onp.testing.assert_allclose(one.astype(int), refs[0].astype(int),
+                                atol=1)
+    gray = native.jpeg_decode(bufs[0], gray=True)
+    assert gray.shape == refs[0].shape[:2] + (1,)
+    batch = image.imdecode_batch_np(bufs)
+    for got, want in zip(batch, refs):
+        onp.testing.assert_allclose(got.astype(int), want.astype(int),
+                                    atol=1)
+    # non-JPEG payloads fall back to the generic path inside the batch API
+    npy = _io.BytesIO()
+    onp.save(npy, refs[0])
+    mixed = image.imdecode_batch_np([bufs[0], npy.getvalue()])
+    onp.testing.assert_array_equal(mixed[1], refs[0])
+    # corrupt JPEG magic inside a batch: no crash, PIL path raises cleanly
+    with pytest.raises(Exception):
+        image.imdecode_batch_np([b"\xff\xd8garbage"])
