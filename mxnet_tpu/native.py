@@ -183,10 +183,16 @@ def decode_lib():
     return lib
 
 
+# PIL's DecompressionBombError threshold: untrusted headers must not make
+# us allocate unbounded buffers (the old PIL-only path enforced this)
+MAX_IMAGE_PIXELS = 178956970
+
+
 def jpeg_decode(buf, gray=False):
     """Decode one JPEG to an HWC uint8 numpy array (RGB, or HW1 gray);
     returns None when the codec is unavailable or the payload isn't a
-    decodable JPEG (caller falls back to PIL)."""
+    decodable JPEG (caller falls back to PIL, which raises the
+    decompression-bomb error for oversized headers)."""
     import numpy as onp
     lib = decode_lib()
     if lib is None:
@@ -198,6 +204,8 @@ def jpeg_decode(buf, gray=False):
     c = ctypes.c_int()
     if lib.mxtpu_jpeg_dims(data, raw.size, ctypes.byref(h), ctypes.byref(w),
                            ctypes.byref(c)) != 0:
+        return None
+    if h.value * w.value > MAX_IMAGE_PIXELS:
         return None
     ch = 1 if gray else 3
     out = onp.empty((h.value, w.value, ch), onp.uint8)
@@ -231,6 +239,8 @@ def jpeg_decode_batch(bufs, gray=False, n_threads=None):
         rc = lib.mxtpu_jpeg_dims(
             raw.ctypes.data_as(u8p), raw.size, ctypes.byref(h),
             ctypes.byref(w), ctypes.byref(c))
+        if rc == 0 and h.value * w.value > MAX_IMAGE_PIXELS:
+            rc = -3   # bomb guard: let PIL raise its DecompressionBombError
         live.append((rc, h.value, w.value))
     idx = [i for i, (rc, _, _) in enumerate(live) if rc == 0]
     n = len(idx)

@@ -299,13 +299,19 @@ class Symbol:
             for i in s._inputs:
                 visit(i)
             index[id(s)] = len(nodes)
-            nodes.append({
+            node = {
                 "op": "null" if s._op is None else s._op,
                 "name": s.name,
                 "attrs": {k: self._enc_attr(v)
                           for k, v in s._kwargs.items()},
                 "inputs": [[index[id(inp)], 0, 0] for inp in s._inputs],
-            })
+            }
+            # user attrs (_set_attr) go under their own key: merging them
+            # into op attrs would be ambiguous on reload (any string key
+            # is a legal user attr)
+            if getattr(s, "_attrs", None):
+                node["user_attrs"] = dict(s._attrs)
+            nodes.append(node)
         for h in head_syms:
             if isinstance(h, Group):
                 raise MXNetError("nested Group symbols do not serialize")
@@ -438,6 +444,9 @@ def load_json(json_str):
         else:
             inputs = [built[i] for i, _, _ in node["inputs"]]
             built.append(Symbol(node["op"], inputs, kwargs, node["name"]))
+        user_attrs = node.get("user_attrs")
+        if user_attrs:
+            built[-1]._set_attr(**user_attrs)
     heads = [built[i] for i, _, _ in data["heads"]]
     return heads[0] if len(heads) == 1 else Group(heads)
 

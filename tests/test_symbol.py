@@ -173,3 +173,17 @@ def test_symbol_gradient_eval():
     onp.testing.assert_allclose(gw.asnumpy(), 2 * (xv * xv * wv).asnumpy())
     with pytest.raises(mx.MXNetError):
         (x * 2).gradient("nope")
+
+
+def test_attrs_survive_json_roundtrip():
+    a = sym.Variable("a")
+    a._set_attr(__lr_mult__="2.0", ctx_group="dev1")  # non-dunder too
+    d = a * 3 + 1
+    d2 = sym.load_json(d.tojson())
+    assert d2.attr_dict().get("a", {}).get("__lr_mult__") == "2.0"
+    assert d2.attr_dict().get("a", {}).get("ctx_group") == "dev1"
+    xv = mx.np.array(onp.array([1.0, 2.0], onp.float32))
+    r2, r1 = d2.eval(a=xv), d.eval(a=xv)
+    r2 = r2[0] if isinstance(r2, list) else r2
+    r1 = r1[0] if isinstance(r1, list) else r1
+    onp.testing.assert_allclose(r2.asnumpy(), r1.asnumpy())
