@@ -973,31 +973,52 @@ def multi_head_attention(query, key, value, heads, mask=None, dropout_p=0.0,
 def foreach(body, data, init_states):
     """npx.foreach: scan body over axis 0 of data (subgraph op analog).
 
-    body(data_slice, states) -> (out, new_states). Works eagerly and under
-    hybridize tracing (lowers to lax.scan).
+    body(data_slice, states) -> (out, new_states). Under autograd.record
+    the loop runs eagerly with per-op recording — gradients flow to data,
+    states AND parameters the body closes over, exactly like the
+    reference's contrib.foreach imperative path. Outside recording (and
+    inside hybridize/jit traces) it lowers to ONE lax.scan.
     """
+    from .. import autograd as _ag
     from ..numpy.multiarray import _wrap
-    states = init_states
+    from .. import numpy as _np
     single_data = isinstance(data, ndarray)
-    xs = data if single_data else list(data)
+    single_state = isinstance(init_states, ndarray)
 
-    def scan_body(carry, x_raw):
-        st = [_wrap(c) for c in carry] if isinstance(carry, (list, tuple)) else _wrap(carry)
-        xin = _wrap(x_raw) if single_data else [_wrap(r) for r in x_raw]
-        out, new_st = body(xin, st)
-        out_raw = (out._data if isinstance(out, ndarray)
-                   else [o._data for o in out])
-        new_raw = ([s._data for s in new_st]
-                   if isinstance(new_st, (list, tuple)) else new_st._data)
-        return new_raw, out_raw
+    if _ag.is_recording():
+        # eager recorded loop (reference: contrib/control_flow foreach)
+        states = init_states
+        length = (data.shape[0] if single_data else data[0].shape[0])
+        outs = []
+        for t in range(length):
+            x_t = data[t] if single_data else [d[t] for d in data]
+            out, states = body(x_t, states)
+            outs.append(out)
+        if isinstance(outs[0], ndarray):
+            stacked = _np.stack(outs)
+        else:
+            stacked = [_np.stack([o[i] for o in outs])
+                       for i in range(len(outs[0]))]
+        return stacked, states
 
-    carry0 = ([s._data for s in init_states]
-              if isinstance(init_states, (list, tuple)) else init_states._data)
-    xs_raw = xs._data if single_data else [x._data for x in xs]
-    final, outs = lax.scan(scan_body, carry0, xs_raw)
-    outs_w = _wrap_out(outs)
-    final_w = ([_wrap(f) for f in final] if isinstance(final, (list, tuple))
-               else _wrap(final))
+    def fn(xs_raw, carry0):
+        def scan_body(carry, x_raw):
+            st = (_wrap(carry) if single_state
+                  else [_wrap(c) for c in carry])
+            xin = _wrap(x_raw) if single_data else [_wrap(r) for r in x_raw]
+            out, new_st = body(xin, st)
+            out_raw = (out._data if isinstance(out, ndarray)
+                       else [o._data for o in out])
+            new_raw = (new_st._data if isinstance(new_st, ndarray)
+                       else [s._data for s in new_st])
+            return new_raw, out_raw
+
+        final, outs = lax.scan(scan_body, carry0, xs_raw)
+        return outs, final
+
+    xs_arg = data if single_data else list(data)
+    st_arg = init_states if single_state else list(init_states)
+    outs_w, final_w = _invoke(fn, (xs_arg, st_arg), name="foreach")
     return outs_w, final_w
 
 
