@@ -985,10 +985,12 @@ def foreach(body, data, init_states):
     single_data = isinstance(data, ndarray)
     single_state = isinstance(init_states, ndarray)
 
-    if _ag.is_recording():
-        # eager recorded loop (reference: contrib/control_flow foreach)
+    length = (data.shape[0] if single_data else data[0].shape[0])
+    if _ag.is_recording() and length > 0:
+        # eager recorded loop (reference: contrib/control_flow foreach);
+        # length 0 falls through to the scan path, whose empty (0, ...)
+        # outputs match the non-recorded behavior
         states = init_states
-        length = (data.shape[0] if single_data else data[0].shape[0])
         outs = []
         for t in range(length):
             x_t = data[t] if single_data else [d[t] for d in data]

@@ -51,6 +51,18 @@ def test_foreach_multiple_states():
         a = a + d[t]
     onp.testing.assert_allclose(outs.asnumpy(), onp.stack(exp), rtol=1e-6)
     onp.testing.assert_allclose(fa.asnumpy(), a, rtol=1e-6)
+    onp.testing.assert_allclose(fb.asnumpy(), b, rtol=1e-6)
+
+
+def test_foreach_zero_length():
+    outs, final = npx.foreach(lambda x, s: (x + s, s + x),
+                              np.zeros((0, 3)), np.ones((3,)))
+    assert outs.shape == (0, 3)
+    onp.testing.assert_allclose(final.asnumpy(), 1.0)
+    with mx.autograd.record():   # recorded path must behave identically
+        outs, final = npx.foreach(lambda x, s: (x + s, s + x),
+                                  np.zeros((0, 3)), np.ones((3,)))
+    assert outs.shape == (0, 3)
 
 
 def test_foreach_gradient():
