@@ -71,6 +71,36 @@ def test_normal_against_torch():
     (lambda: mgp.Uniform(-1.0, 3.0),
      lambda: torch.distributions.Uniform(-1.0, 3.0),
      onp.array([0.0, 2.9])),
+    (lambda: mgp.Chi2(4.0),
+     lambda: torch.distributions.Chi2(torch.tensor(4.0)),
+     onp.array([1.0, 5.5])),
+    (lambda: mgp.Pareto(2.5, 1.0),
+     lambda: torch.distributions.Pareto(torch.tensor(1.0),
+                                        torch.tensor(2.5)),
+     onp.array([1.5, 4.0])),
+    (lambda: mgp.HalfCauchy(1.5),
+     lambda: torch.distributions.HalfCauchy(torch.tensor(1.5)),
+     onp.array([0.4, 2.5])),
+    (lambda: mgp.FisherSnedecor(4.0, 6.0),
+     lambda: torch.distributions.FisherSnedecor(torch.tensor(4.0),
+                                                torch.tensor(6.0)),
+     onp.array([0.5, 2.0])),
+    (lambda: mgp.Geometric(0.3),
+     lambda: torch.distributions.Geometric(torch.tensor(0.3)),
+     onp.array([0.0, 2.0, 6.0])),
+    (lambda: mgp.Binomial(10, 0.4),
+     lambda: torch.distributions.Binomial(10, torch.tensor(0.4)),
+     onp.array([0.0, 4.0, 9.0])),
+    # our prob is the stop probability (n*log p + x*log1p(-p), matching
+    # the reference's log_prob); torch's probs is its complement
+    (lambda: mgp.NegativeBinomial(5, 0.35),
+     lambda: torch.distributions.NegativeBinomial(torch.tensor(5.0),
+                                                  torch.tensor(0.65)),
+     onp.array([0.0, 3.0, 8.0])),
+    (lambda: mgp.Dirichlet(onp.array([2.0, 3.0, 4.0], onp.float32)),
+     lambda: torch.distributions.Dirichlet(
+         torch.tensor([2.0, 3.0, 4.0])),
+     onp.array([[0.2, 0.3, 0.5], [0.1, 0.6, 0.3]], onp.float32)),
 ])
 def test_logprob_oracles(mk_ours, mk_torch, values):
     _assert_logprob(mk_ours(), mk_torch(), values)
