@@ -107,9 +107,11 @@ class _TapeNode:
     for constants); vjp_fn maps output cotangents -> input cotangents.
     """
     __slots__ = ("vjp_fn", "parents", "n_out", "out_shapes", "out_dtypes",
-                 "seq", "name", "saved", "out_treedef")
+                 "seq", "name", "saved", "out_treedef", "fun", "raw_args",
+                 "x64")
 
-    def __init__(self, vjp_fn, parents, outputs, name, out_treedef=None):
+    def __init__(self, vjp_fn, parents, outputs, name, out_treedef=None,
+                 fun=None, raw_args=None, x64=False):
         st = _st()
         self.vjp_fn = vjp_fn
         self.parents = parents
@@ -123,6 +125,13 @@ class _TapeNode:
         # pytree structure of the primal output (list/tuple/dict containers):
         # the VJP's cotangent argument must match it exactly
         self.out_treedef = out_treedef
+        # pure function of the raw differentiable inputs + those inputs:
+        # kept so create_graph=True can re-linearize (jax.vjp of the vjp)
+        # for higher-order gradients (reference: Imperative::Backward with
+        # create_graph, src/imperative/imperative.cc:438).
+        self.fun = fun
+        self.raw_args = raw_args
+        self.x64 = x64
         st.tape.append(self)
 
 
@@ -147,10 +156,12 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         var._mark_variable(grad, req)
 
 
-def _record_op(vjp_fn, array_inputs, outputs, name, out_treedef=None):
+def _record_op(vjp_fn, array_inputs, outputs, name, out_treedef=None,
+               fun=None, raw_args=None, x64=False):
     """Called by the dispatcher for every op executed under record()."""
     parents = [getattr(a, "_entry", None) for a in array_inputs]
-    node = _TapeNode(vjp_fn, parents, outputs, name, out_treedef)
+    node = _TapeNode(vjp_fn, parents, outputs, name, out_treedef,
+                     fun=fun, raw_args=raw_args, x64=x64)
     for i, o in enumerate(outputs):
         o._entry = _Entry(node, i)
     return node
@@ -194,9 +205,18 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     if retain_graph is None:
         retain_graph = create_graph
 
-    grads = _run_backward(heads, head_grads, retain_graph,
-                          accumulate_to_vars=False, wrt=variables,
-                          create_graph=create_graph)
+    if create_graph:
+        # the backward pass itself is recorded: every VJP application and
+        # cotangent accumulation becomes a taped op, so the returned grads
+        # are differentiable (reference: imperative.cc:438 create_graph)
+        with _RecordingStateScope(True, train_mode):
+            grads = _run_backward(heads, head_grads, retain_graph,
+                                  accumulate_to_vars=False, wrt=variables,
+                                  create_graph=True)
+    else:
+        grads = _run_backward(heads, head_grads, retain_graph,
+                              accumulate_to_vars=False, wrt=variables,
+                              create_graph=False)
     return grads[0] if single else grads
 
 
@@ -268,7 +288,9 @@ def _run_backward(heads, head_grads, retain_graph, accumulate_to_vars,
                 key = _outkey(p.node, p.index)
                 cot[key] = _accum(cot[key], ig) if key in cot else ig
         if not retain_graph:
-            node.vjp_fn = None  # free residuals
+            node.vjp_fn = None   # free residuals
+            node.fun = None      # and the re-linearization closure
+            node.raw_args = None  # and the pinned primal buffers
 
     # head that is itself a leaf variable
     for e, h in zip(roots, heads):
@@ -304,7 +326,8 @@ def _run_backward(heads, head_grads, retain_graph, accumulate_to_vars,
             g = cot.get(_outkey(e.node, e.index))
         if g is None:
             g = jnp.zeros(v.shape, _float_or(v.dtype))
-        results.append(_wrap(g))
+        # create_graph cotangents are already recorded ndarrays
+        results.append(g if isinstance(g, _nd) else _wrap(g))
     if not retain_graph:
         st.tape.clear()
     return results
@@ -315,23 +338,86 @@ def _apply_vjp(node, out_cots, create_graph):
         raise MXNetError(
             "backward through a freed graph: pass retain_graph=True to keep "
             "intermediate state for a second backward")
+    if create_graph:
+        return _apply_vjp_create_graph(node, out_cots)
     if node.out_treedef is not None:
-        import jax
         cots = jax.tree_util.tree_unflatten(node.out_treedef, list(out_cots))
     else:
         cots = tuple(out_cots) if node.n_out > 1 else out_cots[0]
-    if create_graph:
-        # re-record the vjp computation as ops so grad-of-grad works
-        from .numpy import multiarray as M
-        wrapped = [M._wrap(c) for c in (out_cots)]
-        raw = node.vjp_fn(cots)
-        # vjp internals are jnp-level; tape them as a single opaque node
-        outs = [M._wrap(r) for r in raw if r is not None]
-        # record connection from wrapped cotangents to outs is not exact for
-        # arbitrary graphs; higher-order support is via grad-of-grad on
-        # compiled (hybridized) functions. Document limitation.
-        return raw
     return node.vjp_fn(cots)
+
+
+def _apply_vjp_create_graph(node, out_cots):
+    """Apply a node's VJP as a *recorded* op so grad-of-grad works.
+
+    Reference semantics: ``autograd.grad(..., create_graph=True)`` records the
+    backward pass itself so its outputs are differentiable
+    (python/mxnet/autograd.py:303 over src/imperative/imperative.cc:438).
+
+    TPU-native mechanism: the node kept its pure forward ``fun`` and raw
+    primal inputs, so the whole input-cotangent computation
+    ``h(primals, cots) = vjp(fun at primals)(cots)`` is itself a pure jax
+    function.  We run ``jax.vjp(h, ...)`` — giving exact second-order
+    linearization wrt BOTH the primals (residual dependence) and the incoming
+    cotangents (chain dependence) — and tape one node whose parents are the
+    original op's parents plus the cotangents' entries.  Because the new node
+    also stores ``h`` as its own ``fun``, third and higher orders compose.
+
+    ``out_cots`` entries are ndarrays (recorded or leaf), raw jax arrays
+    (seed cotangents), or float0 numpy arrays (non-inexact outputs, treated
+    as non-differentiable constants).
+    """
+    from .numpy import multiarray as M
+    if node.fun is None:
+        raise MXNetError(
+            f"create_graph=True is not supported through op '{node.name}': "
+            "it was recorded without a re-differentiable pure function "
+            "(custom autograd.Function or a vjp-only fallback). Use "
+            "first-order grad(), or express the op with built-in operators.")
+    raw_cots = [c._data if isinstance(c, M.ndarray) else c for c in out_cots]
+    # differentiable cotangent slots (float0 => constant)
+    diff_idx = [i for i, c in enumerate(raw_cots) if not _is_float0(c)]
+    n_primal = len(node.raw_args)
+    fun, out_treedef, n_out = node.fun, node.out_treedef, node.n_out
+
+    def h(*flat):
+        primals = flat[:n_primal]
+        dcots = flat[n_primal:]
+        cs = list(raw_cots)
+        for j, i in enumerate(diff_idx):
+            cs[i] = dcots[j]
+        if out_treedef is not None:
+            cstruct = jax.tree_util.tree_unflatten(out_treedef, cs)
+        else:
+            cstruct = tuple(cs) if n_out > 1 else cs[0]
+        _, vjp = jax.vjp(fun, *primals)
+        return tuple(vjp(cstruct))
+
+    h_args = tuple(node.raw_args) + tuple(raw_cots[i] for i in diff_idx)
+    x64_scope = jax.enable_x64(True) if node.x64 else contextlib.nullcontext()
+    with x64_scope:
+        in_cots, h_vjp = jax.vjp(h, *h_args)
+    if node.x64:
+        _inner = h_vjp
+
+        def h_vjp(ct, _i=_inner):
+            with jax.enable_x64(True):
+                return _i(ct)
+
+    out_nds = [M._wrap(r) for r in in_cots]
+    cot_parents = [
+        out_cots[i]._entry if isinstance(out_cots[i], M.ndarray) else None
+        for i in diff_idx]
+    _record_op(h_vjp, [], out_nds, "grad_" + node.name,
+               out_treedef=jax.tree_util.tree_structure(tuple(in_cots)),
+               fun=h, raw_args=h_args, x64=node.x64)
+    # _record_op derived parents from an empty input list; install the true
+    # parent entries (primal entries + cotangent entries) directly — the
+    # primal wrappers may be gone but their _Entry objects live on the node.
+    new_node = out_nds[0]._entry.node if out_nds else None
+    if new_node is not None:
+        new_node.parents = list(node.parents) + cot_parents
+    return out_nds
 
 
 def _outkey(node, i):
@@ -364,6 +450,13 @@ def _accum(a, b):
         out = _sp.add(a, b)
         return out if isinstance(out, _sp.BaseSparseNDArray) else \
             (out._data if hasattr(out, "_data") else out)
+    from .numpy.multiarray import ndarray as _nd, _wrap
+    if isinstance(a, _nd) != isinstance(b, _nd):
+        # create_graph mode mixes recorded ndarray cotangents with raw seed
+        # arrays; wrap the raw side so + dispatches through _invoke (taped)
+        # instead of jax coercing the ndarray wrapper to a constant
+        a = a if isinstance(a, _nd) else _wrap(jnp.asarray(a))
+        b = b if isinstance(b, _nd) else _wrap(jnp.asarray(b))
     return a + b
 
 

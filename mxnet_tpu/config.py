@@ -132,6 +132,12 @@ declare("native.build_dir", str, "", "MXNET_TPU_NATIVE_BUILD",
 declare("fused_conv_bn", str, "auto", "MXNET_FUSED_CONV_BN",
         "Pallas fused conv3x3+BN+ReLU backward on eligible blocks: "
         "'auto' (TPU only), 'on', 'off'.")
+declare("cached_graph.max_signatures", int, 512,
+        "MXNET_CACHED_GRAPH_MAX_SIGNATURES",
+        "Max distinct call signatures one compiled block keeps before its "
+        "trace caches are flushed (bounds the recompile/memory blowup from "
+        "varying python scalars; reference analog: CachedOpConfig limits, "
+        "src/imperative/cached_op.h:412-459)")
 declare("home", str, os.path.join("~", ".mxnet"), "MXNET_HOME",
         "Cache root for datasets/pretrained weights (reference: base.py "
         "data_dir).")

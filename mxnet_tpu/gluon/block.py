@@ -18,9 +18,11 @@ node, cached_op.cc:968,1276).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -290,9 +292,29 @@ class _CachedGraph:
         self._jit = jax.jit(pure, static_argnames=("sig_key",))
         self._signatures = {}  # sig_key -> (treedef, static_leaves)
         self._out_trees = {}   # sig_key -> output treedef (set at trace time)
+        # guards the two trace-time side channels above: the reference ships
+        # a dedicated thread-safe executor (src/imperative/
+        # cached_op_threadsafe.cc); here the jit itself is thread-safe and
+        # only the signature bookkeeping needs the lock
+        self._sig_lock = threading.Lock()
+        # trace (param-buffer rebinding) vs replay isolation
+        self._rw = _RWLock()
+        # sig_key -> number of calls currently using it: a cache flush must
+        # not evict the trace state of a call in progress
+        self._inflight = {}
 
     def _pure(self, trainable_raws, aux_raws, input_raws, rng_key, sig_key):
-        treedef, static_leaves = self._signatures[sig_key]
+        if self._rw._readers:
+            # tracing rebinds the shared Parameter buffers to tracers; doing
+            # that while replays hold the read lock (including our own
+            # reader slot — we mispredicted a cache hit) would leak tracers
+            # into other threads. Abort; the caller retries as a writer.
+            raise _SignatureEvicted(sig_key)
+        sig = self._signatures.get(sig_key)
+        if sig is None:
+            # evicted between registration and (re-)trace — caller retries
+            raise _SignatureEvicted(sig_key)
+        treedef, static_leaves = sig
         saved = {}
         try:
             for n in self.param_names:
@@ -312,7 +334,8 @@ class _CachedGraph:
                 out = self.block.forward(*fargs, **fkwargs)
             out_leaves, out_tree = _flatten_args(out)
             out_raws = [l._data if _is_nd(l) else l for l in out_leaves]
-            self._out_trees[sig_key] = out_tree  # trace-time side channel
+            with self._sig_lock:  # serialize vs cache-flush dict swaps
+                self._out_trees[sig_key] = out_tree
             mutated = {n: self.params[n]._data._data for n in self.aux
                        if self.params[n]._data._data is not markers[n]}
             return out_raws, mutated
@@ -346,21 +369,37 @@ class _CachedGraph:
             else:
                 static_leaves.append(l)
         from .. import amp as _amp
+        from .. import config as _config
         # the full tuple (not its hash) is the key: equality comparison
-        # makes collisions impossible; jax.jit's own cache grows with the
-        # same signatures, so this adds no asymptotic memory
+        # makes collisions impossible (long static reprs are digested — a
+        # 128-bit collision is not a realistic event); jax.jit's own cache
+        # grows with the same signatures, so this adds no asymptotic memory
         sig_key = (str(treedef),
-                   tuple("A" if l is _ARR else repr(l)
+                   tuple("A" if l is _ARR else _static_repr(l)
                          for l in static_leaves),
                    tuple((tuple(r.shape), str(r.dtype)) for r in input_raws),
                    # dtype policy is applied inside _invoke at trace time, so
                    # a policy change must invalidate the cached trace
                    (_amp.is_active(), str(_amp.target_dtype())))
-        self._signatures[sig_key] = (treedef, static_leaves)
+        with self._sig_lock:
+            self._inflight[sig_key] = self._inflight.get(sig_key, 0) + 1
+            is_new_sig = sig_key not in self._signatures
+            if is_new_sig and \
+                    len(self._signatures) >= \
+                    _config.get("cached_graph.max_signatures"):
+                # flush executables, out-trees and signatures together so
+                # they stay consistent (reference: CachedOp bounds this
+                # blowup via config, cached_op.h:412-459) — but keep the
+                # entries of calls currently in flight on other threads
+                keep = set(self._inflight)
+                self._signatures = {k: v for k, v in self._signatures.items()
+                                    if k in keep}
+                self._out_trees = {k: v for k, v in self._out_trees.items()
+                                   if k in keep}
+                self._jit.clear_cache()
+            self._signatures[sig_key] = (treedef, static_leaves)
 
         rng = _random._next_key()
-        trainable_raws = {n: self.params[n]._data._data for n in self.trainable}
-        aux_raws = {n: self.params[n]._data._data for n in self.aux}
 
         nd_leaves = [l for l in leaves if _is_nd(l)]
         arr_inputs = [l for l in nd_leaves
@@ -369,23 +408,66 @@ class _CachedGraph:
         recording = autograd.is_recording() and (
             any(a._entry is not None for a in arr_inputs)
             or any(a._entry is not None for a in param_arrays))
+        diff_input_raws = [l._data for l in arr_inputs]
 
-        if recording:
-            diff_input_raws = [l._data for l in arr_inputs]
+        # an untraced signature means the next jit call traces, and tracing
+        # temporarily rebinds the shared Parameter buffers to tracers —
+        # exclusive (writer). Replays only read the param raws — shared.
+        # _out_trees membership == "trace completed" (set at trace time).
+        need_trace = is_new_sig or sig_key not in self._out_trees
+        try:
+            for _attempt in (0, 1):
+                acquired_write = need_trace
+                if acquired_write:
+                    self._rw.acquire_write()
+                else:
+                    self._rw.acquire_read()
+                try:
+                    trainable_raws = {n: self.params[n]._data._data
+                                      for n in self.trainable}
+                    aux_raws = {n: self.params[n]._data._data
+                                for n in self.aux}
+                    if recording:
+                        def fn(tr, diff_inp):
+                            raws, di = list(input_raws), 0
+                            for i, l in enumerate(nd_leaves):
+                                if jnp.issubdtype(l.dtype, jnp.inexact):
+                                    raws[i] = diff_inp[di]
+                                    di += 1
+                            return self._jit(tr, aux_raws, raws, rng,
+                                             sig_key=sig_key)
 
-            def fn(tr, diff_inp):
-                raws, di = list(input_raws), 0
-                for i, l in enumerate(nd_leaves):
-                    if jnp.issubdtype(l.dtype, jnp.inexact):
-                        raws[i] = diff_inp[di]
-                        di += 1
-                return self._jit(tr, aux_raws, raws, rng, sig_key=sig_key)
-
-            (out_raws, mutated), vjp_fn = jax.vjp(
-                fn, trainable_raws, diff_input_raws)
-        else:
-            out_raws, mutated = self._jit(
-                trainable_raws, aux_raws, input_raws, rng, sig_key=sig_key)
+                        (out_raws, mutated), vjp_fn = jax.vjp(
+                            fn, trainable_raws, diff_input_raws)
+                    else:
+                        out_raws, mutated = self._jit(
+                            trainable_raws, aux_raws, input_raws, rng,
+                            sig_key=sig_key)
+                    out_tree = self._out_trees.get(sig_key)
+                    if out_tree is None:
+                        # executable survived a flush that dropped its
+                        # out-tree: force a clean re-trace
+                        self._jit.clear_cache()
+                        raise _SignatureEvicted(sig_key)
+                    break
+                except _SignatureEvicted:
+                    if _attempt:
+                        raise MXNetError(
+                            "compiled-forward signature cache thrashing: "
+                            "raise mx.config cached_graph.max_signatures")
+                    with self._sig_lock:
+                        self._signatures[sig_key] = (treedef, static_leaves)
+                    need_trace = True
+                finally:
+                    if acquired_write:
+                        self._rw.release_write()
+                    else:
+                        self._rw.release_read()
+        finally:
+            with self._sig_lock:
+                self._inflight[sig_key] -= 1
+                if not self._inflight[sig_key]:
+                    del self._inflight[sig_key]
 
         # write back mutated aux state (BatchNorm running stats etc.) — the
         # analog of CachedOp mutable inputs
@@ -393,7 +475,7 @@ class _CachedGraph:
             self.params[n]._data._rebind(raw)
 
         out_wrapped = [_wrap(r) for r in out_raws]
-        out = jax.tree_util.tree_unflatten(self._out_trees[sig_key], out_wrapped)
+        out = jax.tree_util.tree_unflatten(out_tree, out_wrapped)
 
         if recording:
             mut_shapes = {n: (raw.shape, raw.dtype) for n, raw in mutated.items()}
@@ -405,9 +487,39 @@ class _CachedGraph:
                 tr_cots, inp_cots = _vjp((list(cots), mut_zeros))
                 return tuple(tr_cots[n] for n in trainable_names) + tuple(inp_cots)
 
-            autograd._record_op(node_vjp, param_arrays + arr_inputs,
-                                out_wrapped,
-                                f"CachedOp:{type(self.block).__name__}")
+            n_tr = len(trainable_names)
+
+            def fun_flat(*flat, _fn=fn, _sig=sig_key, _td=treedef,
+                         _sl=static_leaves):
+                # flat = trainable raws + diff input raws; re-runs the jitted
+                # forward so create_graph can jax.vjp through the whole
+                # graph. This runs outside _call_impl's retry loop, so it
+                # must re-register the signature (a flush may have evicted
+                # it) and hold the write lock in case the re-entry traces.
+                tr = dict(zip(trainable_names, flat[:n_tr]))
+                for _attempt in (0, 1):
+                    with self._sig_lock:
+                        self._signatures[_sig] = (_td, _sl)
+                    self._rw.acquire_write()
+                    try:
+                        out_raws2, _mut = _fn(tr, list(flat[n_tr:]))
+                        return tuple(out_raws2)
+                    except _SignatureEvicted:
+                        if _attempt:
+                            raise MXNetError(
+                                "signature cache thrashing during "
+                                "create_graph backward: raise mx.config "
+                                "cached_graph.max_signatures")
+                    finally:
+                        self._rw.release_write()
+
+            autograd._record_op(
+                node_vjp, param_arrays + arr_inputs, out_wrapped,
+                f"CachedOp:{type(self.block).__name__}",
+                out_treedef=jax.tree_util.tree_structure(tuple(out_raws)),
+                fun=fun_flat,
+                raw_args=tuple(trainable_raws[n] for n in trainable_names)
+                + tuple(diff_input_raws))
         return out
 
 
@@ -416,6 +528,55 @@ class _ArrSentinel:
 
 
 _ARR = _ArrSentinel()
+
+
+class _SignatureEvicted(Exception):
+    """Trace-time side channel lost its entry (cache flush race); retry."""
+
+
+class _RWLock:
+    """Minimal readers-writer lock: traces are writers (exclusive — they
+    temporarily rebind shared Parameter buffers to tracers), compiled
+    replays are readers (shared). The reference isolates this class of race
+    in a dedicated executor (src/imperative/cached_op_threadsafe.cc)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if not self._readers:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
+def _static_repr(l):
+    """Signature token for a static (non-array) call leaf; long reprs are
+    digested so one huge python literal doesn't bloat every key."""
+    r = repr(l)
+    if len(r) > 128:
+        # sha256: FIPS-approved (md5 raises on FIPS-enabled builds)
+        return "H" + hashlib.sha256(r.encode()).hexdigest()
+    return r
 
 
 def _hashable(x):

@@ -207,13 +207,16 @@ def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
         out_leaves = [w for w in jax.tree_util.tree_leaves(
             wrapped, is_leaf=lambda x: isinstance(x, ndarray))
             if isinstance(w, ndarray)]
-        treedef = jax.tree_util.tree_structure(out)
+        # NOTE: must not rebind `treedef` — fn closes over the input treedef
+        out_td = jax.tree_util.tree_structure(out)
         autograd._record_op(
             vjp_fn, diff_arrays, out_leaves,
             name or getattr(prim, "__name__", "op"),
             # only trustworthy when every pytree leaf is a wrapped array
-            out_treedef=treedef if treedef.num_leaves == len(out_leaves)
-            else None)
+            out_treedef=out_td if out_td.num_leaves == len(out_leaves)
+            else None,
+            # pure fn + primals: create_graph re-linearizes through these
+            fun=fn, raw_args=tuple(raws), x64=use_x64)
     return wrapped
 
 

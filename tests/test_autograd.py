@@ -188,3 +188,115 @@ def test_finite_difference_utility():
 
     x = np.array([0.3, -0.7, 1.2])
     check_numeric_gradient(f, [x])
+
+
+# ---------------------------------------------------------------------------
+# higher-order (create_graph) — reference taxonomy:
+# python/mxnet/autograd.py:303 grad(create_graph=True) over
+# src/imperative/imperative.cc:438; tests/python/unittest/test_higher_order_grad.py
+# ---------------------------------------------------------------------------
+
+def test_create_graph_sin_chain():
+    # sin -> cos -> -sin -> -cos through repeated create_graph
+    xs = onp.array([0.3, 1.1, -0.7], onp.float32)
+    x = np.array(xs)
+    x.attach_grad()
+    with autograd.record():
+        y = np.sin(x)
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1, x, create_graph=True)
+        g3 = autograd.grad(g2, x)
+    assert_almost_equal(g1, onp.cos(xs), rtol=1e-5)
+    assert_almost_equal(g2, -onp.sin(xs), rtol=1e-5)
+    assert_almost_equal(g3, -onp.cos(xs), rtol=1e-5)
+
+
+def test_create_graph_then_backward():
+    # reference pattern: grad(create_graph=True) then .backward() accumulates
+    # the second-order gradient into x.grad
+    x = np.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        gs = g.sum()
+    gs.backward()
+    assert_almost_equal(x.grad, onp.array([12.0, 18.0]), rtol=1e-5)
+
+
+def test_create_graph_mixed_partial():
+    # f = x*y^2: d/dy(df/dx) = 2y
+    x = np.array([2.0])
+    y = np.array([3.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        f = x * y * y
+        gx = autograd.grad(f, x, create_graph=True)
+        gxy = autograd.grad(gx, y)
+    assert_almost_equal(gxy, onp.array([6.0]), rtol=1e-5)
+
+
+def test_create_graph_gradient_penalty():
+    # WGAN-GP style: penalty on the gradient norm, differentiated wrt weights
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, activation='tanh')
+    net.initialize()
+    x = np.ones((2, 3)) * 0.5
+    x.attach_grad()
+    with autograd.record():
+        out = net(x).sum()
+        g = autograd.grad(out, x, create_graph=True)
+        penalty = (g * g).sum()
+    penalty.backward()
+    w = list(net.collect_params().values())[0]
+    assert onp.isfinite(w.grad().asnumpy()).all()
+    assert onp.abs(w.grad().asnumpy()).sum() > 0
+
+
+def test_create_graph_through_hybridized():
+    # the CachedOp tape node re-linearizes through the jitted forward
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(3, activation='tanh')
+    net.initialize()
+    net.hybridize()
+    x = np.array([[0.1, 0.2], [0.3, -0.4]])
+    x.attach_grad()
+    with autograd.record():
+        y = net(x).sum()
+        g = autograd.grad(y, x, create_graph=True)
+        gn = (g * g).sum()
+    gn.backward()
+    # oracle: same computation fully eager (non-hybridized fresh net with
+    # identical params)
+    net2 = nn.Dense(3, activation='tanh')
+    net2.initialize()
+    for (n1, p1), (n2, p2) in zip(net.collect_params().items(),
+                                  net2.collect_params().items()):
+        p2.set_data(p1.data())
+    x2 = np.array([[0.1, 0.2], [0.3, -0.4]])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = net2(x2).sum()
+        g2 = autograd.grad(y2, x2, create_graph=True)
+        gn2 = (g2 * g2).sum()
+    gn2.backward()
+    assert_almost_equal(x.grad, x2.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_create_graph_function_fails_fast():
+    # custom Function has only a user backward — no pure fn to re-linearize;
+    # must raise, not silently return un-taped grads
+    class Double(autograd.Function):
+        def forward(self, x):
+            return x * 2
+        def backward(self, dy):
+            return dy * 2
+
+    f = Double()
+    x = np.array([1.0])
+    x.attach_grad()
+    with pytest.raises(mx.base.MXNetError, match="create_graph"):
+        with autograd.record():
+            y = f(x)
+            autograd.grad(y, x, create_graph=True)

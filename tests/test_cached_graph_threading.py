@@ -1,0 +1,153 @@
+"""Thread-safety + bounded signature cache for the compiled executor.
+
+Reference: the reference ships a dedicated thread-safe cached op
+(src/imperative/cached_op_threadsafe.cc) and engine concurrency tests
+(tests/cpp/engine/threaded_engine_test.cc); CachedOpConfig bounds recompile
+blowup (src/imperative/cached_op.h:412-459).
+"""
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+from mxnet_tpu.gluon import nn, HybridBlock
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+class _ScaledDense(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Dense(4)
+
+    def forward(self, x, scale=1.0):
+        return self.fc(x) * scale
+
+
+def test_signature_cache_bounded():
+    old = mx.config.get("cached_graph.max_signatures")
+    mx.config.set("cached_graph.max_signatures", 4)
+    try:
+        net = _ScaledDense()
+        net.initialize()
+        net.hybridize()
+        x = np.ones((2, 3))
+        # 20 distinct python scalars -> 20 signatures without the bound
+        for i in range(20):
+            y = net(x, scale=float(i))
+            assert_almost_equal(y, net.fc(x).asnumpy() * float(i), rtol=1e-5)
+        cg = list(net._cached_graphs.values())[0]
+        assert len(cg._signatures) <= 4
+        assert len(cg._out_trees) <= 4
+    finally:
+        mx.config.set("cached_graph.max_signatures", old)
+
+
+class _ListScaled(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Dense(2)
+
+    def forward(self, x, tag=""):
+        # tag is a static python leaf: only its presence in the signature
+        # matters (a long string must be digested, not kept verbatim)
+        return self.fc(x) * (2.0 if tag.startswith("a") else 1.0)
+
+
+def test_long_static_repr_hashed():
+    net = _ListScaled()
+    net.initialize()
+    net.hybridize()
+    x = np.ones((2, 3))
+    long_static = "a" * 300  # repr >> 128 chars, single atomic leaf
+    y = net(x, tag=long_static)
+    y = net(x, tag=long_static)
+    assert onp.isfinite(y.asnumpy()).all()
+    cg = list(net._cached_graphs.values())[0]
+    hashed = [tok for key in cg._signatures for tok in key[1]
+              if tok.startswith("H")]
+    assert hashed, "digest path never exercised"
+    for key in cg._signatures:
+        for tok in key[1]:
+            assert len(tok) <= 129
+
+
+def test_concurrent_inference_many_shapes():
+    net = nn.Dense(8, activation='relu')
+    net.initialize()
+    net.hybridize()
+    shapes = [(1, 5), (2, 5), (3, 5), (4, 5), (5, 5), (6, 5), (7, 5), (8, 5)]
+    inputs = {s: onp.random.RandomState(s[0]).rand(*s).astype(onp.float32)
+              for s in shapes}
+    # one warm-up forward: deferred parameter init must complete before
+    # concurrent use (same contract as the reference's thread-safe CachedOp)
+    net(np.array(inputs[shapes[0]]))
+    # eager oracle with copied params
+    net2 = nn.Dense(8, activation='relu')
+    net2.initialize()
+    net2(np.array(inputs[shapes[0]]))
+    for (_, p1), (_, p2) in zip(net.collect_params().items(),
+                                net2.collect_params().items()):
+        p2.set_data(p1.data())
+    want = {s: net2(np.array(v)).asnumpy() for s, v in inputs.items()}
+
+    errors = []
+
+    def worker(tid):
+        try:
+            for rep in range(6):
+                for s in shapes:
+                    y = net(np.array(inputs[s])).asnumpy()
+                    onp.testing.assert_allclose(y, want[s], rtol=1e-5,
+                                                atol=1e-6)
+        except Exception as e:  # noqa: BLE001
+            errors.append((tid, e))
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_concurrent_with_cache_flushes():
+    # threads race through repeated flushes: cap of 2 with 8 shapes forces
+    # evictions mid-flight; the retry path must keep every result correct
+    old = mx.config.get("cached_graph.max_signatures")
+    mx.config.set("cached_graph.max_signatures", 2)
+    try:
+        net = nn.Dense(4)
+        net.initialize()
+        net.hybridize()
+        xs = {k: onp.full((k, 3), 0.5, onp.float32) for k in range(1, 9)}
+        net2 = nn.Dense(4)
+        net2.initialize()
+        net(np.array(xs[1]))  # warm-up: complete deferred init pre-threads
+        net2(np.array(xs[1]))
+        for (_, p1), (_, p2) in zip(net.collect_params().items(),
+                                    net2.collect_params().items()):
+            p2.set_data(p1.data())
+        want = {k: net2(np.array(v)).asnumpy() for k, v in xs.items()}
+        errors = []
+
+        def worker(tid):
+            try:
+                for rep in range(4):
+                    for k in range(1, 9):
+                        y = net(np.array(xs[k])).asnumpy()
+                        onp.testing.assert_allclose(y, want[k], rtol=1e-5,
+                                                    atol=1e-6)
+            except Exception as e:  # noqa: BLE001
+                errors.append((tid, e))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+    finally:
+        mx.config.set("cached_graph.max_signatures", old)
