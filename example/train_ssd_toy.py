@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Toy single-scale SSD — detection end to end with the multibox op family
+(reference: example/ssd over src/operator/contrib/multibox_*.cc).
+
+A tiny conv backbone produces one feature map; ``npx.multibox_prior``
+generates anchors, class/box heads predict per anchor,
+``npx.multibox_target`` assigns training targets with hard-negative
+mining, and ``npx.multibox_detection`` decodes + NMS-filters at inference.
+The dataset is synthetic: one bright axis-aligned rectangle per image,
+class = color channel.
+
+    python example/train_ssd_toy.py [--steps 60] [--batch-size 8]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, np, npx  # noqa: E402
+from mxnet_tpu.gluon import nn, Trainer  # noqa: E402
+
+IMG = 32          # input resolution
+NUM_CLASSES = 3   # rectangle color
+
+
+def make_batch(rs, batch_size):
+    """Images (B, 3, IMG, IMG) + labels (B, 1, 5) [cls, x1, y1, x2, y2]."""
+    imgs = rs.rand(batch_size, 3, IMG, IMG).astype(onp.float32) * 0.1
+    labels = onp.zeros((batch_size, 1, 5), onp.float32)
+    for i in range(batch_size):
+        cls = rs.randint(0, NUM_CLASSES)
+        w, h = rs.randint(10, 20), rs.randint(10, 20)
+        x, y = rs.randint(0, IMG - w), rs.randint(0, IMG - h)
+        imgs[i, cls, y:y + h, x:x + w] = 1.0
+        labels[i, 0] = [cls, x / IMG, y / IMG, (x + w) / IMG, (y + h) / IMG]
+    return np.array(imgs), np.array(labels)
+
+
+class ToySSD(nn.HybridBlock):
+    """Backbone + per-anchor class/box heads on one feature map."""
+
+    def __init__(self, num_anchors):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        for ch in (16, 32, 64):
+            self.features.add(nn.Conv2D(ch, 3, padding=1),
+                              nn.BatchNorm(), nn.Activation("relu"),
+                              nn.MaxPool2D(2))
+        self.cls_head = nn.Conv2D(num_anchors * (NUM_CLASSES + 1), 3,
+                                  padding=1)
+        self.box_head = nn.Conv2D(num_anchors * 4, 3, padding=1)
+
+    def forward(self, x):
+        feat = self.features(x)                       # (B, 64, 4, 4)
+        cls = self.cls_head(feat)                     # (B, A*(C+1), 4, 4)
+        box = self.box_head(feat)                     # (B, A*4, 4, 4)
+        b = cls.shape[0]
+        # -> (B, C+1, A_total) and (B, A_total*4), anchor-major like the
+        # reference SSD head reshape
+        cls = cls.transpose(0, 2, 3, 1).reshape(b, -1, NUM_CLASSES + 1)
+        cls = cls.transpose(0, 2, 1)
+        box = box.transpose(0, 2, 3, 1).reshape(b, -1)
+        return cls, box, feat
+
+
+def train(steps, batch_size, lr, seed=0, log=True):
+    rs = onp.random.RandomState(seed)
+    sizes, ratios = (0.5, 0.3), (1.0, 2.0, 0.5)
+    num_anchors = len(sizes) + len(ratios) - 1
+    net = ToySSD(num_anchors)
+    net.initialize()
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": lr})
+
+    imgs, _ = make_batch(rs, 1)
+    _, _, feat = net(imgs)
+    anchors = npx.multibox_prior(feat, sizes=sizes, ratios=ratios)
+
+    losses = []
+    for step in range(steps):
+        imgs, labels = make_batch(rs, batch_size)
+        with autograd.record():
+            cls_pred, box_pred, _ = net(imgs)
+            loc_t, loc_m, cls_t = [np.array(t.asnumpy())
+                                   for t in npx.multibox_target(
+                anchors, labels, cls_pred.detach(),
+                negative_mining_ratio=3.0)]
+            # class loss: softmax CE over anchors, ignore_label=-1 masked
+            logp = npx.log_softmax(cls_pred, axis=1)
+            mask = (cls_t >= 0).astype("float32")
+            tgt = np.maximum(cls_t, 0).astype("int32")
+            picked = npx.pick(logp.transpose(0, 2, 1), tgt, axis=-1)
+            cls_loss = -(picked * mask).sum() / np.maximum(mask.sum(), 1)
+            # loc loss: smooth-L1 on positives
+            diff = np.abs(box_pred - loc_t) * loc_m
+            loc_loss = np.where(diff < 1, 0.5 * diff * diff,
+                                diff - 0.5).sum() / \
+                np.maximum(loc_m.sum(), 1)
+            loss = cls_loss + loc_loss
+        loss.backward()
+        trainer.step(batch_size)
+        losses.append(float(loss.asnumpy()))
+        if log and step % 10 == 0:
+            print(f"step {step:3d} loss {losses[-1]:.4f}")
+    return net, anchors, losses
+
+
+def detect(net, anchors, imgs):
+    cls_pred, box_pred, _ = net(imgs)
+    cls_prob = npx.softmax(cls_pred, axis=1)
+    return npx.multibox_detection(cls_prob, box_pred, anchors,
+                                  nms_threshold=0.45, threshold=0.2)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=2e-3)
+    args = p.parse_args()
+    t0 = time.time()
+    net, anchors, losses = train(args.steps, args.batch_size, args.lr)
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f} "
+          f"({time.time() - t0:.1f}s)")
+    rs = onp.random.RandomState(99)
+    imgs, labels = make_batch(rs, 4)
+    out = detect(net, anchors, imgs).asnumpy()
+    for i in range(4):
+        det = out[i][out[i, :, 0] >= 0]
+        best = det[0] if det.shape[0] else None
+        print(f"image {i}: gt cls {int(labels[i, 0, 0].asnumpy())} -> "
+              f"top det {best}")
+
+
+if __name__ == "__main__":
+    main()

@@ -835,3 +835,11 @@ class ImageIter:
         out = jnp.transpose(out, (0, 3, 1, 2))  # NHWC -> NCHW API contract
         lab = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch([_wrap(out)], [_wrap(jnp.asarray(lab))], pad=pad)
+
+
+# detection pipeline (reference surfaces these in mx.image as well:
+# python/mxnet/image/__init__.py re-exports image/detection.py)
+from .image_detection import (  # noqa: E402,F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateMultiRandCropAugmenter,
+    CreateDetAugmenter, ImageDetIter)

@@ -1,0 +1,598 @@
+"""Object-detection image pipeline: Det* augmenters + ImageDetIter.
+
+Reference parity: python/mxnet/image/detection.py (DetAugmenter :40,
+DetBorrowAug :66, DetRandomSelectAug :91, DetHorizontalFlipAug :127,
+DetRandomCropAug :153, DetRandomPadAug :324, CreateMultiRandCropAugmenter
+:418, CreateDetAugmenter :483, ImageDetIter :625).
+
+TPU-native design: labels are plain numpy (host metadata — proposal
+rejection sampling is inherently host control flow, same as the
+reference), while every pixel operation is a device op. A crop is ONE
+fused crop-and-resize gather (image.py ``_affine_crop_resize``), padding
+is one masked-canvas op, and ``ImageDetIter`` splits the chain at the
+force-resize: the geometric prefix runs per sample (labels are coupled to
+each sample's random window), then the photometric tail — color jitter,
+lighting, normalize — runs as batched device passes over the stacked
+(N,H,W,C) tensor exactly like the classification iterator.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import random as pyrandom
+
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError
+from .numpy.multiarray import ndarray, _wrap
+from . import image as _img
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+def _npimg(src):
+    """-> (H, W, C) jnp array."""
+    return src._data if isinstance(src, ndarray) else jnp.asarray(src)
+
+
+class DetAugmenter:
+    """Detection augmenter base (reference: detection.py:40): takes
+    (image, label) and returns both — label rows are
+    [cls, xmin, ymin, xmax, ymax, ...] with normalized coordinates."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ndarray):
+                v = v.asnumpy()
+            if isinstance(v, onp.ndarray):
+                v = v.tolist()
+            self._kwargs[k] = v
+
+    def dumps(self):
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Borrow a label-invariant classification augmenter
+    (reference: detection.py:66)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, _img.Augmenter):
+            raise TypeError("Borrowing from invalid Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [type(self).__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter, with a chance to skip all
+    (reference: detection.py:91)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("Allow DetAugmenter in list only")
+        if not aug_list:
+            skip_prob = 1
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def dumps(self):
+        return [type(self).__name__.lower(),
+                [x.dumps() for x in self.aug_list]]
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + labels with probability p
+    (reference: detection.py:127)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _wrap(_npimg(src)[:, ::-1])
+            label = label.copy()
+            tmp = 1.0 - label[:, 1]
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+def _areas(label):
+    """(K, 4+) corner boxes -> areas (reference: _calculate_areas)."""
+    h = onp.maximum(0, label[:, 3] - label[:, 1])
+    w = onp.maximum(0, label[:, 2] - label[:, 0])
+    return h * w
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IOU-constrained random crop (reference: detection.py:153).
+
+    Proposal search is host-side numpy (cheap label math); the accepted
+    crop applies as one fused device crop (``image.fixed_crop``)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.enabled = (area_range[1] > 0 and
+                        area_range[0] <= area_range[1] and
+                        aspect_ratio_range[0] <= aspect_ratio_range[1] and
+                        aspect_ratio_range[0] > 0)
+        if not self.enabled:
+            logging.warning("DetRandomCropAug disabled: invalid ranges")
+
+    def __call__(self, src, label):
+        img = _npimg(src)
+        crop = self._random_crop_proposal(label, img.shape[0], img.shape[1])
+        if crop:
+            x, y, w, h, label = crop
+            src = _img.fixed_crop(_wrap(img), x, y, w, h, None)
+        return src, label
+
+    def _intersect(self, label, xmin, ymin, xmax, ymax):
+        left = onp.maximum(label[:, 0], xmin)
+        right = onp.minimum(label[:, 2], xmax)
+        top = onp.maximum(label[:, 1], ymin)
+        bot = onp.minimum(label[:, 3], ymax)
+        invalid = (left >= right) | (top >= bot)
+        out = label.copy()
+        out[:, 0], out[:, 1], out[:, 2], out[:, 3] = left, top, right, bot
+        out[invalid, :] = 0
+        return out
+
+    def _check_satisfy_constraints(self, label, xmin, ymin, xmax, ymax,
+                                   width, height):
+        if (xmax - xmin) * (ymax - ymin) < 2:
+            return False
+        x1, y1 = float(xmin) / width, float(ymin) / height
+        x2, y2 = float(xmax) / width, float(ymax) / height
+        object_areas = _areas(label[:, 1:])
+        valid = onp.where(object_areas * width * height > 2)[0]
+        if valid.size < 1:
+            return False
+        inter = self._intersect(label[valid, 1:], x1, y1, x2, y2)
+        cov = _areas(inter) / object_areas[valid]
+        cov = cov[cov > 0]
+        return cov.size > 0 and onp.amin(cov) > self.min_object_covered
+
+    def _update_labels(self, label, crop_box, height, width):
+        xmin = float(crop_box[0]) / width
+        ymin = float(crop_box[1]) / height
+        w = float(crop_box[2]) / width
+        h = float(crop_box[3]) / height
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - xmin) / w
+        out[:, (2, 4)] = (out[:, (2, 4)] - ymin) / h
+        out[:, 1:5] = onp.clip(out[:, 1:5], 0, 1)
+        coverage = _areas(out[:, 1:]) * w * h / _areas(label[:, 1:])
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) & \
+            (coverage > self.min_eject_coverage)
+        if not valid.any():
+            return None
+        return out[valid, :]
+
+    def _random_crop_proposal(self, label, height, width):
+        from math import sqrt
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(sqrt(min_area / ratio)))
+            max_h = int(round(sqrt(max_area / ratio)))
+            if round(max_h * ratio) > width:
+                max_h = int((width + 0.4999999) / ratio)
+            max_h = min(max_h, height)
+            h = min(h, max_h)
+            if h < max_h:
+                h = pyrandom.randint(h, max_h)
+            w = int(round(h * ratio))
+            area = w * h
+            if area < min_area:
+                h += 1
+                w = int(round(h * ratio))
+                area = w * h
+            if area > max_area:
+                h -= 1
+                w = int(round(h * ratio))
+                area = w * h
+            if not (min_area <= area <= max_area and
+                    0 <= w <= width and 0 <= h <= height):
+                continue
+            y = pyrandom.randint(0, max(0, height - h))
+            x = pyrandom.randint(0, max(0, width - w))
+            if self._check_satisfy_constraints(label, x, y, x + w, y + h,
+                                               width, height):
+                new_label = self._update_labels(label, (x, y, w, h),
+                                                height, width)
+                if new_label is not None:
+                    return (x, y, w, h, new_label)
+        return ()
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding (reference: detection.py:324): place the
+    image in a larger pad_val canvas — one masked device op."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0 and
+                        area_range[0] <= area_range[1] and
+                        aspect_ratio_range[0] > 0 and
+                        aspect_ratio_range[0] <= aspect_ratio_range[1])
+        if not self.enabled:
+            logging.warning("DetRandomPadAug disabled: invalid ranges")
+
+    def __call__(self, src, label):
+        img = _npimg(src)
+        height, width = img.shape[0], img.shape[1]
+        pad = self._random_pad_proposal(label, height, width)
+        if pad:
+            x, y, w, h, label = pad
+            canvas = jnp.broadcast_to(
+                jnp.asarray(self.pad_val, img.dtype),
+                (h, w, img.shape[2])) if len(self.pad_val) > 1 else \
+                jnp.full((h, w, img.shape[2]),
+                         self.pad_val[0], img.dtype)
+            src = _wrap(canvas.at[y:y + height, x:x + width].set(img))
+        return src, label
+
+    def _update_labels(self, label, pad_box, height, width):
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] * width + pad_box[0]) / pad_box[2]
+        out[:, (2, 4)] = (out[:, (2, 4)] * height + pad_box[1]) / pad_box[3]
+        return out
+
+    def _random_pad_proposal(self, label, height, width):
+        from math import sqrt
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(sqrt(min_area / ratio)))
+            max_h = int(round(sqrt(max_area / ratio)))
+            if round(h * ratio) < width:
+                h = int((width + 0.499999) / ratio)
+            h = max(h, height)
+            h = min(h, max_h)
+            if h < max_h:
+                h = pyrandom.randint(h, max_h)
+            w = int(round(h * ratio))
+            if (h - height) < 2 or (w - width) < 2:
+                continue
+            y = pyrandom.randint(0, max(0, h - height))
+            x = pyrandom.randint(0, max(0, w - width))
+            new_label = self._update_labels(label, (x, y, w, h),
+                                            height, width)
+            return (x, y, w, h, new_label)
+        return ()
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Multiple crop augmenters under one random selector
+    (reference: detection.py:418)."""
+    def align(params):
+        out, num = [], 1
+        for p in params:
+            if not isinstance(p, list):
+                p = [p]
+            out.append(p)
+            num = max(num, len(p))
+        for k, p in enumerate(out):
+            if len(p) != num:
+                assert len(p) == 1
+                out[k] = p * num
+        return out
+
+    aligned = align([min_object_covered, aspect_ratio_range, area_range,
+                     min_eject_coverage, max_attempts])
+    augs = [DetRandomCropAug(min_object_covered=moc, aspect_ratio_range=arr,
+                             area_range=ar, min_eject_coverage=mec,
+                             max_attempts=ma)
+            for moc, arr, ar, mec, ma in zip(*aligned)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       min_eject_coverage=0.3, max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Standard detection augmentation chain (reference: detection.py:483);
+    same stage order: resize, crop, mirror, pad, force-resize, cast, then
+    the photometric tail (which ImageDetIter batches on device)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(_img.ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, area_range,
+            min_eject_coverage, max_attempts, skip_prob=(1 - rand_crop)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(aspect_ratio_range,
+                                  (1.0, area_range[1]), max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    auglist.append(DetBorrowAug(
+        _img.ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(_img.CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            _img.ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(_img.HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(
+            _img.LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(_img.RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(_img.ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(_img.ImageIter):
+    """Detection data iterator (reference: detection.py:625).
+
+    Labels use the reference's packed format
+    ``[header_w, obj_w, ..., (cls, x1, y1, x2, y2, ...)*]``; batches carry
+    (B, max_objects, obj_w) labels padded with -1. The geometric prefix
+    of the augmenter chain (everything up to and including the
+    force-resize) runs per sample (labels are coupled to each sample's
+    random window); the photometric tail runs as batched device passes."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", **kwargs):
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         label_width=1, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name)
+        self.auglist = (CreateDetAugmenter(data_shape, **kwargs)
+                        if aug_list is None else aug_list)
+        # split: photometric tail after the cast stage is batchable
+        self._batch_tail_start = len(self.auglist)
+        for i, aug in enumerate(self.auglist):
+            if isinstance(aug, DetBorrowAug) and \
+                    isinstance(aug.augmenter, _img.CastAug):
+                self._batch_tail_start = i + 1
+                break
+        label_shape = self._estimate_label_shape()
+        self.label_shape = label_shape
+        self.provide_label = [(label_name,
+                               (batch_size,) + tuple(label_shape))]
+        self.provide_data = [(data_name, (batch_size,) + tuple(data_shape))]
+
+    # -- label parsing (reference: detection.py:718) ----------------------
+    def _parse_label(self, label):
+        raw = onp.asarray(label).ravel()
+        if raw.size < 7:
+            raise MXNetError(f"Label shape is invalid: {raw.shape}")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise MXNetError(
+                f"Label shape {raw.shape} inconsistent with annotation "
+                f"width {obj_width}.")
+        out = onp.reshape(raw[header_width:], (-1, obj_width))
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        if not valid.any():
+            raise MXNetError("Encounter sample with no valid label.")
+        return out[valid, :].astype(onp.float32)
+
+    def _check_valid_label(self, label):
+        if label.ndim != 2 or label.shape[1] < 5:
+            raise MXNetError(
+                f"Label with shape (1+, 5+) required, {label} received.")
+        valid = (label[:, 0] >= 0) & (label[:, 3] > label[:, 1]) & \
+            (label[:, 4] > label[:, 2])
+        if not valid.any():
+            raise MXNetError("Invalid label occurs.")
+
+    def _estimate_label_shape(self):
+        max_count, obj_w = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self._next_sample()
+                parsed = self._parse_label(label)
+                max_count = max(max_count, parsed.shape[0])
+                obj_w = parsed.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return (max_count, obj_w)
+
+    def _next_sample(self):
+        """Full label vector (not truncated to label_width)."""
+        from . import recordio as rio
+        if self.record is not None:
+            if self.seq is not None:
+                if self._cursor >= len(self.seq):
+                    raise StopIteration
+                s = self.record.read_idx(self.seq[self._cursor])
+                self._cursor += 1
+            else:
+                s = self.record.read()
+                if s is None:
+                    raise StopIteration
+            header, img = rio.unpack(s)
+            return onp.array(header.label), img
+        if self._cursor >= len(self.seq):
+            raise StopIteration
+        label, fname = self.imglist[self.seq[self._cursor]]
+        self._cursor += 1
+        import os
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return onp.asarray(label), f.read()
+
+    def reshape(self, data_shape=None, label_shape=None):
+        if data_shape is not None:
+            self.provide_data = [(self.provide_data[0][0],
+                                  (self.batch_size,) + tuple(data_shape))]
+            self.data_shape = tuple(data_shape)
+            # retarget the chain's force-resize stage so the augmented
+            # pixels actually match the new provide_data contract
+            for aug in self.auglist:
+                if isinstance(aug, DetBorrowAug) and \
+                        isinstance(aug.augmenter, _img.ForceResizeAug):
+                    aug.augmenter.size = (data_shape[2], data_shape[1])
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.provide_label = [(self.provide_label[0][0],
+                                   (self.batch_size,) + tuple(label_shape))]
+            self.label_shape = tuple(label_shape)
+
+    def check_label_shape(self, label_shape):
+        if not len(label_shape) == 2:
+            raise ValueError("label_shape should have length 2")
+        if label_shape[0] < self.label_shape[0]:
+            raise ValueError(
+                f"Attempts to reduce label count from "
+                f"{self.label_shape[0]} to {label_shape[0]}, not allowed.")
+        if label_shape[1] != self.label_shape[1]:
+            raise ValueError(
+                f"label_shape object width inconsistent: "
+                f"{self.label_shape[1]} vs {label_shape[1]}.")
+
+    def augmentation_transform(self, data, label):
+        """Per-sample geometric prefix (reference: detection.py:847)."""
+        for aug in self.auglist[:self._batch_tail_start]:
+            data, label = aug(data, label)
+        return data, label
+
+    def next(self):
+        from .io import DataBatch
+        bs = self.batch_size
+        c, h, w = self.data_shape
+        mlab, wlab = self.label_shape
+        imgs, labs = [], []
+        i = 0
+        try:
+            while i < bs:
+                raw_label, buf = self._next_sample()
+                try:
+                    img = _wrap(jnp.asarray(
+                        _img.imdecode_np(buf, flag=1 if c == 3 else 0)))
+                    label = self._parse_label(raw_label)
+                    img, label = self.augmentation_transform(img, label)
+                    self._check_valid_label(label)
+                except MXNetError as e:
+                    logging.debug("Invalid sample, skipping: %s", e)
+                    continue
+                imgs.append(img._data.astype(jnp.float32))
+                labs.append(label)
+                i += 1
+        except StopIteration:
+            if not i:
+                raise
+        pad = bs - i
+        batch = jnp.stack(imgs + [jnp.zeros_like(imgs[0])] * pad)
+        # batched photometric tail: one device pass over the whole batch
+        tail = [a.augmenter for a in self.auglist[self._batch_tail_start:]
+                if isinstance(a, DetBorrowAug)]
+        if tail:
+            batch = _img.apply_batch(tail, _wrap(batch))._data
+        batch = jnp.transpose(batch, (0, 3, 1, 2))  # NHWC -> NCHW
+        out_lab = onp.full((bs, mlab, wlab), -1.0, onp.float32)
+        for j, lab in enumerate(labs):
+            k = min(lab.shape[0], mlab)
+            out_lab[j, :k, :lab.shape[1]] = lab[:k]
+        return DataBatch([_wrap(batch)], [_wrap(jnp.asarray(out_lab))],
+                         pad=pad)
+
+    def sync_label_shape(self, it, verbose=False):
+        """Synchronize label shape with another iterator
+        (reference: detection.py:913)."""
+        assert isinstance(it, ImageDetIter)
+        train_label_shape = self.label_shape
+        val_label_shape = it.label_shape
+        assert train_label_shape[1] == val_label_shape[1]
+        max_count = max(train_label_shape[0], val_label_shape[0])
+        if max_count > train_label_shape[0]:
+            self.reshape(None, (max_count, train_label_shape[1]))
+        if max_count > val_label_shape[0]:
+            it.reshape(None, (max_count, val_label_shape[1]))
+        if verbose and max_count > min(train_label_shape[0],
+                                       val_label_shape[0]):
+            logging.info("Resized label_shape to (%d, %d).",
+                         max_count, train_label_shape[1])
+        return it
+
+    def draw_next(self, *args, **kwargs):
+        raise NotImplementedError(
+            "draw_next needs cv2 display; use label/bbox data directly")

@@ -174,3 +174,23 @@ def dgl_subgraph(graph, *vids, return_mapping=False):
 def _wrap_np(a):
     import jax.numpy as jnp
     return _wrap(jnp.asarray(a))
+
+
+_CAMEL = {
+    # legacy contrib CamelCase aliases (reference: _contrib_MultiBox* ops
+    # surfaced as mx.nd.contrib.MultiBoxPrior etc.)
+    "MultiBoxPrior": "multibox_prior",
+    "MultiBoxTarget": "multibox_target",
+    "MultiBoxDetection": "multibox_detection",
+    "BipartiteMatching": "bipartite_matching",
+}
+
+
+def __getattr__(name):
+    """Fall back to the npx operator surface: the reference exposes every
+    _contrib_* op here (box_nms, box_iou, multibox_*, ...)."""
+    from .. import numpy_extension as _npx
+    fn = getattr(_npx, _CAMEL.get(name, name), None)
+    if fn is not None:
+        return fn
+    raise AttributeError(f"mxnet.ndarray.contrib has no op '{name}'")

@@ -1197,6 +1197,8 @@ from ..ops.quantization import (  # noqa: E402
     quantize_v2, dequantize, quantized_fully_connected, quantized_conv)
 from ..ops.bbox import (  # noqa: E402
     box_iou, box_nms, box_encode, box_decode, bipartite_matching)
+from ..ops.multibox import (  # noqa: E402
+    multibox_prior, multibox_target, multibox_detection)
 
 
 def nonzero(data):

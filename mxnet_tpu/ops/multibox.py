@@ -1,0 +1,267 @@
+"""SSD multibox operators.
+
+Reference parity: src/operator/contrib/multibox_prior.cc (anchor
+generation), multibox_target.cc (training target assignment: greedy
+bipartite + threshold matching + hard-negative mining), and
+multibox_detection.cc (decode + per-class NMS) — the operator family under
+the reference's SSD example (example/ssd).
+
+TPU-native design: everything is static-shaped jnp/lax.  The reference's
+per-sample C++ loops with early exits become masked whole-array passes
+vmapped over the batch: invalid ground-truths are masked (contiguous
+prefix of label rows whose class is not -1), the sequential bipartite
+stage is a ``lax.fori_loop`` of global argmax rounds (M rounds, each a
+reduction over the A×M overlap matrix — MXU/VPU friendly), and NMS is the
+same O(A^2) masked triangular pass as ``ops.bbox``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..numpy.multiarray import _invoke
+from .bbox import _iou_impl
+
+__all__ = ["multibox_prior", "multibox_target", "multibox_detection"]
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate prior (anchor) boxes (reference: multibox_prior.cc
+    MultiBoxPriorForward).
+
+    data: (N, C, H, W) — only H, W are used. Returns (1, H*W*K, 4) corner
+    boxes with K = len(sizes) + len(ratios) - 1: per location, all sizes
+    at ratios[0], then ratios[1:] at sizes[0]. Box half-width is
+    ``size * H/W * sqrt(ratio) / 2`` (sizes are normalized to height),
+    half-height ``size / sqrt(ratio) / 2``.
+    """
+    sizes = tuple(float(s) for s in sizes) or (1.0,)
+    ratios = tuple(float(r) for r in ratios) or (1.0,)
+
+    def fn(d):
+        h, w = d.shape[-2], d.shape[-1]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+        cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+        # per-location half extents, reference order
+        hw, hh = [], []
+        r0 = jnp.sqrt(jnp.float32(ratios[0]))
+        for s in sizes:
+            hw.append(s * h / w * r0 / 2.0)
+            hh.append(s / r0 / 2.0)
+        for r in ratios[1:]:
+            rs = jnp.sqrt(jnp.float32(r))
+            hw.append(sizes[0] * h / w * rs / 2.0)
+            hh.append(sizes[0] / rs / 2.0)
+        hw = jnp.stack([jnp.asarray(v, jnp.float32) for v in hw])  # (K,)
+        hh = jnp.stack([jnp.asarray(v, jnp.float32) for v in hh])
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")      # (H, W)
+        cxg = cxg[..., None]                                # (H, W, 1)
+        cyg = cyg[..., None]
+        out = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh],
+                        axis=-1)                            # (H, W, K, 4)
+        out = out.reshape(1, h * w * hw.shape[0], 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    return _invoke(fn, (data,), name="multibox_prior")
+
+
+def _target_one(anchors, label, cls_pred, overlap_threshold, ignore_label,
+                negative_mining_ratio, negative_mining_thresh,
+                minimum_negative_samples, variances):
+    """One sample of MultiBoxTargetForward (multibox_target.cc:54-260).
+
+    anchors (A, 4) corner, label (M, W) [cls, x1, y1, x2, y2, ...],
+    cls_pred (C, A) raw scores. Returns loc_target (A*4,), loc_mask
+    (A*4,), cls_target (A,).
+    """
+    A = anchors.shape[0]
+    M = label.shape[0]
+    f32 = jnp.float32
+    # valid gts: contiguous prefix with class != -1 (reference breaks at
+    # the first -1 row)
+    valid_gt = jnp.cumprod(label[:, 0] != -1.0).astype(bool)       # (M,)
+    num_valid = valid_gt.sum()
+    gt_boxes = label[:, 1:5]
+    overlaps = _iou_impl(anchors, gt_boxes)                        # (A, M)
+    overlaps = jnp.where(valid_gt[None, :], overlaps, -1.0)
+
+    # ---- stage 1: greedy bipartite matching (one gt per round) ----------
+    def bip_round(_, carry):
+        match_iou, match_gt, anchor_matched, gt_matched = carry
+        work = jnp.where(anchor_matched[:, None] | gt_matched[None, :],
+                         -1.0, overlaps)
+        flat = jnp.argmax(work)
+        i, k = flat // M, flat % M
+        good = work[i, k] > 1e-6
+        match_iou = jnp.where(good, match_iou.at[i].set(work[i, k]),
+                              match_iou)
+        match_gt = jnp.where(good, match_gt.at[i].set(k), match_gt)
+        anchor_matched = jnp.where(good, anchor_matched.at[i].set(True),
+                                   anchor_matched)
+        gt_matched = jnp.where(good, gt_matched.at[k].set(True), gt_matched)
+        return match_iou, match_gt, anchor_matched, gt_matched
+
+    match_iou = jnp.full((A,), -1.0, f32)
+    match_gt = jnp.full((A,), -1, jnp.int32)
+    anchor_matched = jnp.zeros((A,), bool)
+    gt_matched = ~valid_gt  # invalid gts count as already matched
+    match_iou, match_gt, anchor_matched, _ = lax.fori_loop(
+        0, M, bip_round,
+        (match_iou, match_gt, anchor_matched, gt_matched))
+
+    # ---- stage 2: per-anchor best gt; threshold matching ----------------
+    best_gt = jnp.argmax(overlaps, axis=1).astype(jnp.int32)       # (A,)
+    best_iou = jnp.take_along_axis(overlaps, best_gt[:, None], 1)[:, 0]
+    has_gt = num_valid > 0
+    thresh_pos = (~anchor_matched) & has_gt & (best_iou > overlap_threshold) \
+        if overlap_threshold > 0 else jnp.zeros((A,), bool)
+    positive = anchor_matched | thresh_pos
+    match_gt = jnp.where(anchor_matched, match_gt, best_gt)
+    match_iou = jnp.where(anchor_matched, match_iou, best_iou)
+
+    # ---- stage 3: negatives --------------------------------------------
+    if negative_mining_ratio > 0:
+        num_positive = positive.sum()
+        num_negative = jnp.minimum(
+            (num_positive * negative_mining_ratio).astype(jnp.int32),
+            A - num_positive.astype(jnp.int32))
+        num_negative = jnp.maximum(num_negative,
+                                   jnp.int32(minimum_negative_samples))
+        # candidate negatives: unmatched anchors whose best overlap is
+        # below the mining threshold; rank by background softmax prob
+        # ascending (hardest negatives = least-confident background)
+        mx = cls_pred.max(axis=0)
+        prob_bg = jnp.exp(cls_pred[0] - mx) / \
+            jnp.exp(cls_pred - mx[None, :]).sum(axis=0)
+        cand = (~positive) & (match_iou < negative_mining_thresh) & has_gt
+        # stable sort by descending (-prob) == ascending prob
+        order = jnp.argsort(jnp.where(cand, prob_bg, jnp.inf),
+                            stable=True)
+        rank = jnp.empty_like(order).at[order].set(jnp.arange(A))
+        negative = cand & (rank < num_negative)
+    else:
+        negative = (~positive) & has_gt
+
+    # ---- assign targets -------------------------------------------------
+    g = gt_boxes[match_gt]                                          # (A, 4)
+    gw, gh = g[:, 2] - g[:, 0], g[:, 3] - g[:, 1]
+    gx, gy = (g[:, 0] + g[:, 2]) * 0.5, (g[:, 1] + g[:, 3]) * 0.5
+    aw, ah = anchors[:, 2] - anchors[:, 0], anchors[:, 3] - anchors[:, 1]
+    ax, ay = (anchors[:, 0] + anchors[:, 2]) * 0.5, \
+        (anchors[:, 1] + anchors[:, 3]) * 0.5
+    enc = jnp.stack([
+        (gx - ax) / aw / variances[0],
+        (gy - ay) / ah / variances[1],
+        jnp.log(jnp.maximum(gw / aw, 1e-12)) / variances[2],
+        jnp.log(jnp.maximum(gh / ah, 1e-12)) / variances[3]], axis=1)
+    loc_target = jnp.where(positive[:, None], enc, 0.0).reshape(-1)
+    loc_mask = jnp.where(positive[:, None],
+                         jnp.ones((A, 4), f32), 0.0).reshape(-1)
+    cls_target = jnp.where(
+        positive, label[match_gt, 0] + 1.0,
+        jnp.where(negative, 0.0, f32(ignore_label)))
+    return loc_target, loc_mask, cls_target
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Compute SSD training targets (reference: _contrib_MultiBoxTarget).
+
+    anchor (1, A, 4); label (N, M, 5+) with -1-padded rows; cls_pred
+    (N, num_classes, A). Returns [loc_target (N, A*4), loc_mask (N, A*4),
+    cls_target (N, A)] — cls 0 is background, ignore_label marks don't-care
+    anchors.
+    """
+    def fn(a, l, c):
+        anchors = a.reshape(-1, 4)
+        one = lambda lb, cp: _target_one(
+            anchors, lb, cp, float(overlap_threshold), float(ignore_label),
+            float(negative_mining_ratio), float(negative_mining_thresh),
+            int(minimum_negative_samples), tuple(variances))
+        return jax.vmap(one)(l, c)
+    return _invoke(fn, (anchor, label, cls_pred), name="multibox_target")
+
+
+def _detect_one(cls_prob, loc_pred, anchors, threshold, clip, variances,
+                nms_threshold, force_suppress, nms_topk):
+    """One sample of MultiBoxDetectionForward (multibox_detection.cc:40)."""
+    C, A = cls_prob.shape
+    f32 = jnp.float32
+    # argmax over foreground classes (reference starts j at 1)
+    fg = cls_prob[1:]                                            # (C-1, A)
+    score = fg.max(axis=0)
+    cid = fg.argmax(axis=0).astype(f32)                          # 0-based
+    keep_id = score >= threshold
+    # decode locations (TransformLocations)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    p = loc_pred.reshape(-1, 4)
+    ox = p[:, 0] * variances[0] * aw + ax
+    oy = p[:, 1] * variances[1] * ah + ay
+    ow = jnp.exp(p[:, 2] * variances[2]) * aw / 2
+    oh = jnp.exp(p[:, 3] * variances[3]) * ah / 2
+    boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    rows = jnp.concatenate(
+        [jnp.where(keep_id, cid, -1.0)[:, None], score[:, None], boxes], 1)
+
+    # compact valid rows to the front then stable-sort by descending score:
+    # one stable argsort with invalid rows keyed to -inf reproduces both
+    key = jnp.where(keep_id, score, -jnp.inf)
+    order = jnp.argsort(-key, stable=True)
+    rows = rows[order]
+    valid = keep_id[order]
+    if nms_topk > 0:
+        valid = valid & (jnp.arange(A) < nms_topk)
+    rows = jnp.where(valid[:, None], rows, -1.0)
+
+    if nms_threshold <= 0 or nms_threshold > 1:
+        return rows
+    iou = _iou_impl(rows[:, 2:6], rows[:, 2:6])
+    same = (rows[:, 0][:, None] == rows[:, 0][None, :]) | bool(force_suppress)
+
+    def body(i, keep):
+        sup = (iou[i] >= nms_threshold) & same[i] & \
+            (jnp.arange(A) > i) & keep[i]
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, A, body, valid)
+    return jnp.where(keep[:, None], rows,
+                     rows.at[:, 0].set(-1.0))
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Convert predictions to detections (reference:
+    _contrib_MultiBoxDetection).
+
+    cls_prob (N, C, A) softmax class probabilities (class 0 background),
+    loc_pred (N, A*4), anchor (1, A, 4). Returns (N, A, 6) rows
+    [class_id, score, x1, y1, x2, y2], class_id -1 for invalid/suppressed,
+    rows sorted by descending score.
+    """
+    if background_id != 0:
+        raise NotImplementedError("background_id must be 0 (reference "
+                                  "kernel has the same restriction)")
+
+    def fn(c, lp, a):
+        anchors = a.reshape(-1, 4)
+        one = lambda cp, l: _detect_one(
+            cp, l, anchors, float(threshold), bool(clip), tuple(variances),
+            float(nms_threshold), bool(force_suppress), int(nms_topk))
+        return jax.vmap(one)(c, lp)
+    return _invoke(fn, (cls_prob, loc_pred, anchor),
+                   name="multibox_detection")

@@ -1,0 +1,185 @@
+"""Detection augmenters + ImageDetIter
+(reference: python/mxnet/image/detection.py; tests/python/unittest/
+test_image.py TestImageDetIter)."""
+import os
+import random as pyrandom
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.image import (
+    DetHorizontalFlipAug, DetRandomCropAug, DetRandomPadAug,
+    DetRandomSelectAug, DetBorrowAug, CreateDetAugmenter,
+    CreateMultiRandCropAugmenter, ImageDetIter)
+
+
+def _img(h=40, w=60, seed=0):
+    rs = onp.random.RandomState(seed)
+    return np.array(rs.randint(0, 255, (h, w, 3)).astype(onp.float32))
+
+
+def _label():
+    # [cls, x1, y1, x2, y2]
+    return onp.array([[0.0, 0.2, 0.3, 0.6, 0.8],
+                      [1.0, 0.5, 0.1, 0.9, 0.4]], onp.float32)
+
+
+def test_flip_label_math():
+    pyrandom.seed(0)
+    aug = DetHorizontalFlipAug(p=1.1)  # always flips
+    src, lab = aug(_img(), _label())
+    want = _label()
+    x1 = 1.0 - want[:, 3].copy()
+    x2 = 1.0 - want[:, 1].copy()
+    onp.testing.assert_allclose(lab[:, 1], x1)
+    onp.testing.assert_allclose(lab[:, 3], x2)
+    # pixels mirrored
+    onp.testing.assert_allclose(src.asnumpy(),
+                                _img().asnumpy()[:, ::-1])
+
+
+def test_crop_update_labels_formula():
+    aug = DetRandomCropAug()
+    lab = _label()
+    out = aug._update_labels(lab, (12, 8, 30, 24), 40, 60)  # x,y,w,h
+    # reference formula: shift by crop origin, scale by crop size, clip
+    xmin, ymin, w, h = 12 / 60, 8 / 40, 30 / 60, 24 / 40
+    want = lab.copy()
+    want[:, (1, 3)] = onp.clip((want[:, (1, 3)] - xmin) / w, 0, 1)
+    want[:, (2, 4)] = onp.clip((want[:, (2, 4)] - ymin) / h, 0, 1)
+    for row in out:
+        match = onp.isclose(want[:, 1:5], row[1:5], atol=1e-6).all(1)
+        assert match.any()
+
+
+def test_random_crop_constraints_hold():
+    pyrandom.seed(3)
+    aug = DetRandomCropAug(min_object_covered=0.3, max_attempts=40)
+    applied = 0
+    for trial in range(20):
+        src, lab = aug(_img(seed=trial), _label())
+        arr = src.asnumpy()
+        assert lab.shape[1] == 5 and lab.shape[0] >= 1
+        assert (lab[:, 1:5] >= -1e-6).all() and (lab[:, 1:5] <= 1 + 1e-6).all()
+        assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+        if arr.shape != (40, 60, 3):
+            applied += 1
+    assert applied > 0  # the crop actually fired at least once
+
+
+def test_random_pad_geometry_and_labels():
+    pyrandom.seed(1)
+    aug = DetRandomPadAug(area_range=(1.5, 2.5), pad_val=(9, 9, 9),
+                          max_attempts=50)
+    src, lab = aug(_img(), _label())
+    arr = src.asnumpy()
+    assert arr.shape[0] >= 40 and arr.shape[1] >= 60
+    assert arr.shape[0] * arr.shape[1] > 40 * 60  # actually padded
+    # padded area exists and carries pad_val
+    orig = _img().asnumpy()
+    # labels stay normalized within the canvas, boxes shrink
+    assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    w0 = _label()[:, 3] - _label()[:, 1]
+    assert ((lab[:, 3] - lab[:, 1]) < w0 + 1e-6).all()
+    # the original pixels appear somewhere intact: find offset via label
+    # transform inverse is complex; instead check pad_val present
+    assert (arr == 9.0).any()
+    # original pixel content preserved (some row of original exists)
+    assert onp.isclose(arr.sum(), orig.sum() +
+                       9.0 * (arr.size - orig.size), rtol=1e-4)
+
+
+def test_select_aug_skip_prob():
+    pyrandom.seed(0)
+    aug = DetRandomSelectAug([DetHorizontalFlipAug(2.0)], skip_prob=0)
+    src, lab = aug(_img(), _label())
+    onp.testing.assert_allclose(src.asnumpy(), _img().asnumpy()[:, ::-1])
+    aug = DetRandomSelectAug([], skip_prob=0)  # empty -> always skip
+    src, lab = aug(_img(), _label())
+    onp.testing.assert_allclose(src.asnumpy(), _img().asnumpy())
+
+
+def test_create_det_augmenter_chain():
+    augs = CreateDetAugmenter((3, 32, 32), resize=48, rand_crop=0.5,
+                              rand_pad=0.5, rand_mirror=True,
+                              brightness=0.1, contrast=0.1, hue=0.05,
+                              pca_noise=0.01, rand_gray=0.1,
+                              mean=True, std=True)
+    names = [type(a).__name__ for a in augs]
+    assert names.count("DetRandomSelectAug") == 2  # crop + pad selectors
+    assert "DetHorizontalFlipAug" in names
+    # chain runs end to end per sample
+    pyrandom.seed(0)
+    src, lab = _img(64, 64), _label()
+    for a in augs:
+        src, lab = a(src, lab)
+    assert src.asnumpy().shape == (32, 32, 3)
+    d = a.dumps() if hasattr(a, "dumps") else None
+    assert d is not None
+
+
+def test_multi_rand_crop_augmenter():
+    aug = CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5], area_range=[(0.1, 1.0), (0.3, 1.0)],
+        skip_prob=0)
+    assert len(aug.aug_list) == 2
+    assert aug.aug_list[1].min_object_covered == 0.5
+
+
+def _write_dataset(tmpdir, n=6):
+    paths, items = [], []
+    for i in range(n):
+        rs = onp.random.RandomState(i)
+        img = rs.randint(0, 255, (50 + 4 * i, 60, 3)).astype(onp.uint8)
+        path = os.path.join(tmpdir, f"im{i}.jpg")
+        with open(path, "wb") as f:
+            f.write(mx.image.imencode(np.array(img.astype(onp.float32))))
+        # packed det label: header_w=2, obj_w=5, then (1 + i % 2) objects
+        objs = [[float(i % 3), 0.1, 0.2, 0.7, 0.8]]
+        if i % 2:
+            objs.append([1.0, 0.3, 0.3, 0.9, 0.95])
+        lab = [2.0, 5.0] + [v for o in objs for v in o]
+        items.append(lab + [f"im{i}.jpg"])
+    return items
+
+
+def test_image_det_iter_end_to_end(tmp_path):
+    items = _write_dataset(str(tmp_path))
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                      imglist=items, path_root=str(tmp_path),
+                      rand_crop=0.5, rand_mirror=True, rand_pad=0.5,
+                      brightness=0.1, mean=True, std=True)
+    assert it.label_shape == (2, 5)
+    assert it.provide_label[0][1] == (4, 2, 5)
+    batches = list(it)
+    assert len(batches) == 2
+    for b in batches:
+        data = b.data[0].asnumpy()
+        lab = b.label[0].asnumpy()
+        assert data.shape == (4, 3, 32, 32)
+        assert lab.shape == (4, 2, 5)
+        for s in range(4 - b.pad):
+            valid = lab[s][lab[s, :, 0] >= 0]
+            assert valid.shape[0] >= 1
+            assert (valid[:, 3] > valid[:, 1]).all()
+            assert (valid[:, 4] > valid[:, 2]).all()
+        # -1 padding intact where no object
+        assert (lab[lab[:, :, 0] < 0] == -1).all()
+
+
+def test_image_det_iter_reshape_and_sync(tmp_path):
+    items = _write_dataset(str(tmp_path))
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=items, path_root=str(tmp_path))
+    it2 = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                       imglist=items[:1], path_root=str(tmp_path))
+    assert it2.label_shape[0] <= it.label_shape[0]
+    it.sync_label_shape(it2)
+    assert it.label_shape == it2.label_shape
+    with pytest.raises(ValueError):
+        it.reshape(label_shape=(0, 5))
+    it.reshape(data_shape=(3, 48, 48))
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 48, 48)

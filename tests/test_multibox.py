@@ -1,0 +1,293 @@
+"""SSD multibox ops vs a direct numpy transcription of the reference
+algorithm (src/operator/contrib/multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles (independent re-implementation of the C++ loops)
+# ---------------------------------------------------------------------------
+
+def prior_oracle(h, w, sizes, ratios, steps=(-1, -1), offsets=(0.5, 0.5),
+                 clip=False):
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    out = []
+    for r in range(h):
+        cy = (r + offsets[0]) * step_y
+        for c in range(w):
+            cx = (c + offsets[1]) * step_x
+            ratio = onp.sqrt(ratios[0])
+            for s in sizes:
+                bw = s * h / w * ratio / 2
+                bh = s / ratio / 2
+                out.append([cx - bw, cy - bh, cx + bw, cy + bh])
+            s = sizes[0]
+            for rr in ratios[1:]:
+                ratio = onp.sqrt(rr)
+                bw = s * h / w * ratio / 2
+                bh = s / ratio / 2
+                out.append([cx - bw, cy - bh, cx + bw, cy + bh])
+    out = onp.array(out, onp.float32)[None]
+    return onp.clip(out, 0, 1) if clip else out
+
+
+def _iou(a, b):
+    lt = onp.maximum(a[:2], b[:2])
+    rb = onp.minimum(a[2:], b[2:])
+    wh = onp.maximum(rb - lt, 0)
+    inter = wh[0] * wh[1]
+    ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0)
+    ub = max(b[2] - b[0], 0) * max(b[3] - b[1], 0)
+    union = ua + ub - inter
+    return inter / union if union > 0 else 0.0
+
+
+def target_oracle(anchors, labels, cls_preds, overlap_threshold=0.5,
+                  ignore_label=-1, negative_mining_ratio=-1,
+                  negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    N, M, _ = labels.shape
+    A = anchors.shape[0]
+    loc_t = onp.zeros((N, A * 4), onp.float32)
+    loc_m = onp.zeros((N, A * 4), onp.float32)
+    cls_t = onp.full((N, A), float(ignore_label), onp.float32)
+    for n in range(N):
+        lab = labels[n]
+        nvalid = 0
+        for i in range(M):
+            if lab[i, 0] == -1:
+                break
+            nvalid += 1
+        if nvalid == 0:
+            continue
+        ov = onp.array([[_iou(anchors[j], lab[k, 1:5])
+                         for k in range(nvalid)] for j in range(A)])
+        gt_flags = [False] * nvalid
+        match = [(-1.0, -1)] * A
+        aflag = [-1] * A
+        npos = 0
+        while not all(gt_flags):
+            best_a, best_g, best = -1, -1, 1e-6
+            for j in range(A):
+                if aflag[j] == 1:
+                    continue
+                for k in range(nvalid):
+                    if gt_flags[k]:
+                        continue
+                    if ov[j, k] > best:
+                        best_a, best_g, best = j, k, ov[j, k]
+            if best_a == -1:
+                break
+            match[best_a] = (best, best_g)
+            gt_flags[best_g] = True
+            aflag[best_a] = 1
+            npos += 1
+        if overlap_threshold > 0:
+            for j in range(A):
+                if aflag[j] == 1:
+                    continue
+                k = int(onp.argmax(ov[j]))
+                match[j] = (ov[j, k], k)
+                if ov[j, k] > overlap_threshold:
+                    aflag[j] = 1
+                    npos += 1
+        if negative_mining_ratio > 0:
+            C = cls_preds.shape[1]
+            nneg = min(int(npos * negative_mining_ratio), A - npos)
+            if nneg > 0:
+                cand = []
+                for j in range(A):
+                    if aflag[j] == 1:
+                        continue
+                    if match[j][0] < 0:
+                        k = int(onp.argmax(ov[j]))
+                        match[j] = (ov[j, k], k)
+                    if match[j][0] < negative_mining_thresh and aflag[j] == -1:
+                        logits = cls_preds[n, :, j]
+                        e = onp.exp(logits - logits.max())
+                        # reference sorts SortElemDescend(-prob) descending:
+                        # smallest background prob first (hardest negatives)
+                        cand.append((e[0] / e.sum(), j))
+                cand.sort(key=lambda t: t[0])  # stable on ties by j
+                for _, j in cand[:nneg]:
+                    aflag[j] = 0
+        else:
+            for j in range(A):
+                if aflag[j] != 1:
+                    aflag[j] = 0
+        for j in range(A):
+            if aflag[j] == 1:
+                _, k = match[j]
+                cls_t[n, j] = lab[k, 0] + 1
+                loc_m[n, j * 4:j * 4 + 4] = 1
+                al, at, ar, ab = anchors[j]
+                aw, ah = ar - al, ab - at
+                ax, ay = (al + ar) / 2, (at + ab) / 2
+                gl, gt_, gr, gb = lab[k, 1:5]
+                gw, gh = gr - gl, gb - gt_
+                gx, gy = (gl + gr) / 2, (gt_ + gb) / 2
+                loc_t[n, j * 4:j * 4 + 4] = [
+                    (gx - ax) / aw / variances[0],
+                    (gy - ay) / ah / variances[1],
+                    onp.log(gw / aw) / variances[2],
+                    onp.log(gh / ah) / variances[3]]
+            elif aflag[j] == 0:
+                cls_t[n, j] = 0
+    return loc_t, loc_m, cls_t
+
+
+def detect_oracle(cls_prob, loc_pred, anchors, threshold=0.01, clip=True,
+                  variances=(0.1, 0.1, 0.2, 0.2), nms_threshold=0.5,
+                  force_suppress=False, nms_topk=-1):
+    N, C, A = cls_prob.shape
+    out = onp.full((N, A, 6), -1.0, onp.float32)
+    for n in range(N):
+        rows = []
+        for i in range(A):
+            score, cid = -1.0, 0
+            for j in range(1, C):
+                if cls_prob[n, j, i] > score:
+                    score, cid = cls_prob[n, j, i], j
+            if cid > 0 and score < threshold:
+                cid = 0
+            al, at, ar, ab = anchors[i]
+            aw, ah = ar - al, ab - at
+            ax, ay = (al + ar) / 2, (at + ab) / 2
+            px, py, pw, ph = loc_pred[n, i * 4:i * 4 + 4]
+            ox = px * variances[0] * aw + ax
+            oy = py * variances[1] * ah + ay
+            ow = onp.exp(pw * variances[2]) * aw / 2
+            oh = onp.exp(ph * variances[3]) * ah / 2
+            box = [ox - ow, oy - oh, ox + ow, oy + oh]
+            if clip:
+                box = [min(1.0, max(0.0, v)) for v in box]
+            rows.append([cid - 1, score] + box)
+        valid = [r for r in rows if r[0] >= 0]
+        valid.sort(key=lambda r: -r[1])  # stable
+        if nms_topk > 0:
+            valid = valid[:nms_topk]
+        if 0 < nms_threshold <= 1:
+            for i in range(len(valid)):
+                if valid[i][0] < 0:
+                    continue
+                for j in range(i + 1, len(valid)):
+                    if valid[j][0] < 0:
+                        continue
+                    if force_suppress or valid[i][0] == valid[j][0]:
+                        iou = _iou(onp.array(valid[i][2:]),
+                                   onp.array(valid[j][2:]))
+                        if iou >= nms_threshold:
+                            valid[j][0] = -1
+        for i, r in enumerate(valid):
+            out[n, i] = r
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cfg", [
+    dict(h=2, w=3, sizes=(0.5,), ratios=(1.0,)),
+    dict(h=4, w=4, sizes=(0.4, 0.25), ratios=(1.0, 2.0, 0.5)),
+    dict(h=3, w=5, sizes=(0.9,), ratios=(1.0, 3.0), clip=True),
+    dict(h=2, w=2, sizes=(0.5,), ratios=(1.0,), steps=(0.3, 0.4),
+         offsets=(0.0, 1.0)),
+])
+def test_multibox_prior(cfg):
+    h, w = cfg.pop("h"), cfg.pop("w")
+    data = np.zeros((1, 3, h, w))
+    got = mx.npx.multibox_prior(data, **cfg).asnumpy()
+    want = prior_oracle(h, w, cfg["sizes"], cfg["ratios"],
+                        cfg.get("steps", (-1, -1)),
+                        cfg.get("offsets", (0.5, 0.5)),
+                        cfg.get("clip", False))
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def _rand_case(seed, N=3, A=24, M=4, C=3):
+    rs = onp.random.RandomState(seed)
+    data = np.zeros((1, 3, 2, 4))
+    anchors = mx.npx.multibox_prior(
+        data, sizes=(0.4, 0.2), ratios=(1.0, 2.0)).asnumpy()[0]
+    A = anchors.shape[0]
+    labels = onp.full((N, M, 5), -1.0, onp.float32)
+    for n in range(N):
+        k = rs.randint(0, M + 1) if n else 0  # sample 0: no valid gt
+        for i in range(k):
+            x1, y1 = rs.uniform(0, 0.6, 2)
+            labels[n, i] = [rs.randint(0, 2), x1, y1,
+                            x1 + rs.uniform(0.1, 0.4),
+                            y1 + rs.uniform(0.1, 0.4)]
+    cls_preds = rs.randn(N, C, A).astype(onp.float32)
+    return anchors, labels, cls_preds
+
+
+@pytest.mark.parametrize("seed,mining", [(0, -1), (1, -1), (2, 3.0),
+                                         (3, 2.0)])
+def test_multibox_target(seed, mining):
+    anchors, labels, cls_preds = _rand_case(seed)
+    got = mx.npx.multibox_target(
+        np.array(anchors[None]), np.array(labels), np.array(cls_preds),
+        overlap_threshold=0.5, negative_mining_ratio=mining,
+        negative_mining_thresh=0.5)
+    want = target_oracle(anchors, labels, cls_preds,
+                         negative_mining_ratio=mining)
+    for g, w, name in zip(got, want, ["loc_target", "loc_mask",
+                                      "cls_target"]):
+        onp.testing.assert_allclose(g.asnumpy(), w, rtol=1e-4, atol=1e-5,
+                                    err_msg=name)
+
+
+@pytest.mark.parametrize("seed,topk,force", [(0, -1, False), (1, 5, False),
+                                             (2, -1, True)])
+def test_multibox_detection(seed, topk, force):
+    rs = onp.random.RandomState(seed + 10)
+    anchors, _, _ = _rand_case(seed)
+    A = anchors.shape[0]
+    N, C = 2, 3
+    logits = rs.randn(N, C, A).astype(onp.float32)
+    e = onp.exp(logits)
+    cls_prob = (e / e.sum(1, keepdims=True)).astype(onp.float32)
+    loc_pred = (rs.randn(N, A * 4) * 0.5).astype(onp.float32)
+    got = mx.npx.multibox_detection(
+        np.array(cls_prob), np.array(loc_pred), np.array(anchors[None]),
+        threshold=0.3, nms_threshold=0.45, nms_topk=topk,
+        force_suppress=force).asnumpy()
+    want = detect_oracle(cls_prob, loc_pred, anchors, threshold=0.3,
+                         nms_threshold=0.45, nms_topk=topk,
+                         force_suppress=force)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_target_hand_case():
+    # one anchor dead-on a gt, one far away: bipartite matches the first,
+    # second becomes negative (no mining)
+    anchors = onp.array([[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]],
+                        onp.float32)
+    labels = onp.array([[[1.0, 0.1, 0.1, 0.5, 0.5]]], onp.float32)
+    loc_t, loc_m, cls_t = mx.npx.multibox_target(
+        np.array(anchors[None]), np.array(labels),
+        np.array(onp.zeros((1, 3, 2), onp.float32)))
+    onp.testing.assert_allclose(cls_t.asnumpy(), [[2.0, 0.0]])
+    onp.testing.assert_allclose(loc_m.asnumpy(),
+                                [[1, 1, 1, 1, 0, 0, 0, 0]])
+    onp.testing.assert_allclose(loc_t.asnumpy()[0, :4], [0, 0, 0, 0],
+                                atol=1e-6)
+
+
+def test_detection_suppresses_same_class():
+    # two overlapping boxes same class: lower score suppressed
+    anchors = onp.array([[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.52, 0.52]],
+                        onp.float32)
+    cls_prob = onp.array([[[0.1, 0.2], [0.9, 0.8]]], onp.float32)
+    loc_pred = onp.zeros((1, 8), onp.float32)
+    out = mx.npx.multibox_detection(
+        np.array(cls_prob), np.array(loc_pred), np.array(anchors[None]),
+        nms_threshold=0.5).asnumpy()
+    assert out[0, 0, 0] == 0.0 and abs(out[0, 0, 1] - 0.9) < 1e-6
+    assert out[0, 1, 0] == -1.0

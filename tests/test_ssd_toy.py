@@ -1,0 +1,21 @@
+"""SSD toy example end-to-end (reference: example/ssd smoke level —
+tests/python/unittest/test_example off-tree equivalent)."""
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "example"))
+
+from train_ssd_toy import train, detect, make_batch  # noqa: E402
+
+
+def test_ssd_toy_trains_and_detects():
+    net, anchors, losses = train(steps=25, batch_size=8, lr=2e-3, log=False)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    rs = onp.random.RandomState(5)
+    imgs, labels = make_batch(rs, 2)
+    out = detect(net, anchors, imgs).asnumpy()
+    assert out.shape[0] == 2 and out.shape[2] == 6
+    # rows are [cls, score, x1, y1, x2, y2] sorted by score; invalid -1
+    assert ((out[:, :, 0] >= -1) & (out[:, :, 0] < 3)).all()
