@@ -376,6 +376,46 @@ def bench_augmentation(precision, on_cpu, peak, bs=256, k_steps=8):
             "ms_per_step": sec * 1e3, "precision": "fp32"}
 
 
+def bench_dataloader_workers(precision, on_cpu, peak, n=256, dim=2048,
+                             workers=4):
+    """Python-transform DataLoader: thread pool vs spawn process pool.
+
+    The transform is pure-python CPU work (the GIL wall the reference's
+    multiprocess workers exist for, gluon/data/dataloader.py:28-187);
+    reports process-pool throughput with the thread-pool number alongside.
+    """
+    import time as _t
+
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataloader import _PyBenchDataset
+
+    if on_cpu:
+        n = 64
+    ds = _PyBenchDataset(n, dim)
+
+    def run(thread_pool):
+        dl = DataLoader(ds, batch_size=16, num_workers=workers,
+                        thread_pool=thread_pool)
+        for _warm in range(1 if thread_pool else 3):
+            for b in dl:  # warm pool (spawn workers boot lazily) + caches
+                pass
+        t0 = _t.time()
+        cnt = 0
+        for b in dl:
+            cnt += b.shape[0]
+        sec = _t.time() - t0
+        if not thread_pool:
+            dl._proc_pool.shutdown(wait=False, cancel_futures=True)
+        return cnt / sec
+
+    thr = run(True)
+    proc = run(False)
+    return {"name": f"dataloader_pytransform_w{workers}",
+            "items_per_s": proc, "thread_items_per_s": thr,
+            "proc_vs_thread": proc / thr, "precision": "fp32",
+            "ms_per_step": 16e3 / proc}
+
+
 def _probe_backend(timeout_s=240):
     """The axon TPU tunnel can wedge so hard that jax.devices() never
     returns (observed: multi-hour outage, round 4). Probe it in a
@@ -423,6 +463,7 @@ def main():
         (bench_bert_train, dict(precision="bf16", bs=48)),
         (bench_bert_train, dict(precision="bf16", bs=64)),
         (bench_augmentation, dict(precision="fp32")),
+        (bench_dataloader_workers, dict(precision="fp32")),
     ]:
         if on_cpu and kwargs.get("bs", 32) != 32 and fn in (
                 bench_resnet50_train, bench_resnet50_infer,

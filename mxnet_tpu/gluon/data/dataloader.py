@@ -37,15 +37,90 @@ def default_batchify_fn(data):
                           and d.shape == first.shape
                           and d.dtype == first.dtype for d in data):
         from ... import storage
+        from ...numpy.multiarray import _wrap
+        import jax
+        import jax.numpy as jnp
         out = storage.pinned_array((len(data),) + first.shape, first.dtype)
         for i, d in enumerate(data):
             out[i] = d
+        # on a CPU backend jnp.asarray zero-copies the aligned pooled
+        # block; the pool then recycles it under the live device array and
+        # later batches overwrite earlier ones. Force a real copy there.
+        # On an accelerator the host->HBM transfer already copies, and the
+        # pooled staging block is exactly what we want to hand it.
+        if jax.default_backend() == "cpu":
+            return _wrap(jnp.array(out, copy=True))
         return _np.array(out)
     return _np.array(onp.asarray(data))
 
 
 def default_mp_batchify_fn(data):
-    return default_batchify_fn(data)
+    """Worker-process batchify: stacks to HOST numpy (reference:
+    dataloader.py:55 builds NDArrays in shared memory; device buffers
+    cannot cross a process boundary, so workers stay numpy and the main
+    process does the one host->HBM copy per batch)."""
+    if isinstance(data[0], ndarray):
+        data = [d.asnumpy() for d in data]
+    if isinstance(data[0], (tuple, list)):
+        return type(data[0])(
+            default_mp_batchify_fn(list(x)) for x in zip(*data))
+    return onp.stack([onp.asarray(d) for d in data])
+
+
+# ---------------------------------------------------------------------------
+# multiprocess workers (reference: dataloader.py:28-187 worker_loop +
+# ConnectionWrapper + shared-memory NDArray rebuild over
+# src/storage/cpu_shared_storage_manager.h). Transport here is
+# multiprocessing.shared_memory: the worker writes each batch leaf into a
+# fresh shm block and ships (name, shape, dtype); the main process copies
+# it into a device array and unlinks.
+# ---------------------------------------------------------------------------
+
+_worker_state = {}
+
+
+def _mp_worker_init(dataset, batchify):
+    _worker_state["dataset"] = dataset
+    _worker_state["batchify"] = batchify
+
+
+def _to_shm(batch):
+    from multiprocessing import shared_memory
+    if isinstance(batch, (tuple, list)):
+        return (type(batch).__name__, [_to_shm(b) for b in batch])
+    a = onp.ascontiguousarray(onp.asarray(batch))
+    shm = shared_memory.SharedMemory(create=True, size=max(a.nbytes, 1))
+    onp.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+    name = shm.name
+    shm.close()
+    return ("arr", name, a.shape, str(a.dtype))
+
+
+def _mp_worker_task(indices):
+    ds, bf = _worker_state["dataset"], _worker_state["batchify"]
+    return _to_shm(bf([ds[i] for i in indices]))
+
+
+def _from_shm(spec):
+    from multiprocessing import shared_memory
+    if spec[0] == "arr":
+        _, name, shape, dtype = spec
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            import jax.numpy as jnp
+            from ...numpy.multiarray import _wrap
+            view = onp.ndarray(shape, dtype, buffer=shm.buf)
+            # copy=True is load-bearing: a CPU backend would otherwise
+            # zero-copy the shm mapping, which is unmapped two lines down
+            out = _wrap(jnp.array(view, copy=True))
+            out._data.block_until_ready()  # transfer done before unmap
+        finally:
+            shm.close()
+            shm.unlink()
+        return out
+    kind, parts = spec
+    seq = [_from_shm(p) for p in parts]
+    return tuple(seq) if kind == "tuple" else seq
 
 
 class DataLoader:
@@ -71,36 +146,86 @@ class DataLoader:
             batch_sampler = BatchSampler(sampler, batch_size,
                                          last_batch or "keep")
         self._batch_sampler = batch_sampler
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._thread_pool = thread_pool
+        if batchify_fn is None:
+            batchify_fn = (default_batchify_fn
+                           if thread_pool or num_workers == 0
+                           else default_mp_batchify_fn)
+        self._batchify_fn = batchify_fn
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        self._proc_pool = None
 
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
+
+    def _get_proc_pool(self):
+        # persistent spawn pool (reference keeps its worker pool for the
+        # loader lifetime, dataloader.py:520); spawn not fork — the parent
+        # holds live PJRT/XLA state that must not be forked
+        if self._proc_pool is None:
+            import multiprocessing as mp
+            self._proc_pool = cf.ProcessPoolExecutor(
+                self._num_workers,
+                mp_context=mp.get_context("spawn"),
+                initializer=_mp_worker_init,
+                initargs=(self._dataset, self._batchify_fn))
+        return self._proc_pool
 
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
-        # thread-pool pipeline with bounded prefetch (the analog of
-        # iter_prefetcher.h's threaded prefetch chain)
-        with cf.ThreadPoolExecutor(self._num_workers) as pool:
-            pending = []
-            it = iter(self._batch_sampler)
+        if self._thread_pool:
+            # thread-pool pipeline with bounded prefetch (the analog of
+            # iter_prefetcher.h's threaded prefetch chain)
+            with cf.ThreadPoolExecutor(self._num_workers) as pool:
+                yield from self._pump(pool, self._make_batch, lambda r: r)
+            return
+        pool = self._get_proc_pool()
+        yield from self._pump(pool, _mp_worker_task, _from_shm)
+
+    def _pump(self, pool, task, unwrap):
+        pending = []
+        it = iter(self._batch_sampler)
+        try:
+            for _ in range(self._prefetch or self._num_workers):
+                pending.append(pool.submit(task, next(it)))
+        except StopIteration:
+            pass
+        while pending:
+            fut = pending.pop(0)
             try:
-                for _ in range(self._prefetch or self._num_workers):
-                    pending.append(pool.submit(self._make_batch, next(it)))
+                pending.append(pool.submit(task, next(it)))
             except StopIteration:
                 pass
-            while pending:
-                fut = pending.pop(0)
-                try:
-                    pending.append(pool.submit(self._make_batch, next(it)))
-                except StopIteration:
-                    pass
-                yield fut.result(timeout=self._timeout)
+            yield unwrap(fut.result(timeout=self._timeout))
+
+    def __del__(self):
+        if self._proc_pool is not None:
+            self._proc_pool.shutdown(wait=False, cancel_futures=True)
 
     def __len__(self):
         return len(self._batch_sampler)
+
+
+class _PyBenchDataset:
+    """Picklable synthetic dataset with a deliberately GIL-bound python
+    transform (bench: dataloader_pytransform row)."""
+
+    def __init__(self, n=256, dim=2048):
+        rs = onp.random.RandomState(0)
+        self.x = rs.rand(n, dim).astype(onp.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        row = self.x[i]
+        acc = 0.0
+        for _ in range(5):           # ~1 ms of pure-python GIL-bound work
+            for v in row[:2048:1]:
+                acc += float(v) * 1.0000001
+        return row * onp.float32(1.0 + 0.0 * acc)

@@ -15,8 +15,18 @@ import jax
 from . import config
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(config.get("seed"))
+# lazily materialized: building a PRNGKey initializes the jax backend, and
+# importing the package must NOT touch devices (spawned dataloader workers
+# and CLI tools import mxnet_tpu with no accelerator in reach)
+_key = None
 _trace = threading.local()
+
+
+def _global_key():
+    global _key
+    if _key is None:
+        _key = jax.random.PRNGKey(config.get("seed"))
+    return _key
 
 
 def seed(seed_state, ctx="all"):
@@ -41,7 +51,7 @@ def _next_key():
         return sub
     global _key, _fallback_n
     with _lock:
-        nxt, sub = jax.random.split(_key)
+        nxt, sub = jax.random.split(_global_key())
         if isinstance(nxt, jax.core.Tracer):
             # Called under an external jit trace without a trace_key_scope:
             # never leak a tracer into the process-global key. Derive a unique
