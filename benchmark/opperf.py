@@ -83,13 +83,167 @@ def _default_ops(mx, shape):
     return ops
 
 
-def run(ops=None, warmup=5, iters=100, shape=(128, 128)):
+def _full_surface_ops(mx):
+    """Every op in the locked REF_NP/REF_NPX/REF_RANDOM/REF_LINALG tables
+    (reference: benchmark/opperf runs every registered op, opperf.py:56).
+
+    np-surface argument specs are borrowed from the numeric sweep
+    (tests/test_numpy_op_sweep.ALL_FORWARD) so each op gets valid inputs;
+    npx/linalg/random get spec tables here. Shapes are small, so e2e ~
+    dispatch for most rows — which is the eager-path number the TPU design
+    cares about (SURVEY §7 hard part #1); the hand-tuned larger-shape
+    table covers the device-time hot set.
+    """
+    import importlib.util
+
+    np, npx = mx.np, mx.npx
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(here, "tests")
+    sys.path.insert(0, tests)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "op_sweep_cases", os.path.join(tests, "test_numpy_op_sweep.py"))
+        sweep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sweep)
+    finally:
+        sys.path.remove(tests)
+
+    ops = {}
+    for name, cases in sorted(sweep.ALL_FORWARD.items()):
+        args, kwargs = cases[0]
+        mx_args = [sweep._to_mx(a) for a in args]
+        fn = getattr(np, name, None)
+        if fn is None:
+            continue
+        ops[f"np.{name}"] = (lambda f=fn, a=mx_args, k=kwargs: f(*a, **k))
+
+    # npx layer/tensor op specs (REF_NPX minus control flow, which is not
+    # a timed primitive)
+    x = np.random.uniform(size=(8, 16))
+    img = np.random.uniform(size=(2, 3, 16, 16))
+    w = np.random.uniform(size=(8, 3, 3, 3))
+    fc_w = np.random.uniform(size=(4, 16))
+    idx = np.array(onp.random.randint(0, 8, (8,)), dtype="int32")
+    gamma, beta = np.ones((16,)), np.zeros((16,))
+    rnn_x = np.random.uniform(size=(4, 2, 8))
+    rnn_p = np.random.uniform(size=(2 * (4 * 8 * (8 + 8 + 2)) // 2,))
+    state = np.zeros((1, 2, 8))
+    npx_specs = {
+        "activation": lambda: npx.activation(x, act_type="relu"),
+        "arange_like": lambda: npx.arange_like(x, axis=0),
+        "batch_dot": lambda: npx.batch_dot(img.reshape(2, 3, 256),
+                                           img.reshape(2, 256, 3)),
+        "batch_norm": lambda: npx.batch_norm(
+            img, np.ones((3,)), np.zeros((3,)), np.zeros((3,)),
+            np.ones((3,))),
+        "broadcast_like": lambda: npx.broadcast_like(x[:1], x),
+        "convolution": lambda: npx.convolution(
+            img, w, kernel=(3, 3), num_filter=8),
+        "deconvolution": lambda: npx.deconvolution(
+            img, np.random.uniform(size=(3, 8, 3, 3)), kernel=(3, 3),
+            num_filter=8),
+        "dropout": lambda: npx.dropout(x, p=0.5),
+        "embedding": lambda: npx.embedding(idx, fc_w, input_dim=4,
+                                           output_dim=16),
+        "fully_connected": lambda: npx.fully_connected(
+            x, fc_w, num_hidden=4, no_bias=True),
+        "group_norm": lambda: npx.group_norm(
+            img, np.ones((3,)), np.zeros((3,)), num_groups=3),
+        "layer_norm": lambda: npx.layer_norm(x, gamma, beta, axis=-1),
+        "leaky_relu": lambda: npx.leaky_relu(x, act_type="leaky"),
+        "log_softmax": lambda: npx.log_softmax(x),
+        "masked_log_softmax": lambda: npx.masked_log_softmax(
+            x, np.ones(x.shape, dtype="bool")),
+        "masked_softmax": lambda: npx.masked_softmax(
+            x, np.ones(x.shape, dtype="bool")),
+        "one_hot": lambda: npx.one_hot(idx, 8),
+        "pick": lambda: npx.pick(x, np.array(onp.zeros((8,)), dtype="int32"),
+                                 axis=-1),
+        "pooling": lambda: npx.pooling(img, kernel=(2, 2), stride=(2, 2)),
+        "rnn": lambda: npx.rnn(rnn_x, rnn_p, state, state_size=8,
+                               num_layers=1, mode="rnn_tanh"),
+        "softmax": lambda: npx.softmax(x),
+        "topk": lambda: npx.topk(x, k=4),
+        "reshape": lambda: npx.reshape(x, (-1,)),
+        "constraint_check": lambda: npx.constraint_check(x > -100),
+        "nonzero": lambda: npx.nonzero(x),
+        "gamma": lambda: npx.gamma(x + 1.0),
+        "sequence_mask": lambda: npx.sequence_mask(
+            rnn_x, np.array([2.0, 3.0]), use_sequence_length=True),
+    }
+    for name, fn in npx_specs.items():
+        ops[f"npx.{name}"] = fn
+
+    m = np.random.uniform(size=(16, 16))
+    spd = np.matmul(m, np.transpose(m)) + 16 * np.eye(16)
+    linalg_specs = {
+        "cholesky": lambda: np.linalg.cholesky(spd),
+        "det": lambda: np.linalg.det(m),
+        "eig": lambda: np.linalg.eig(m),
+        "eigh": lambda: np.linalg.eigh(spd),
+        "eigvals": lambda: np.linalg.eigvals(m),
+        "eigvalsh": lambda: np.linalg.eigvalsh(spd),
+        "inv": lambda: np.linalg.inv(spd),
+        "lstsq": lambda: np.linalg.lstsq(m, m[:, 0], rcond=None),
+        "matrix_power": lambda: np.linalg.matrix_power(m, 3),
+        "matrix_rank": lambda: np.linalg.matrix_rank(m),
+        "multi_dot": lambda: np.linalg.multi_dot([m, m, m]),
+        "norm": lambda: np.linalg.norm(m),
+        "pinv": lambda: np.linalg.pinv(m),
+        "qr": lambda: np.linalg.qr(m),
+        "slogdet": lambda: np.linalg.slogdet(spd),
+        "solve": lambda: np.linalg.solve(spd, m[:, 0]),
+        "svd": lambda: np.linalg.svd(m),
+        "tensorinv": lambda: np.linalg.tensorinv(
+            (np.random.uniform(size=(4, 4)) + 4 * np.eye(4)).reshape(
+                2, 2, 2, 2), ind=2),
+        "tensorsolve": lambda: np.linalg.tensorsolve(
+            np.random.uniform(size=(2, 2, 2, 2)) + np.eye(4).reshape(
+                2, 2, 2, 2) * 4, np.random.uniform(size=(2, 2))),
+    }
+    for name, fn in linalg_specs.items():
+        ops[f"linalg.{name}"] = fn
+
+    rnd = np.random
+    random_specs = {
+        "beta": lambda: rnd.beta(2.0, 3.0, size=(8, 8)),
+        "chisquare": lambda: rnd.chisquare(3.0, size=(8, 8)),
+        "choice": lambda: rnd.choice(8, size=(8,)),
+        "exponential": lambda: rnd.exponential(1.0, size=(8, 8)),
+        "f": lambda: rnd.f(3.0, 4.0, size=(8, 8)),
+        "gamma": lambda: rnd.gamma(2.0, 1.0, size=(8, 8)),
+        "gumbel": lambda: rnd.gumbel(0.0, 1.0, size=(8, 8)),
+        "logistic": lambda: rnd.logistic(0.0, 1.0, size=(8, 8)),
+        "lognormal": lambda: rnd.lognormal(0.0, 1.0, size=(8, 8)),
+        "multinomial": lambda: rnd.multinomial(
+            8, [0.25, 0.25, 0.5], size=(4,)),
+        "multivariate_normal": lambda: rnd.multivariate_normal(
+            np.zeros((2,)), np.eye(2), size=(8,)),
+        "normal": lambda: rnd.normal(0.0, 1.0, size=(8, 8)),
+        "pareto": lambda: rnd.pareto(2.0, size=(8, 8)),
+        "power": lambda: rnd.power(2.0, size=(8, 8)),
+        "randint": lambda: rnd.randint(0, 8, size=(8, 8)),
+        "rayleigh": lambda: rnd.rayleigh(1.0, size=(8, 8)),
+        "shuffle": lambda: rnd.shuffle(np.arange(8)),
+        "uniform": lambda: rnd.uniform(0.0, 1.0, size=(8, 8)),
+        "weibull": lambda: rnd.weibull(2.0, size=(8, 8)),
+        "rand": lambda: rnd.rand(8, 8),
+    }
+    for name, fn in random_specs.items():
+        ops[f"random.{name}"] = fn
+    return ops
+
+
+def run(ops=None, warmup=5, iters=100, shape=(128, 128), full=False):
     import mxnet_tpu as mx
     table = _default_ops(mx, shape)
+    if full:
+        table.update(_full_surface_ops(mx))
     if ops:
         table = {k: v for k, v in table.items() if k in ops}
     rows = []
     for name, fn in table.items():
+        out = None
         try:
             for _ in range(warmup):
                 out = fn()
@@ -116,10 +270,16 @@ def main():
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--shape", default="128,128")
     p.add_argument("--json", default=None, help="also write JSON here")
+    p.add_argument("--full", action="store_true",
+                   help="every op in the locked REF_* surfaces "
+                        "(writes OPPERF.json by default)")
     args = p.parse_args()
     shape = tuple(int(s) for s in args.shape.split(","))
     ops = set(args.ops.split(",")) if args.ops else None
-    rows = run(ops=ops, iters=args.iters, shape=shape)
+    if args.full and args.json is None:
+        args.json = os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "OPPERF.json")
+    rows = run(ops=ops, iters=args.iters, shape=shape, full=args.full)
     print(f"{'Op':24s} {'dispatch(us)':>14s} {'e2e(us)':>12s}")
     for r in sorted(rows, key=lambda r: -r.get("e2e_us", 0)):
         if "error" in r:

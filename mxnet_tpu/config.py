@@ -138,6 +138,11 @@ declare("cached_graph.max_signatures", int, 512,
         "trace caches are flushed (bounds the recompile/memory blowup from "
         "varying python scalars; reference analog: CachedOpConfig limits, "
         "src/imperative/cached_op.h:412-459)")
+declare("kvstore.async_timeout", float, 120.0,
+        "MXNET_KVSTORE_ASYNC_TIMEOUT",
+        "Seconds a dist_async reconciling pull may wait on its collective "
+        "before failing loudly (mismatched pull schedules deadlock the "
+        "SPMD psum; the reference's ZMQ server has no such constraint)")
 declare("home", str, os.path.join("~", ".mxnet"), "MXNET_HOME",
         "Cache root for datasets/pretrained weights (reference: base.py "
         "data_dir).")

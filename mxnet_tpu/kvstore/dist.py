@@ -159,9 +159,48 @@ class DistAsyncKVStore(DistKVStore):
                     merged._data.astype(self._store[k].dtype))
 
     def _reconcile(self, k):
-        """Average replicas across processes; adopt the average locally."""
+        """Average replicas across processes; adopt the average locally.
+
+        Watchdog: the reconciling psum is an SPMD collective, so a
+        mismatched pull schedule across processes HANGS inside XLA (the
+        documented divergence from the reference's ZMQ server, which has
+        no such constraint). The collective's completion wait runs on a
+        helper thread with a deadline; on timeout this raises a diagnostic
+        naming the key and this process's reconcile sequence number so the
+        mismatched schedule is debuggable instead of a silent freeze.
+        """
         if self._nprocs > 1:
-            avg = self._allreduce(self._store[k])._data / self._nprocs
+            import threading
+
+            from .. import config as _config
+            self._reconcile_seq = getattr(self, "_reconcile_seq", 0) + 1
+            timeout = _config.get("kvstore.async_timeout")
+            result = {}
+
+            def wait():
+                try:
+                    out = self._allreduce(self._store[k])._data
+                    out.block_until_ready()
+                    result["value"] = out
+                except Exception as e:  # noqa: BLE001 - ferried to caller
+                    result["error"] = e
+
+            t = threading.Thread(target=wait, daemon=True)
+            t.start()
+            t.join(timeout)
+            if t.is_alive():
+                raise MXNetError(
+                    f"dist_async reconcile #{self._reconcile_seq} for key "
+                    f"'{k}' timed out after {timeout}s on rank "
+                    f"{self.rank}/{self.num_workers}. Every process must "
+                    "pull the same keys in the same order the same number "
+                    "of times (SPMD collective constraint); a "
+                    "data-dependent pull schedule deadlocks here. Align "
+                    "the pull schedule or raise mx.config "
+                    "'kvstore.async_timeout'.")
+            if "error" in result:
+                raise result["error"]
+            avg = result["value"] / self._nprocs
             self._store[k]._rebind(avg.astype(self._store[k].dtype))
         return self._store[k]
 

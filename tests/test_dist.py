@@ -97,3 +97,38 @@ def test_single_process_dist_kvstore_degenerates():
     out = mx.np.empty((3,))
     kv.pull("a", out=out)
     onp.testing.assert_array_equal(out.asnumpy(), onp.full((3,), 2.0))
+
+
+def test_dist_async_watchdog_times_out():
+    """A hung reconciling collective must raise with a schedule diagnostic
+    (the documented dist_async divergence, kvstore/dist.py:121) instead of
+    freezing. The hang is simulated: a real mismatched pull schedule
+    blocks inside XLA exactly like this stand-in."""
+    import time
+
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np
+    from mxnet_tpu.kvstore.dist import DistAsyncKVStore
+
+    kv = DistAsyncKVStore.__new__(DistAsyncKVStore)
+    kv._store = {"w": np.zeros((4,))}
+    kv._nprocs = 2
+    kv._rank = 0
+
+    def hang(merged):
+        time.sleep(60)
+        return merged
+
+    kv._allreduce = hang
+    type(kv).rank = property(lambda self: 0)
+    old = mx.config.get("kvstore.async_timeout")
+    mx.config.set("kvstore.async_timeout", 0.5)
+    try:
+        t0 = time.time()
+        with pytest.raises(mx.base.MXNetError, match="pull schedule"):
+            kv._reconcile("w")
+        assert time.time() - t0 < 5
+    finally:
+        mx.config.set("kvstore.async_timeout", old)

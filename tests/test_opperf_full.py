@@ -1,0 +1,29 @@
+"""Full-surface opperf harness (reference: benchmark/opperf/opperf.py:56
+runs every registered op)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "benchmark"))
+
+
+@pytest.mark.slow
+def test_opperf_covers_locked_surfaces():
+    import opperf
+    from test_op_coverage import REF_NPX, REF_LINALG, REF_RANDOM
+
+    rows = opperf.run(full=True, warmup=1, iters=2)
+    names = {r["op"] for r in rows}
+    errs = [r for r in rows if "error" in r]
+    assert not errs, errs[:5]
+    for op in REF_NPX:
+        if op in ("cond", "foreach", "while_loop"):  # control flow, untimed
+            continue
+        assert f"npx.{op}" in names, op
+    for op in REF_LINALG:
+        assert f"linalg.{op}" in names, op
+    for op in REF_RANDOM:
+        assert f"random.{op}" in names, op
+    assert len(names) >= 290
