@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Per-process scaling probe, run under tools/launch.py.
+
+Each rank pins itself to a distinct core set BEFORE importing jax, so the
+measured collective latency is communication + framework overhead — not
+the core contention that pollutes the in-process virtual-mesh table
+(MULTICHIP weak-scaling caveat). Prints one line per rank:
+
+    PROC_SCALING {"rank", "n", "compute_ms", "allreduce": [...]}
+
+Reference anchor: tools/bandwidth/measure.py + tests/nightly/
+dist_sync_kvstore.py launch taxonomy.
+"""
+import json
+import os
+import time
+
+rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+nproc = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+ncores = os.cpu_count() or 1
+per = max(1, ncores // max(nproc, 1))
+cores = {(rank * per + i) % ncores for i in range(per)}  # wraps when
+os.sched_setaffinity(0, cores)                           # ranks > cores
+
+import jax  # noqa: E402  (after affinity pinning)
+
+from mxnet_tpu._dist_init import ensure_distributed  # noqa: E402
+
+ensure_distributed()
+
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.parallel.collectives import (  # noqa: E402
+    allreduce_across_processes)
+
+
+def main():
+    # local compute reference: jitted 512^2 matmul chain on this rank's core
+    m = jnp.ones((512, 512), jnp.float32)
+    f = jax.jit(lambda x: x @ x * 0.999)
+    f(m).block_until_ready()
+    t0 = time.perf_counter()
+    out = m
+    for _ in range(20):
+        out = f(out)
+    out.block_until_ready()
+    compute_ms = (time.perf_counter() - t0) / 20 * 1e3
+
+    rows = []
+    for nfloat in (1 << 18, 1 << 22):          # 1 MiB, 16 MiB payloads
+        v = jnp.ones((nfloat,), jnp.float32)
+        allreduce_across_processes(v).block_until_ready()  # compile+connect
+        t0 = time.perf_counter()
+        iters = 8
+        for _ in range(iters):
+            out = allreduce_across_processes(v)
+        out.block_until_ready()
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        rows.append({"bytes": nfloat * 4, "allreduce_ms": round(ms, 3),
+                     "gbps": round(nfloat * 4 * 8 / (ms / 1e3) / 1e9, 2)})
+
+    print("PROC_SCALING " + json.dumps({
+        "rank": rank, "n": nproc, "cores_per_rank": per,
+        "compute_ms": round(compute_ms, 3), "allreduce": rows}),
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,5 +80,103 @@ def weak_scaling_table(ns=None, devices=None, per_device_batch=4,
             "ms_per_step": round(dt * 1e3, 2),
             "images_per_s": round(bs / dt, 1),
             "efficiency": round(t1 / dt, 3),
+            # isolated collective cost at this n: a bare jitted psum of a
+            # gradient-sized vector over the same mesh. On the virtual
+            # mesh this is the number a reader can extrapolate from —
+            # step-time growth beyond (compute_n1 + collective) is host
+            # core contention, not communication.
+            "collective_ms": round(_time_allreduce(mesh, net) * 1e3, 3),
         })
+    if rows:
+        rows[0]["decomposition"] = (
+            "ms_per_step(n=1) is pure compute; collective_ms isolates the "
+            "gradient-allreduce at each n; the remainder of the step-time "
+            "growth on a virtual mesh is host-core contention")
+    return rows
+
+
+def _time_allreduce(mesh, net, iters=10):
+    """Time one jitted gradient-sized psum over the mesh's 'dp' axis."""
+    import functools
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.devices.size
+    nparams = sum(int(onp_prod(p.shape)) for p in
+                  net.collect_params().values() if p._data is not None)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P("dp"))
+    def ar(v):
+        return jax.lax.psum(v, "dp")
+
+    v = jnp.ones((n, max(nparams // max(n, 1), 1)), jnp.float32)
+    v = jax.device_put(v, NamedSharding(mesh, P("dp")))
+    ar(v).block_until_ready()  # compile
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        out = ar(v)
+    out.block_until_ready()
+    return (_t.perf_counter() - t0) / iters
+
+
+def onp_prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def multiprocess_overhead_table(ns=(2, 4), timeout=420):
+    """Launch n real processes (tools/launch.py, one core-set each) and
+    measure the DCN-path collective in isolation: per-rank jitted matmul
+    compute vs allreduce_across_processes latency at two payload sizes.
+
+    Separates process-collective overhead from the shared-core contention
+    that dominates the virtual in-process mesh (reference anchor:
+    tests/nightly/dist_sync_kvstore.py launch taxonomy). Rows come from
+    rank 0 of each run; failures degrade to an {'n', 'error'} row.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = os.path.join(repo, "benchmark", "scaling_proc.py")
+    rows = []
+    for n in ns:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools", "launch.py"),
+                 "-n", str(n), sys.executable, script],
+                capture_output=True, text=True, timeout=timeout, env=env,
+                cwd=repo)
+        except subprocess.TimeoutExpired:
+            rows.append({"n": n, "error": f"timeout {timeout}s"})
+            continue
+        row = None
+        for line in r.stdout.splitlines():
+            if line.startswith("PROC_SCALING "):
+                cand = json.loads(line[len("PROC_SCALING "):])
+                if cand.get("rank") == 0:
+                    row = cand
+        if row is None:
+            rows.append({"n": n, "error":
+                         (r.stderr or r.stdout)[-300:] or "no output"})
+        else:
+            row.pop("rank", None)
+            if (os.cpu_count() or 1) < n:
+                row["shared_cores"] = True  # pinning impossible: ranks
+                # contend for cores, so allreduce_ms includes contention
+            rows.append(row)
     return rows
