@@ -138,6 +138,9 @@ declare("cached_graph.max_signatures", int, 512,
         "trace caches are flushed (bounds the recompile/memory blowup from "
         "varying python scalars; reference analog: CachedOpConfig limits, "
         "src/imperative/cached_op.h:412-459)")
+declare("fused_ln_residual", str, "auto", "MXNET_FUSED_LN_RESIDUAL",
+        "Pallas fused dropout+residual+LayerNorm in transformer encoder "
+        "cells: 'auto' (TPU only), 'on', 'off'.")
 declare("kvstore.async_timeout", float, 120.0,
         "MXNET_KVSTORE_ASYNC_TIMEOUT",
         "Seconds a dist_async reconciling pull may wait on its collective "

@@ -74,6 +74,53 @@ class PositionwiseFFN(HybridBlock):
         return h
 
 
+def _fused_ln_residual(x, h, ln, p):
+    """Route ``LN(x + dropout(h))`` through the fused Pallas kernel
+    (ops/pallas/ln_residual.py) when eligible, else return None.
+
+    Gated by mx.config ``fused_ln_residual``: 'auto' engages on TPU
+    backends only (the kernel works via interpret=True elsewhere but XLA's
+    own fusion is the right call on CPU); feature dim must be a lane
+    multiple (128) and the LayerNorm must be the default last-axis one.
+    """
+    import jax
+
+    from ... import autograd, config
+    from ... import random as _random
+    from ...numpy.multiarray import _invoke
+
+    mode = config.get("fused_ln_residual")
+    if mode == "off" or ln._axis not in (-1, x.ndim - 1):
+        return None
+    on_tpu = jax.default_backend() == "tpu"
+    if mode == "auto" and not on_tpu:
+        return None
+    dim = x.shape[-1]
+    if dim % 128 != 0:
+        return None
+    ch = x.shape[-1]
+    for prm in (ln.gamma, ln.beta):
+        if not prm._shape_known():
+            prm._finish_deferred_init((ch,))
+        elif prm._data is None:
+            prm._finish_deferred_init()
+    from ...ops.pallas.ln_residual import ln_residual_dropout
+
+    p_eff = float(p) if autograd.is_training() else 0.0
+    key = _random._next_key() if p_eff > 0 else None
+    eps = ln._epsilon
+    interpret = not on_tpu
+
+    def fn(x_, h_, g_, b_):
+        mask = (jax.random.bernoulli(key, 1.0 - p_eff, h_.shape)
+                if p_eff > 0 else None)
+        return ln_residual_dropout(x_, h_, g_, b_, p=p_eff, mask=mask,
+                                   eps=eps, interpret=interpret)
+
+    return _invoke(fn, (x, h, ln.gamma.data(), ln.beta.data()),
+                   name="fused_ln_residual")
+
+
 class TransformerEncoderCell(HybridBlock):
     """One encoder layer: MHA + FFN with residuals.
 
@@ -91,6 +138,7 @@ class TransformerEncoderCell(HybridBlock):
         self.ffn = PositionwiseFFN(units, hidden_size, activation, dropout)
         self.ffn_ln = LayerNorm()
         self.dropout = Dropout(dropout) if dropout else None
+        self._dropout_rate = float(dropout)
 
     def forward(self, x, mask=None):
         from ...parallel.mesh import constrain
@@ -101,10 +149,18 @@ class TransformerEncoderCell(HybridBlock):
             h = self.ffn(self.ffn_ln(x))
             return constrain(x + h, "residual")
         h = self.attention(x, mask=mask)
-        x = constrain(
-            self.attn_ln(x + (self.dropout(h) if self.dropout else h)),
-            "residual")
+        p = self._dropout_rate if self.dropout is not None else 0.0
+        fused = _fused_ln_residual(x, h, self.attn_ln, p)
+        if fused is not None:
+            x = constrain(fused, "residual")
+        else:
+            x = constrain(
+                self.attn_ln(x + (self.dropout(h) if self.dropout else h)),
+                "residual")
         h = self.ffn(x)
+        fused = _fused_ln_residual(x, h, self.ffn_ln, 0.0)
+        if fused is not None:
+            return constrain(fused, "residual")
         return constrain(self.ffn_ln(x + h), "residual")
 
 
