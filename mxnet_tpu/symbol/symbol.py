@@ -495,6 +495,51 @@ class Executor:
                 self.grad_dict[name] = arr.grad
         return self.grad_dict
 
+    # -- reference surface tail (executor.py:232-393) ---------------------
+    @property
+    def arg_arrays(self):
+        return list(self.arg_dict.values())
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_dict]
+
+    @property
+    def aux_dict(self):
+        """Aux states: the functional graph keeps none outside arg_dict
+        (BatchNorm stats ride Gluon parameters); kept for surface parity."""
+        return {}
+
+    @property
+    def aux_arrays(self):
+        return list(self.aux_dict.values())
+
+    @property
+    def output_dict(self):
+        names = self._symbol.list_outputs()
+        return {n: o for n, o in zip(names, self.outputs)}
+
+    def get_optimized_symbol(self):
+        """XLA owns graph optimization; the bound symbol IS the graph
+        (reference: executor.py:126 returns the partitioned symbol)."""
+        return self._symbol
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Load a parameter dict into the bound arrays
+        (reference: executor.py:342)."""
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                dst = self.arg_dict[name]
+                dst._rebind(array._data.astype(dst.dtype))
+            elif not allow_extra_params:
+                raise ValueError(
+                    f'Find name "{name}" that is not in the arguments')
+        for name in (aux_params or {}):
+            if not allow_extra_params:
+                raise ValueError(
+                    f"Find name {name} that is not in the auxiliary states")
+
 
 def __getattr__(name):
     """Any mx.np / mx.npx / legacy-table op lifted to symbolic composition

@@ -187,3 +187,32 @@ def test_attrs_survive_json_roundtrip():
     r2 = r2[0] if isinstance(r2, list) else r2
     r1 = r1[0] if isinstance(r1, list) else r1
     onp.testing.assert_allclose(r2.asnumpy(), r1.asnumpy())
+
+
+def test_executor_surface_tail():
+    """arg_arrays/grad_arrays/output_dict/copy_params_from
+    (reference: executor.py:232-393)."""
+    import numpy as onp
+    import pytest
+
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a * b).as_np_ndarray() if hasattr(a * b, "as_np_ndarray") else a * b
+    args = {"a": np.array([1.0, 2.0]), "b": np.array([3.0, 4.0])}
+    ex = c.bind(None, args) if hasattr(c, "bind") else None
+    if ex is None:
+        from mxnet_tpu.executor import Executor
+        ex = Executor(c, args)
+    ex.forward(is_train=True)
+    ex.backward()
+    assert len(ex.arg_arrays) == 2
+    assert set(ex.output_dict) == set(c.list_outputs())
+    assert ex.get_optimized_symbol() is c
+    assert ex.aux_dict == {}
+    g = ex.grad_arrays
+    assert len(g) == 2 and g[0] is not None
+    ex.copy_params_from({"a": np.array([5.0, 6.0])})
+    onp.testing.assert_allclose(ex.arg_dict["a"].asnumpy(), [5.0, 6.0])
+    with pytest.raises(ValueError):
+        ex.copy_params_from({"zz": np.array([1.0])})
+    ex.copy_params_from({"zz": np.array([1.0])}, allow_extra_params=True)
