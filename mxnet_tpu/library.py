@@ -16,13 +16,23 @@ from .base import MXNetError
 
 _loaded = {}
 
+#: native operator-plugin ABI this build speaks (reference:
+#: src/lib_api.cc MX_LIBRARY_VERSION handshake). A plugin .so exports
+#: mxtpu_plugin_abi_version() returning exactly this value, plus
+#: name/num_ops/op_name/op_call — see native/mxtpu_plugin_example.cc for
+#: the canonical implementation.
+PLUGIN_ABI_VERSION = 1
+
 
 def load(path, verbose=True):
     """Load an extension.
 
     - a ``.py`` path or module name: imported; its ``register(registry)``
       hook, if present, is called with the framework op registry.
-    - a ``.so`` path: loaded via ctypes for host-native components.
+    - a ``.so`` path: if it speaks the versioned operator-plugin ABI
+      (exports ``mxtpu_plugin_abi_version``), its ops are registered as
+      eager/jit-capable operators; otherwise it is a plain ctypes load
+      for host-side components.
     """
     if path in _loaded:
         return _loaded[path]
@@ -30,6 +40,8 @@ def load(path, verbose=True):
         if not os.path.exists(path):
             raise MXNetError(f"extension library not found: {path}")
         lib = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+        if hasattr(lib, "mxtpu_plugin_abi_version"):
+            load_native_ops(lib, path, verbose=verbose)
         _loaded[path] = lib
         return lib
     name = path[:-3].replace("/", ".") if path.endswith(".py") else path
@@ -40,6 +52,85 @@ def load(path, verbose=True):
         hook(registry)
     _loaded[path] = mod
     return mod
+
+
+def load_native_ops(lib, path, verbose=True):
+    """Register a versioned operator plugin's ops (ABI v1).
+
+    Each plugin op becomes a framework operator running as a host
+    callback: eager calls hit the C function directly over numpy buffers;
+    under jit the call lowers through ``jax.pure_callback`` (the analog of
+    the reference's CustomOp FCompute dispatched by the engine,
+    src/operator/custom/custom.cc). Elementwise float32 contract, shape-
+    preserving; not differentiable (register a python backward via
+    ops.registry for that).
+    """
+    import numpy as onp
+
+    ver_fn = lib.mxtpu_plugin_abi_version
+    ver_fn.restype = ctypes.c_int
+    ver = ver_fn()
+    if ver != PLUGIN_ABI_VERSION:
+        raise MXNetError(
+            f"plugin {path!r} speaks ABI v{ver}, this build speaks "
+            f"v{PLUGIN_ABI_VERSION}; rebuild the plugin against the "
+            "matching mxnet_tpu release")
+    lib.mxtpu_plugin_name.restype = ctypes.c_char_p
+    lib.mxtpu_plugin_num_ops.restype = ctypes.c_int
+    lib.mxtpu_plugin_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_plugin_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_plugin_op_call.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    pname = lib.mxtpu_plugin_name().decode()
+
+    from .ops import registry
+
+    def make_op(idx, op_name):
+        def host_call(arr, params):
+            arr = onp.ascontiguousarray(arr, dtype=onp.float32)
+            params = onp.ascontiguousarray(params, dtype=onp.float32)
+            out = onp.empty_like(arr)
+            lib.mxtpu_plugin_op_call(
+                idx,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                arr.size,
+                params.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                params.size)
+            return out
+
+        def op(x, params=()):
+            import jax
+            import jax.numpy as jnp
+
+            from .numpy.multiarray import ndarray, _invoke
+            pvec = jnp.asarray(params, jnp.float32).reshape(-1)
+
+            def fn(x_):
+                return jax.pure_callback(
+                    host_call,
+                    jax.ShapeDtypeStruct(x_.shape, jnp.float32),
+                    x_.astype(jnp.float32), pvec, vmap_method="sequential")
+            if isinstance(x, ndarray):
+                return _invoke(fn, (x,), name=op_name)
+            return fn(jnp.asarray(x))
+
+        op.__name__ = op_name
+        op.__doc__ = f"native plugin op from {pname} (ABI v{ver})"
+        return op
+
+    ops = []
+    for i in range(lib.mxtpu_plugin_num_ops()):
+        op_name = lib.mxtpu_plugin_op_name(i).decode()
+        registry.register(op_name, make_op(i, op_name),
+                          doc=f"plugin:{pname}", source=f"plugin:{pname}")
+        ops.append(op_name)
+    if verbose:
+        import logging
+        logging.info("loaded plugin %s (ABI v%d): %s", pname, ver, ops)
+    return ops
 
 
 # --------------------------------------------------------------------------

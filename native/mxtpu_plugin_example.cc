@@ -1,0 +1,57 @@
+// Example versioned operator plugin (reference: example/extensions/
+// lib_custom_op over include/mxnet/lib_api.h — the reference's ABI-stable
+// .so plugin surface; src/lib_api.cc version handshake).
+//
+// The mxtpu plugin ABI (v1) an extension .so must export:
+//   int          mxtpu_plugin_abi_version(void);   // == 1
+//   const char*  mxtpu_plugin_name(void);
+//   int          mxtpu_plugin_num_ops(void);
+//   const char*  mxtpu_plugin_op_name(int i);
+//   void         mxtpu_plugin_op_call(int i,
+//                    const float* in, float* out, long long n,
+//                    const float* params, int n_params);
+//
+// Ops are elementwise float32 host kernels; the framework surfaces each
+// as an eager/jit-capable operator via a host callback (library.py
+// load_native_ops). Parameters arrive as a flat float vector.
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+int mxtpu_plugin_abi_version(void) { return 1; }
+
+const char* mxtpu_plugin_name(void) { return "mxtpu_plugin_example"; }
+
+int mxtpu_plugin_num_ops(void) { return 2; }
+
+const char* mxtpu_plugin_op_name(int i) {
+  switch (i) {
+    case 0: return "plugin_softsign";
+    case 1: return "plugin_scale_shift";
+    default: return "";
+  }
+}
+
+static void softsign(const float* in, float* out, long long n) {
+  for (long long i = 0; i < n; ++i) out[i] = in[i] / (1.0f + std::fabs(in[i]));
+}
+
+static void scale_shift(const float* in, float* out, long long n,
+                        const float* params, int n_params) {
+  const float a = n_params > 0 ? params[0] : 1.0f;
+  const float b = n_params > 1 ? params[1] : 0.0f;
+  for (long long i = 0; i < n; ++i) out[i] = a * in[i] + b;
+}
+
+void mxtpu_plugin_op_call(int i, const float* in, float* out, long long n,
+                          const float* params, int n_params) {
+  switch (i) {
+    case 0: softsign(in, out, n); break;
+    case 1: scale_shift(in, out, n, params, n_params); break;
+    default: break;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""Versioned native operator plugin ABI (reference: include/mxnet/
+lib_api.h + src/lib_api.cc version handshake; example/extensions/
+lib_custom_op)."""
+import ctypes
+import os
+import subprocess
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_example():
+    from mxnet_tpu import native
+    src = os.path.join(REPO, "native", "mxtpu_plugin_example.cc")
+    out = os.path.join(native._build_dir(), "libmxtpu_plugin_example.so")
+    if not (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        os.makedirs(native._build_dir(), exist_ok=True)
+        r = subprocess.run(["g++", "-O2", "-shared", "-fPIC", src, "-o", out],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"no toolchain: {r.stderr[-200:]}")
+    return out
+
+
+def test_plugin_loads_and_registers_ops():
+    so = _build_example()
+    mx.library.load(so)
+    from mxnet_tpu.ops import registry
+    info = registry.get("plugin_softsign")
+    assert info is not None and "plugin" in info.source
+
+    x = np.array(onp.array([-2.0, 0.0, 3.0], onp.float32))
+    got = info.fn(x).asnumpy()
+    want = x.asnumpy() / (1 + onp.abs(x.asnumpy()))
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+    ss = registry.get("plugin_scale_shift").fn
+    got = ss(x, params=(2.0, 1.0)).asnumpy()
+    onp.testing.assert_allclose(got, 2 * x.asnumpy() + 1, rtol=1e-6)
+
+
+def test_plugin_op_under_jit():
+    so = _build_example()
+    mx.library.load(so)
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import registry
+    fn = registry.get("plugin_softsign").fn
+
+    @jax.jit
+    def f(v):
+        return fn(v) * 2.0
+
+    v = jnp.asarray([1.0, -1.0], jnp.float32)
+    onp.testing.assert_allclose(onp.asarray(f(v)), [1.0, -1.0], rtol=1e-6)
+
+
+def test_plugin_abi_mismatch_rejected(tmp_path):
+    src = tmp_path / "bad.cc"
+    src.write_text("""
+extern "C" {
+int mxtpu_plugin_abi_version(void) { return 999; }
+const char* mxtpu_plugin_name(void) { return "bad"; }
+int mxtpu_plugin_num_ops(void) { return 0; }
+const char* mxtpu_plugin_op_name(int) { return ""; }
+void mxtpu_plugin_op_call(int, const float*, float*, long long,
+                          const float*, int) {}
+}
+""")
+    so = str(tmp_path / "libbad.so")
+    r = subprocess.run(["g++", "-O0", "-shared", "-fPIC", str(src),
+                        "-o", so], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("no toolchain")
+    with pytest.raises(mx.base.MXNetError, match="ABI v999"):
+        mx.library.load(so)
