@@ -101,6 +101,21 @@ def _mp_worker_task(indices):
     return _to_shm(bf([ds[i] for i in indices]))
 
 
+def _free_shm(spec):
+    """Unlink a batch's shm blocks without copying (abandoned iterator)."""
+    from multiprocessing import shared_memory
+    if spec[0] == "arr":
+        try:
+            shm = shared_memory.SharedMemory(name=spec[1])
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        return
+    for p in spec[1]:
+        _free_shm(p)
+
+
 def _from_shm(spec):
     from multiprocessing import shared_memory
     if spec[0] == "arr":
@@ -185,26 +200,37 @@ class DataLoader:
                 yield from self._pump(pool, self._make_batch, lambda r: r)
             return
         pool = self._get_proc_pool()
-        yield from self._pump(pool, _mp_worker_task, _from_shm)
+        yield from self._pump(pool, _mp_worker_task, _from_shm,
+                              dispose=_free_shm)
 
-    def _pump(self, pool, task, unwrap):
+    def _pump(self, pool, task, unwrap, dispose=None):
         pending = []
         it = iter(self._batch_sampler)
         try:
-            for _ in range(self._prefetch or self._num_workers):
-                pending.append(pool.submit(task, next(it)))
-        except StopIteration:
-            pass
-        while pending:
-            fut = pending.pop(0)
             try:
-                pending.append(pool.submit(task, next(it)))
+                for _ in range(self._prefetch or self._num_workers):
+                    pending.append(pool.submit(task, next(it)))
             except StopIteration:
                 pass
-            yield unwrap(fut.result(timeout=self._timeout))
+            while pending:
+                fut = pending.pop(0)
+                try:
+                    pending.append(pool.submit(task, next(it)))
+                except StopIteration:
+                    pass
+                yield unwrap(fut.result(timeout=self._timeout))
+        finally:
+            # abandoned mid-epoch (break / islice / GC): in-flight batches
+            # carry shm blocks only _from_shm would unlink — drain them
+            if dispose is not None:
+                for fut in pending:
+                    try:
+                        dispose(fut.result(timeout=self._timeout))
+                    except Exception:  # noqa: BLE001 - best-effort cleanup
+                        pass
 
     def __del__(self):
-        if self._proc_pool is not None:
+        if getattr(self, "_proc_pool", None) is not None:
             self._proc_pool.shutdown(wait=False, cancel_futures=True)
 
     def __len__(self):

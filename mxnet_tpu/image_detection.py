@@ -424,13 +424,21 @@ class ImageDetIter(_img.ImageIter):
                          data_name=data_name, label_name=label_name)
         self.auglist = (CreateDetAugmenter(data_shape, **kwargs)
                         if aug_list is None else aug_list)
-        # split: photometric tail after the cast stage is batchable
-        self._batch_tail_start = len(self.auglist)
+        # split point for the batched photometric tail: the maximal
+        # DetBorrowAug-only SUFFIX (label-coupled augmenters anywhere in
+        # the chain stay per-sample), pushed past the force-resize stage —
+        # stacking needs the shape-unifying resize in the per-sample
+        # prefix
+        start = len(self.auglist)
+        for i in range(len(self.auglist) - 1, -1, -1):
+            if not isinstance(self.auglist[i], DetBorrowAug):
+                break
+            start = i
         for i, aug in enumerate(self.auglist):
             if isinstance(aug, DetBorrowAug) and \
-                    isinstance(aug.augmenter, _img.CastAug):
-                self._batch_tail_start = i + 1
-                break
+                    isinstance(aug.augmenter, _img.ForceResizeAug):
+                start = max(start, i + 1)
+        self._batch_tail_start = start
         label_shape = self._estimate_label_shape()
         self.label_shape = label_shape
         self.provide_label = [(label_name,

@@ -183,3 +183,29 @@ def test_image_det_iter_reshape_and_sync(tmp_path):
     it.reshape(data_shape=(3, 48, 48))
     b = next(it)
     assert b.data[0].shape == (2, 3, 48, 48)
+
+
+def test_custom_aug_list_tail_split_keeps_label_augs(tmp_path):
+    """A label-coupled augmenter AFTER the cast stage must still run
+    per-sample, not be silently dropped from the batched tail."""
+    from mxnet_tpu import image as _img
+
+    items = _write_dataset(str(tmp_path))
+    flip = DetHorizontalFlipAug(2.0)  # always flips
+    aug_list = [
+        DetBorrowAug(_img.ForceResizeAug((32, 32))),
+        DetBorrowAug(_img.CastAug()),
+        flip,
+    ]
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=items[:2], path_root=str(tmp_path),
+                      aug_list=aug_list)
+    # the flip is not a DetBorrowAug: it must be in the per-sample prefix
+    assert it._batch_tail_start == len(aug_list)
+    b = next(it)
+    lab = b.label[0].asnumpy()
+    # all written labels had x1=0.1, x2=0.7 (or the second object's):
+    # after a guaranteed flip, x1 = 1-0.7 = 0.3 for the first object
+    first = lab[0][lab[0, :, 0] >= 0][0]
+    assert abs(first[1] - 0.3) < 1e-5 or abs(first[1] - 0.1) > 1e-5
+    assert first[3] - first[1] > 0
