@@ -337,6 +337,8 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8):
                flops, precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
     row["params_m"] = round(n_params / 1e6, 1)
+    from mxnet_tpu import config as _cfg
+    row["fused_ln_residual"] = str(_cfg.get("fused_ln_residual"))
     return row
 
 

@@ -351,6 +351,60 @@ class ndarray:
     def as_nd_ndarray(self):
         return self
 
+    # -- NumPy interoperability protocols ---------------------------------
+    # Reference: numpy_dispatch_protocol.py + multiarray.py:318-413 —
+    # official numpy functions/ufuncs called ON mx arrays dispatch to the
+    # mx implementation and return mx arrays (casting table: any mx
+    # operand makes the result mx). Fallback to host numpy is allowed
+    # only outside autograd recording (grads cannot flow through it).
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__":
+            return NotImplemented
+        from .. import numpy as _mx_np
+        name = ufunc.__name__
+        fn = getattr(_mx_np, name, None)
+        out = kwargs.pop("out", None)
+        if out is not None:
+            if isinstance(out, tuple):
+                if len(out) != 1:
+                    return NotImplemented
+                out = out[0]
+            kwargs["out"] = out
+        ins = tuple(_wrap(jnp.asarray(a)) if isinstance(a, onp.ndarray)
+                    else a for a in inputs)
+        if fn is None or not callable(fn):
+            return self._np_fallback(ufunc, ins, kwargs)
+        return fn(*ins, **kwargs)
+
+    def __array_function__(self, func, types, args, kwargs):
+        from .. import numpy as _mx_np
+        try:
+            fn = getattr(_mx_np, func.__name__)
+        except AttributeError:
+            fn = None
+        if fn is None or not callable(fn):
+            return self._np_fallback(func, args, kwargs)
+        return fn(*args, **kwargs)
+
+    @staticmethod
+    def _np_fallback(func, args, kwargs):
+        from .. import autograd as _ag
+        if _ag.is_recording():
+            raise MXNetError(
+                f"falling back to official NumPy operator "
+                f"{getattr(func, '__name__', func)} under autograd.record() "
+                "is not supported (gradients cannot flow through host "
+                "numpy); move the call outside the recording scope")
+
+        def to_onp(x):
+            return x.asnumpy() if isinstance(x, ndarray) else x
+        out = func(*jax.tree_util.tree_map(
+            to_onp, args, is_leaf=lambda x: isinstance(x, ndarray)),
+            **{k: to_onp(v) for k, v in kwargs.items()})
+        return (_wrap(jnp.asarray(out))
+                if isinstance(out, onp.ndarray) else out)
+
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
