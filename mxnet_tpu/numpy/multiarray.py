@@ -42,8 +42,17 @@ nan = onp.nan
 euler_gamma = onp.euler_gamma
 
 
+_inexact_cache: dict = {}
+
+
 def _is_inexact(x):
-    return jnp.issubdtype(x.dtype, jnp.inexact)
+    # dispatch hot path: issubdtype walks the numpy type lattice every
+    # call — memoize per dtype (a handful of distinct dtypes per process)
+    dt = x.dtype
+    r = _inexact_cache.get(dt)
+    if r is None:
+        r = _inexact_cache[dt] = bool(jnp.issubdtype(dt, jnp.inexact))
+    return r
 
 
 _64BIT = frozenset(("int64", "uint64", "float64", "complex128"))
@@ -121,6 +130,10 @@ def _wrap_out(out):
     return out
 
 
+_profiler_mod = None
+_amp_mod = None
+
+
 def _invoke(prim, args, kwargs=None, name=None, x64=False):
     """Dispatch one op: the eager hot path.
 
@@ -131,7 +144,11 @@ def _invoke(prim, args, kwargs=None, name=None, x64=False):
     an Xprof TraceAnnotation — the analog of the engine-integrated
     ProfileOperator (src/engine/threaded_engine.h:356-367).
     """
-    from .. import profiler as _profiler
+    global _profiler_mod
+    _profiler = _profiler_mod
+    if _profiler is None:  # late-bound once (import cycle at module load)
+        from .. import profiler as _profiler
+        _profiler_mod = _profiler
     if _profiler._state["running"] and _profiler._config["profile_imperative"]:
         with _profiler.span(name or getattr(prim, "__name__", "op"),
                             "operator"):
@@ -139,15 +156,35 @@ def _invoke(prim, args, kwargs=None, name=None, x64=False):
     return _invoke_impl(prim, args, kwargs, name, x64)
 
 
+_64bit_cache: dict = {}
+
+
 def _leaf_is_64bit(x):
+    # dtype.name builds a python string per call — memoize per dtype
     dt = getattr(x, "dtype", None)
-    return dt is not None and getattr(dt, "name", "") in _64BIT
+    if dt is None:
+        return False
+    r = _64bit_cache.get(dt)
+    if r is None:
+        r = _64bit_cache[dt] = getattr(dt, "name", "") in _64BIT
+    return r
 
 
 def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
     kwargs = kwargs or {}
-    from .. import amp as _amp
-    amp_dt = _amp._op_cast_dtype(name or getattr(prim, "__name__", ""))
+    global _amp_mod
+    _amp = _amp_mod
+    if _amp is None:
+        from .. import amp as _amp
+        _amp_mod = _amp
+    amp_dt = (_amp._op_cast_dtype(name or getattr(prim, "__name__", ""))
+              if _amp.is_active() else None)
+    # flat fast path (the eager hot loop, SURVEY §7 hard part #1): no
+    # kwargs and no nested containers means tree_flatten/unflatten and
+    # the container-aware closure are pure overhead
+    if not kwargs and not any(isinstance(a, (tuple, list, dict))
+                              for a in args):
+        return _invoke_flat(prim, args, name, x64, amp_dt)
     leaves, treedef = jax.tree_util.tree_flatten(
         (args, kwargs), is_leaf=lambda x: isinstance(x, ndarray))
     # differentiable inputs: inexact-dtype ndarrays; others are unwrapped
@@ -216,6 +253,78 @@ def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
             out_treedef=out_td if out_td.num_leaves == len(out_leaves)
             else None,
             # pure fn + primals: create_graph re-linearizes through these
+            fun=fn, raw_args=tuple(raws), x64=use_x64)
+    return wrapped
+
+
+def _invoke_flat(prim, args, name, x64, amp_dt):
+    """Dispatch with flat positional args only — semantics identical to
+    the generic path (amp cast, scoped x64, vjp recording), minus the
+    pytree walk and container-aware closure."""
+    use_x64 = x64
+    arr_pos = []
+    diff_arrays = []
+    leaves = list(args)
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, ndarray):
+            if not use_x64 and _leaf_is_64bit(leaf._data):
+                use_x64 = True
+            if _is_inexact(leaf):
+                arr_pos.append(i)
+                diff_arrays.append(leaf)
+            else:
+                leaves[i] = leaf._data
+
+    def fn(*xs):
+        if amp_dt is not None:
+            xs = [x.astype(amp_dt)
+                  if jnp.issubdtype(x.dtype, jnp.floating)
+                  and x.dtype != amp_dt else x for x in xs]
+        ls = list(leaves)
+        for p, x in zip(arr_pos, xs):
+            ls[p] = x
+        return prim(*ls)
+
+    raws = [a._data for a in diff_arrays]
+    recording = (autograd.is_recording()
+                 and any(a._entry is not None for a in diff_arrays))
+    x64_scope = jax.enable_x64(True) if use_x64 else contextlib.nullcontext()
+    with x64_scope:
+        if recording:
+            try:
+                out, vjp_fn = jax.vjp(fn, *raws)
+            except (TypeError, jax.errors.TracerError,
+                    jax.errors.ConcretizationTypeError):
+                recording = False
+                out = fn(*raws)
+        elif amp_dt is None and not use_x64:
+            # no cast, no scope, nothing recorded: call through directly
+            ls = leaves
+            if arr_pos:
+                ls = list(leaves)
+                for p, a in zip(arr_pos, diff_arrays):
+                    ls[p] = a._data
+            out = prim(*ls)
+        else:
+            out = fn(*raws)
+    if recording and use_x64:
+        _inner_vjp = vjp_fn
+
+        def vjp_fn(ct, _inner=_inner_vjp):
+            with jax.enable_x64(True):
+                return _inner(ct)
+
+    wrapped = _wrap_out(out)
+    if recording:
+        out_leaves = [w for w in jax.tree_util.tree_leaves(
+            wrapped, is_leaf=lambda x: isinstance(x, ndarray))
+            if isinstance(w, ndarray)]
+        out_td = jax.tree_util.tree_structure(out)
+        autograd._record_op(
+            vjp_fn, diff_arrays, out_leaves,
+            name or getattr(prim, "__name__", "op"),
+            out_treedef=out_td if out_td.num_leaves == len(out_leaves)
+            else None,
             fun=fn, raw_args=tuple(raws), x64=use_x64)
     return wrapped
 

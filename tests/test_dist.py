@@ -131,3 +131,20 @@ def test_dist_async_watchdog_times_out():
         assert time.time() - t0 < 5
     finally:
         mx.config.set("kvstore.async_timeout", old)
+
+
+@pytest.mark.slow
+def test_multiprocess_overhead_table_two_procs():
+    """Real 2-process collective probe (reference:
+    tests/nightly/dist_sync_kvstore.py launch taxonomy)."""
+    from mxnet_tpu.parallel.scaling import multiprocess_overhead_table
+
+    rows = multiprocess_overhead_table(ns=(2,), timeout=240)
+    assert len(rows) == 1
+    row = rows[0]
+    assert "error" not in row, row
+    assert row["n"] == 2
+    assert row["compute_ms"] > 0
+    assert len(row["allreduce"]) == 2
+    for r in row["allreduce"]:
+        assert r["allreduce_ms"] > 0 and r["bytes"] in (1 << 20, 1 << 24)
