@@ -435,3 +435,33 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
                              label_width=label_width,
                              path_imgrec=path_imgrec, shuffle=shuffle,
                              aug_list=aug, **kwargs)
+
+
+def ImageDetRecordIter(path_imgrec=None, data_shape=None, batch_size=1,
+                       shuffle=False, mean_r=0, mean_g=0, mean_b=0,
+                       std_r=0, std_g=0, std_b=0, **kwargs):
+    """Detection RecordIO iterator with the C++ iterator's kwargs surface
+    (reference: src/io/iter_image_det_recordio.cc, registered as
+    mx.io.ImageDetRecordIter). Maps onto image.ImageDetIter (packed
+    detection labels, Det* augmenter chain)."""
+    import numpy as onp
+    from ..image_detection import ImageDetIter
+    mean = (True if (mean_r or mean_g or mean_b) else None)
+    if mean is True:
+        mean = onp.array([mean_r, mean_g, mean_b], "float32")
+    std = (onp.array([std_r or 1.0, std_g or 1.0, std_b or 1.0], "float32")
+           if (std_r or std_g or std_b) else None)
+    return ImageDetIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                        shuffle=shuffle, mean=mean, std=std, **kwargs)
+
+
+def ImageRecordUInt8Iter(**kwargs):
+    """uint8-output variant (reference: iter_image_recordio_2.cc alias);
+    pixel values stay 0-255 with no normalization."""
+    kwargs.pop("mean_r", None), kwargs.pop("std_r", None)
+    return ImageRecordIter(**kwargs)
+
+
+ImageRecordInt8Iter = ImageRecordUInt8Iter
+ImageRecordIter_v1 = ImageRecordIter
+ImageRecordUInt8Iter_v1 = ImageRecordUInt8Iter

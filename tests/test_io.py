@@ -249,3 +249,32 @@ def test_load_parameters_missing_safetensors_error(tmp_path):
         assert False, "expected FileNotFoundError"
     except FileNotFoundError as e:
         assert "nope.safetensors" in str(e) and ".npz" not in str(e)
+
+
+def test_image_det_record_iter_surface(tmp_path):
+    """mx.io.ImageDetRecordIter (reference: iter_image_det_recordio.cc
+    surface) maps onto ImageDetIter over a real .rec file."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "det.rec")
+    idx = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        rs = onp.random.RandomState(i)
+        img = rs.randint(0, 255, (40, 40, 3)).astype(onp.uint8)
+        buf = mx.image.imencode(mx.np.array(img.astype(onp.float32)))
+        header = recordio.IRHeader(
+            0, [2.0, 5.0, float(i % 2), 0.1, 0.2, 0.8, 0.9], i, 0)
+        w.write_idx(i, recordio.pack(header, buf))
+    w.close()
+
+    it = mx.io.ImageDetRecordIter(path_imgrec=rec, data_shape=(3, 24, 24),
+                                  batch_size=2)
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 24, 24)
+    lab = b.label[0].asnumpy()
+    assert lab.shape[0] == 2 and lab.shape[2] == 5
+    assert (lab[:, 0, 0] >= 0).all()
