@@ -19,8 +19,13 @@ def _ref(x, h, g, b, mask, p, eps=1e-5):
     return (s - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
-@pytest.mark.parametrize("p,rows", [(0.0, 10), (0.3, 7), (0.0, 256)])
-def test_kernel_fwd_and_grads(p, rows):
+@pytest.mark.parametrize("p,rows,block_rows", [
+    (0.0, 10, 256), (0.3, 7, 256), (0.0, 256, 256),
+    # grid > 1: exercises the revisited (8, dim) dgamma/dbeta accumulator
+    # (pl.when init on step 0, += on every step) incl. a padded tail block
+    (0.3, 600, 64),
+])
+def test_kernel_fwd_and_grads(p, rows, block_rows):
     rs = onp.random.RandomState(1)
     D = 128
     x = jnp.asarray(rs.randn(rows, D).astype(onp.float32))
@@ -29,7 +34,8 @@ def test_kernel_fwd_and_grads(p, rows):
     b = jnp.asarray(rs.randn(D).astype(onp.float32))
     mask = jnp.asarray((rs.rand(rows, D) > p).astype(onp.float32))
 
-    kw = dict(p=p, mask=mask if p > 0 else None, interpret=True)
+    kw = dict(p=p, mask=mask if p > 0 else None, interpret=True,
+              block_rows=block_rows)
     out = ln_residual_dropout(x, h, g, b, **kw)
     want = _ref(x, h, g, b, mask if p > 0 else jnp.ones_like(x), p)
     onp.testing.assert_allclose(out, want, rtol=3e-5, atol=3e-5)

@@ -1,0 +1,146 @@
+"""Mosaic-compile + numerics check for every Pallas kernel on real TPU.
+
+Round-4 verdict item #1: the fused kernels had only ever run in interpret
+mode (the tunnel died before a hardware pass).  This script compiles each
+kernel with interpret=False on the attached TPU and checks numerics
+against the plain-jnp reference implementation.  Exit code 0 only if all
+kernels compile AND match.
+
+Usage:  python tools/tpu_kernel_check.py
+"""
+from __future__ import annotations
+
+import sys
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _maxerr(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+def _relerr(grads, refs):
+    """Max per-tensor relative error: maxerr / (max|ref| per tensor)."""
+    rel = []
+    for a, b in zip(grads, refs):
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-6
+        rel.append(_maxerr(a, b) / scale)
+    return max(rel)
+
+
+def check_flash_attention():
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+    B, H, S, D = 2, 4, 2048, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, H, S, D), jnp.bfloat16)
+
+    def ref(q, k, v, causal):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(D)
+        if causal:
+            m = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(m, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+    results = {}
+    for causal in (False, True):
+        out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal))(q, k, v)
+        r = ref(q, k, v, causal)
+        err = _maxerr(out, r)
+        assert err < 0.05, f"flash fwd causal={causal} maxerr {err}"
+        # backward
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+        def loss_ref(q, k, v):
+            return jnp.sum(ref(q, k, v, causal) ** 2)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        rel = _relerr(g, gr)
+        assert rel < 0.05, f"flash bwd causal={causal} relerr {rel}"
+        results[f"causal={causal}"] = {"fwd_maxerr": err, "bwd_relerr": rel}
+    return results
+
+
+def check_ln_residual():
+    from mxnet_tpu.ops.pallas.ln_residual import ln_residual_dropout
+    B, S, Dm = 8, 128, 768
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    x = jax.random.normal(ks[0], (B * S, Dm), jnp.bfloat16)
+    h = jax.random.normal(ks[1], (B * S, Dm), jnp.bfloat16)
+    gamma = jax.random.normal(ks[2], (Dm,), jnp.float32)
+    beta = jax.random.normal(ks[3], (Dm,), jnp.float32)
+    mask = (jax.random.uniform(ks[4], (B * S, Dm)) > 0.1)
+    p = 0.1
+
+    def ref(x, h, gamma, beta):
+        s = x.astype(jnp.float32) + jnp.where(mask, h.astype(jnp.float32) / (1 - p), 0.0)
+        mu = jnp.mean(s, -1, keepdims=True)
+        var = jnp.mean((s - mu) ** 2, -1, keepdims=True)
+        return ((s - mu) * jax.lax.rsqrt(var + 1e-5)) * gamma + beta
+
+    out = jax.jit(lambda *a: ln_residual_dropout(*a, p=p, mask=mask))(x, h, gamma, beta)
+    r = ref(x, h, gamma, beta)
+    err = _maxerr(out, r)
+    assert err < 0.05, f"ln_residual fwd maxerr {err}"
+
+    def loss(x, h, gamma, beta):
+        return jnp.sum(ln_residual_dropout(x, h, gamma, beta, p=p, mask=mask).astype(jnp.float32) ** 2)
+    def loss_ref(x, h, gamma, beta):
+        return jnp.sum(ref(x, h, gamma, beta) ** 2)
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(x, h, gamma, beta)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(x, h, gamma, beta)
+    rel = _relerr(g, gr)
+    assert rel < 0.05, f"ln_residual bwd relerr {rel}"
+    return {"fwd_maxerr": err, "bwd_relerr_max": rel}
+
+
+def check_conv_bwd():
+    from mxnet_tpu.ops.pallas_conv_bwd import (conv3x3_bn_relu_ref,
+                                               fused_cbr_train)
+    N, H, W, Cin, Cout = 8, 56, 56, 64, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    x = jax.random.normal(ks[0], (N, H, W, Cin), jnp.bfloat16)
+    w = jax.random.normal(ks[1], (3, 3, Cin, Cout), jnp.bfloat16) * 0.1
+    gamma = jnp.abs(jax.random.normal(ks[2], (Cout,), jnp.float32)) + 0.5
+    beta = jax.random.normal(ks[3], (Cout,), jnp.float32)
+
+    def loss_fused(x, w, gamma, beta):
+        return jnp.sum(fused_cbr_train(x, w, gamma, beta)[0].astype(jnp.float32) ** 2)
+    def loss_ref(x, w, gamma, beta):
+        return jnp.sum(conv3x3_bn_relu_ref(x, w, gamma, beta)[0].astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2, 3)))(x, w, gamma, beta)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(x, w, gamma, beta)
+    rel = _relerr(g, gr)
+    assert rel < 0.06, f"conv_bwd relerr {rel}"
+    return {"bwd_relerr_max": rel}
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}", flush=True)
+    if dev.platform != "tpu":
+        print("NOT A TPU — this check is meaningless on CPU", flush=True)
+        sys.exit(2)
+    ok = True
+    for name, fn in [("flash_attention", check_flash_attention),
+                     ("ln_residual", check_ln_residual),
+                     ("conv3x3_bn_relu_bwd", check_conv_bwd)]:
+        try:
+            res = fn()
+            print(f"PASS {name}: {res}", flush=True)
+        except Exception:
+            ok = False
+            print(f"FAIL {name}:", flush=True)
+            traceback.print_exc()
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
