@@ -281,7 +281,8 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     return row
 
 
-def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8):
+def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8,
+                     dropout=0.0):
     import jax
     import jax.numpy as jnp
     import numpy as onp
@@ -303,7 +304,7 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8):
     net = BERTForPretraining(vocab_size=vocab, units=units,
                              hidden_size=units * 4, num_layers=layers,
                              num_heads=heads, max_length=512,
-                             dropout=0.0, embed_dropout=0.0)
+                             dropout=dropout, embed_dropout=0.0)
     net.initialize()
     net(mx.np.zeros((2, seq), dtype="int32"))
     trainable, aux = functional.split_params(net)
@@ -333,7 +334,9 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8):
     sec, _ = _measure(step, (trainable, opt_m, ids, ids), n_state=2)
     sec /= k_steps
     flops = 6.0 * n_params * bs * seq   # 6ND training rule
-    row = _row(f"bert_base_pretrain_bs{bs}_seq{seq}_{precision}", sec, bs,
+    drop_tag = f"_drop{dropout}" if dropout else ""
+    row = _row(f"bert_base_pretrain_bs{bs}_seq{seq}{drop_tag}_{precision}",
+               sec, bs,
                flops, precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
     row["params_m"] = round(n_params / 1e6, 1)

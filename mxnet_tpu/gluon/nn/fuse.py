@@ -7,10 +7,12 @@ stays XLA (already fused) and the BACKWARD is the Pallas mega-kernel in
 ops/pallas_conv_bwd.py which never materializes the conv-output cotangent
 (round-3 profiled HBM wall).
 
-Enabled when config 'fused_conv_bn' is true ("auto": TPU only), training
-mode is active, and the triplet matches the kernel's shape class; anything
-else falls back to the plain child-by-child forward, so eval, CPU tests,
-exotic shapes and ONNX export are unchanged.
+Enabled when config 'fused_conv_bn' is "on" (opt-in; "auto" is OFF —
+measured ~30% slower than XLA's conv backward on TPU v5lite, see
+_fusion_active), training mode is active, and the triplet matches the
+kernel's shape class; anything else falls back to the plain
+child-by-child forward, so eval, CPU tests, exotic shapes and ONNX
+export are unchanged.
 """
 from __future__ import annotations
 
@@ -28,12 +30,12 @@ def _fusion_active():
         return False
     if mode in ("1", "true", "on"):
         return True
-    # auto: only where the Pallas kernel compiles natively
-    import jax
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    # auto: OFF. Measured on TPU v5lite (round 5 A/B, tools/tpu_ab.py):
+    # the Pallas backward is ~30% SLOWER end-to-end than XLA's own
+    # conv-backward fusions (ResNet-50 bs32 bf16: 1774 vs 2550 img/s).
+    # The kernel remains available via fused_conv_bn=on for shapes/chips
+    # where it wins; engaging it by default is a de-optimization.
+    return False
 
 
 def _has_hooks(*blocks):

@@ -78,10 +78,12 @@ def _fused_ln_residual(x, h, ln, p):
     """Route ``LN(x + dropout(h))`` through the fused Pallas kernel
     (ops/pallas/ln_residual.py) when eligible, else return None.
 
-    Gated by mx.config ``fused_ln_residual``: 'auto' engages on TPU
-    backends only (the kernel works via interpret=True elsewhere but XLA's
-    own fusion is the right call on CPU); feature dim must be a lane
-    multiple (128) and the LayerNorm must be the default last-axis one.
+    Gated by mx.config ``fused_ln_residual``: 'auto' engages only on TPU
+    AND when dropout is live (training mode, p > 0) — the measured-win
+    case; with no dropout XLA's own residual+LN fusion is faster, and the
+    kernel works only via interpret=True off-TPU. 'on' forces it
+    everywhere. Feature dim must be a lane multiple (128) and the
+    LayerNorm must be the default last-axis one.
     """
     import jax
 
@@ -94,6 +96,13 @@ def _fused_ln_residual(x, h, ln, p):
         return None
     on_tpu = jax.default_backend() == "tpu"
     if mode == "auto" and not on_tpu:
+        return None
+    if mode == "auto" and not (autograd.is_training() and float(p) > 0):
+        # Measured on TPU v5lite (round 5, tools/tpu_ab.py): with dropout
+        # OFF XLA's own residual+LN fusion is ~2% faster than the kernel;
+        # with dropout ON the kernel wins ~5% (one VMEM pass over the
+        # stream vs mask materialization + three passes). auto = only the
+        # measured-win case; 'on' forces it everywhere.
         return None
     dim = x.shape[-1]
     if dim % 128 != 0:
