@@ -1,0 +1,72 @@
+import json, sys, collections
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from jax import lax
+import bench
+import mxnet_tpu as mx
+import mxnet_tpu.numpy_extension as npx
+from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+BS = 128
+K = 24          # iterations fused into one executable (amortizes dispatch)
+peak = bench._chip_peak(jax.devices()[0])
+
+sigs = collections.Counter()
+orig = npx.convolution
+def spy(x, w, b=None, **kw):
+    sigs[(tuple(x.shape), tuple(w.shape), tuple(kw.get("stride") or (1,1)),
+          tuple(kw.get("pad") or (0,0)))] += 1
+    return orig(x, w, b, **kw)
+npx.convolution = spy
+net = resnet50_v1(); net.initialize()
+net(mx.np.zeros((BS, 3, 224, 224), dtype="float32"))
+npx.convolution = orig
+
+def time_fn(f, *args):
+    def step(c, *a):
+        def body(i, c):
+            out = f(a[0] + c.astype(a[0].dtype), *a[1:])
+            return jnp.sum(out, dtype=jnp.float32) * 1e-30
+        c = lax.fori_loop(0, K, body, c)
+        return c, c
+    j = jax.jit(step)
+    j, _ = bench._compile(j, jax.ShapeDtypeStruct((), jnp.float32),
+                          *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args])
+    sec, _ = bench._measure(j, (jnp.zeros(()), *args), n_state=1, target_s=0.8)
+    return sec / K
+
+rows = []
+total = {"fwd_ms": 0.0, "dgrad_ms": 0.0, "wgrad_ms": 0.0, "flops": 0.0}
+for (xs, ws, stride, pad), count in sorted(sigs.items()):
+    x = jax.random.normal(jax.random.PRNGKey(0), xs, jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), ws, jnp.bfloat16) * 0.05
+    dn = lax.conv_dimension_numbers(xs, ws, ("NCHW", "OIHW", "NCHW"))
+    def conv(x, w, stride=stride, pad=pad, dn=dn):
+        return lax.conv_general_dilated(
+            x, w, stride, [(pad[0], pad[0]), (pad[1], pad[1])],
+            dimension_numbers=dn)
+    o_shape = jax.eval_shape(conv, x, w).shape
+    do = jax.random.normal(jax.random.PRNGKey(2), o_shape, jnp.bfloat16)
+    flops = 2 * o_shape[0]*o_shape[1]*o_shape[2]*o_shape[3] * ws[1]*ws[2]*ws[3]
+
+    t_fwd = time_fn(conv, x, w)
+    dgrad = lambda do, w: jax.vjp(lambda x_: conv(x_, w), x)[1](do)[0]
+    wgrad = lambda do, x: jax.vjp(lambda w_: conv(x, w_), w)[1](do)[0]
+    t_dg = time_fn(dgrad, do, w)
+    t_wg = time_fn(wgrad, do, x)
+    row = {"x": xs, "w": ws, "s": stride, "n": count,
+           "gflops": round(flops/1e9, 1),
+           "fwd_tf": round(flops/t_fwd/1e12, 1),
+           "dgrad_tf": round(flops/t_dg/1e12, 1),
+           "wgrad_tf": round(flops/t_wg/1e12, 1),
+           "fwd_ms": round(t_fwd*1e3*count, 3),
+           "dgrad_ms": round(t_dg*1e3*count, 3),
+           "wgrad_ms": round(t_wg*1e3*count, 3)}
+    rows.append(row)
+    for k2 in ("fwd_ms", "dgrad_ms", "wgrad_ms"):
+        total[k2] += row[k2]
+    total["flops"] += flops * count
+    print(json.dumps(row), file=sys.stderr, flush=True)
+total = {k: round(v, 2) for k, v in total.items()}
+total["peak_tf"] = peak/1e12
+print(json.dumps({"bs": BS, "total": total, "rows": rows}))
