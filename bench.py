@@ -176,10 +176,10 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
         def loss_fn(tvec, tbig):
             tr = t_pack.unpack(tvec.astype(cdtype), _cast_tree(tbig, cdtype))
             aux_d = a_pack.unpack(avec, abig)
+            from mxnet_tpu.ops.xent import sparse_softmax_xent
             logits, mutated = functional.functional_call(
                 net, {**tr, **aux_d}, x.astype(cdtype), train=True)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+            loss = jnp.mean(sparse_softmax_xent(logits, y))
             return loss, mutated
         (loss, mutated), grads = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True)(tvec, tbig)
@@ -281,7 +281,7 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     return row
 
 
-def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8,
+def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=16,
                      dropout=0.0):
     import jax
     import jax.numpy as jnp
@@ -313,10 +313,10 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=8,
 
     def train_step(trainable, opt_m, ids, labels):
         def loss_fn(tr):
+            from mxnet_tpu.ops.xent import sparse_softmax_xent
             (mlm, _nsp), _ = functional.functional_call(
                 net, {**_cast_tree(tr, cdtype), **aux}, ids, train=True)
-            logp = jax.nn.log_softmax(mlm.astype(jnp.float32), axis=-1)
-            return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+            return jnp.mean(sparse_softmax_xent(mlm, labels))
         loss, grads = jax.value_and_grad(loss_fn)(trainable)
         opt_m = jax.tree_util.tree_map(
             lambda m, g: 0.9 * m + g.astype(m.dtype), opt_m, grads)

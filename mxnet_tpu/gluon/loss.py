@@ -115,13 +115,26 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def forward(self, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = npx.log_softmax(pred, axis=self._axis)
-        if self._sparse_label:
-            loss = -npx.pick(pred, label, axis=self._axis)
+        if not self._from_logits and self._sparse_label:
+            # fused path: logsumexp - pick, no (N, V) f32 log-softmax
+            # (ops/xent.py; measured win on the TPU HBM roofline)
+            from ..numpy.multiarray import _invoke
+            from ..ops.xent import sparse_softmax_xent
+            axis = self._axis
+            # dispatch under the op's own name: "softmax_cross_entropy"
+            # sits in amp FP32_OPS, which would cast the logits to f32 and
+            # re-materialize exactly the (N, V) array this path avoids;
+            # the op accumulates in f32 internally so the cast is redundant
+            loss = _invoke(lambda x, l: sparse_softmax_xent(x, l, axis),
+                           (pred, label), name="sparse_softmax_xent")
         else:
-            label = label.reshape(pred.shape)
-            loss = -(pred * label).sum(axis=self._axis)
+            if not self._from_logits:
+                pred = npx.log_softmax(pred, axis=self._axis)
+            if self._sparse_label:
+                loss = -npx.pick(pred, label, axis=self._axis)
+            else:
+                label = label.reshape(pred.shape)
+                loss = -(pred * label).sum(axis=self._axis)
         loss = _apply_weighting(loss, self._weight, sample_weight)
         return self._mean(loss)
 

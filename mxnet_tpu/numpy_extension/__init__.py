@@ -1073,16 +1073,20 @@ def load(file):
 
 
 def softmax_cross_entropy(data, label, sparse_label=True, axis=-1):
-    def fn(x, l=None):
-        logp = jax.nn.log_softmax(x, axis)
-        lbl = l if l is not None else label._data
-        if sparse_label:
-            return -jnp.take_along_axis(
-                logp, jnp.expand_dims(lbl.astype(jnp.int32), axis), axis).sum()
-        return -(lbl * logp).sum()
+    """Reference: src/operator/loss_binary_op.cc softmax_cross_entropy —
+    scalar sum of -log softmax(data)[label]. The sparse path routes
+    through the fused logsumexp-minus-pick op (ops/xent.py), which never
+    materializes an (N, V) float32 log-softmax."""
+    from ..ops.xent import sparse_softmax_xent
+
     if sparse_label:
-        return _invoke(fn, (data,), name="softmax_cross_entropy")
-    return _invoke(fn, (data, label), name="softmax_cross_entropy")
+        # own dispatch name: amp lists "softmax_cross_entropy" as FP32,
+        # which would cast and re-materialize the (N, V) array the fused
+        # op avoids (it accumulates in f32 internally already)
+        return _invoke(lambda x, l: sparse_softmax_xent(x, l, axis).sum(),
+                       (data, label), name="sparse_softmax_xent")
+    return _invoke(lambda x, l: -(l * jax.nn.log_softmax(x, axis)).sum(),
+                   (data, label), name="softmax_cross_entropy")
 
 
 def smooth_l1(data, scalar=1.0):

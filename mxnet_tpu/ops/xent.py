@@ -1,0 +1,71 @@
+"""Fused sparse softmax cross-entropy.
+
+Reference parity: ``softmax_cross_entropy`` (src/operator/loss_binary_op.cc:29,
+-log softmax(data)[label]) and the sparse path of
+gluon ``SoftmaxCrossEntropyLoss`` (python/mxnet/gluon/loss.py).
+
+TPU-first design: the naive formulation ``-pick(log_softmax(x), label)``
+materializes a full (N, V) float32 log-softmax — at BERT-pretraining scale
+(4096 tokens x 30522 vocab) that intermediate alone is ~500 MB of HBM
+traffic per step, and its VJP writes the same again. Here the loss is
+computed as ``logsumexp(x) - x[label]``: two fused XLA reductions that
+read the logits ONCE in their storage dtype (bf16 under AMP) with f32
+accumulation inside the reduction, plus an N-element gather. The custom
+VJP emits the one-pass cotangent ``(softmax(x) - onehot(label)) * g``
+directly in the input dtype, so no f32 (N, V) array ever exists in
+either direction. Measured on TPU v5lite this removes ~1.7 ms from a
+27.5 ms BERT-base bs32 step (tools/tpu_ab.py round-5 session).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def sparse_softmax_xent(logits, labels, axis=-1):
+    """Per-element ``-log softmax(logits)[labels]`` along ``axis``.
+
+    logits: (..., V, ...) float array; labels: integer array of
+    ``logits.shape`` minus ``axis``. Returns float32 losses of the label
+    shape. Gradients flow to ``logits`` only.
+    """
+    return _xent_fwd(logits, labels, axis)[0]
+
+
+def _xent_fwd(logits, labels, axis):
+    xf = logits.astype(jnp.float32)      # fuses into the reductions below
+    m = jnp.max(xf, axis=axis, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(xf - m), axis=axis)) + jnp.squeeze(m, axis)
+    labels = _clip_labels(labels, logits, axis)
+    idx = jnp.expand_dims(labels, axis)
+    # gather from the ORIGINAL array: N elements move, not a cast of (N, V)
+    picked = jnp.squeeze(jnp.take_along_axis(logits, idx, axis), axis)
+    loss = lse - picked.astype(jnp.float32)
+    return loss, (logits, labels, lse)
+
+
+def _clip_labels(labels, logits, axis):
+    """npx.pick(mode='clip') parity: out-of-range labels clamp to the
+    nearest valid class instead of poisoning the loss with NaN (negative
+    indices would otherwise wrap to the LAST class via gather)."""
+    v = logits.shape[axis]
+    return jnp.clip(labels.astype(jnp.int32), 0, v - 1)
+
+
+def _xent_bwd(axis, res, g):
+    logits, labels, lse = res
+    xf = logits.astype(jnp.float32)
+    p = jnp.exp(xf - jnp.expand_dims(lse, axis))
+    ax = axis if axis >= 0 else logits.ndim + axis
+    iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, ax)
+    onehot = iota == jnp.expand_dims(_clip_labels(labels, logits, axis), axis)
+    dx = (p - onehot.astype(jnp.float32)) * jnp.expand_dims(g, axis)
+    zeros = onp.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx.astype(logits.dtype), zeros
+
+
+sparse_softmax_xent.defvjp(_xent_fwd, _xent_bwd)

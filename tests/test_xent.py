@@ -1,0 +1,97 @@
+"""Fused sparse softmax cross-entropy (reference:
+src/operator/loss_binary_op.cc softmax_cross_entropy; gluon loss.py
+SoftmaxCrossEntropyLoss sparse path)."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+from mxnet_tpu.ops.xent import sparse_softmax_xent
+
+
+def _naive(x, l, axis=-1):
+    logp = jax.nn.log_softmax(x.astype(jnp.float32), axis)
+    return -jnp.squeeze(
+        jnp.take_along_axis(logp, jnp.expand_dims(l.astype(jnp.int32), axis),
+                            axis), axis)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape,axis", [((7, 13), -1), ((4, 6, 11), -1),
+                                        ((5, 9, 3), 1)])
+def test_matches_naive_with_grads(dtype, shape, axis):
+    rs = onp.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*shape).astype(onp.float32) * 3).astype(dtype)
+    lshape = list(shape)
+    v = lshape.pop(axis if axis >= 0 else len(shape) + axis)
+    l = jnp.asarray(rs.randint(0, v, lshape))
+
+    got = sparse_softmax_xent(x, l, axis)
+    want = _naive(x, l, axis)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    onp.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+    g = jax.grad(lambda x: jnp.sum(sparse_softmax_xent(x, l, axis) ** 2))(x)
+    gw = jax.grad(lambda x: jnp.sum(_naive(x, l, axis) ** 2))(x)
+    assert g.dtype == x.dtype
+    onp.testing.assert_allclose(g.astype(jnp.float32), gw.astype(jnp.float32),
+                                rtol=5e-2 if dtype == jnp.bfloat16 else 1e-4,
+                                atol=5e-2 if dtype == jnp.bfloat16 else 1e-4)
+
+
+def test_out_of_range_labels_clip():
+    # npx.pick(mode='clip') parity: -1 clamps to 0, >=V clamps to V-1,
+    # finite loss and grads either way (no NaN poisoning from a corrupt
+    # or padding label)
+    x = jnp.asarray(onp.random.RandomState(1).randn(3, 5), jnp.float32)
+    l_bad = jnp.array([-1, 2, 7])
+    l_clip = jnp.array([0, 2, 4])
+    onp.testing.assert_allclose(sparse_softmax_xent(x, l_bad),
+                                sparse_softmax_xent(x, l_clip), rtol=1e-6)
+    g = jax.grad(lambda x: sparse_softmax_xent(x, l_bad).sum())(x)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_extreme_logits_stable():
+    # logsumexp shift must keep large logits finite in both directions
+    x = jnp.array([[1e4, -1e4, 0.0], [88.0, 89.0, 90.0]], jnp.float32)
+    l = jnp.array([0, 2])
+    loss = sparse_softmax_xent(x, l)
+    g = jax.grad(lambda x: sparse_softmax_xent(x, l).sum())(x)
+    assert bool(jnp.isfinite(loss).all()) and bool(jnp.isfinite(g).all())
+    onp.testing.assert_allclose(loss, _naive(x, l), rtol=1e-5, atol=1e-5)
+
+
+def test_npx_softmax_cross_entropy_reference_example():
+    # the documented example from loss_binary_op.cc:45-56
+    import mxnet_tpu.numpy_extension as npx
+    x = np.array([[1.0, 2.0, 3.0], [11.0, 7.0, 5.0]])
+    label = np.array([2, 0])
+    out = npx.softmax_cross_entropy(x, label)
+    onp.testing.assert_allclose(out.asnumpy(), 0.4281871, rtol=1e-5)
+
+
+def test_gluon_loss_fused_path_matches_dense_and_backprops():
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    rs = onp.random.RandomState(3)
+    pred = np.array(rs.randn(6, 10).astype(onp.float32))
+    lbl = np.array(rs.randint(0, 10, (6,)))
+    dense = onp.eye(10, dtype=onp.float32)[lbl.asnumpy().astype(int)]
+
+    sparse_loss = SoftmaxCrossEntropyLoss(sparse_label=True)
+    dense_loss = SoftmaxCrossEntropyLoss(sparse_label=False)
+    onp.testing.assert_allclose(sparse_loss(pred, lbl).asnumpy(),
+                                dense_loss(pred, np.array(dense)).asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+    pred.attach_grad()
+    with autograd.record():
+        out = sparse_loss(pred, lbl).sum()
+    out.backward()
+    g = pred.grad.asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+    # d/dlogits of mean-CE sums to zero per row
+    onp.testing.assert_allclose(g.sum(-1), onp.zeros(6), atol=1e-6)
