@@ -1,0 +1,42 @@
+/* Minimal non-Python host driving the framework through the C ABI
+ * (native/mxtpu_c_api.h). Build (from repo root):
+ *   gcc example/capi_host.c -Inative -Lnative/build -lmxtpu_capi \
+ *       -Wl,-rpath,$PWD/native/build -o /tmp/capi_host
+ * The embedded interpreter finds mxnet_tpu via PYTHONPATH=<repo root>. */
+#include <stdio.h>
+#include <stdlib.h>
+#include "mxtpu_c_api.h"
+
+int main(void) {
+  if (MXTpuInit() != 0) {
+    fprintf(stderr, "init failed: %s\n", MXTpuGetLastError());
+    return 1;
+  }
+  char info[256];
+  MXTpuRuntimeInfo(info, sizeof info);
+  printf("runtime: %s\n", info);
+
+  float a[6] = {1, 2, 3, 4, 5, 6}, b[6] = {10, 20, 30, 40, 50, 60};
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle ha, hb;
+  if (MXTpuNDArrayCreate(a, sizeof a, 0, shape, 2, &ha) ||
+      MXTpuNDArrayCreate(b, sizeof b, 0, shape, 2, &hb)) {
+    fprintf(stderr, "create failed: %s\n", MXTpuGetLastError());
+    return 1;
+  }
+  NDArrayHandle ins[2] = {ha, hb}, outs[2];
+  int n_out = 2;
+  if (MXTpuImperativeInvoke("add", ins, 2, NULL, NULL, 0, outs, &n_out)) {
+    fprintf(stderr, "invoke failed: %s\n", MXTpuGetLastError());
+    return 1;
+  }
+  float out[6];
+  MXTpuNDArraySyncCopyToCPU(outs[0], out, sizeof out);
+  printf("add -> [%g %g %g %g %g %g]\n",
+         out[0], out[1], out[2], out[3], out[4], out[5]);
+  if (out[5] != 66.0f) { fprintf(stderr, "wrong result\n"); return 1; }
+  MXTpuNDArrayFree(ha); MXTpuNDArrayFree(hb); MXTpuNDArrayFree(outs[0]);
+  MXTpuShutdown();
+  printf("C host OK\n");
+  return 0;
+}

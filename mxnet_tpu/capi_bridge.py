@@ -1,0 +1,102 @@
+"""Python side of the C ABI (native/mxtpu_capi.cc <-> this module).
+
+Reference parity: the reference's C API (src/c_api/c_api.cc) fronts its
+C++ engine; here the runtime IS Python/JAX, so the C library forwards
+each ABI call to one of these small, primitive-typed functions. Keeping
+the conversion logic in Python (bytes/tuples/ints only at the boundary)
+keeps the C++ layer free of numpy/jax internals and the ABI stable.
+
+dtype codes follow the reference's mshadow enum (base.py mirrors it):
+0=float32 1=float64 2=float16 3=uint8 4=int32 5=int8 6=int64.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as onp
+
+_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+           4: "int32", 5: "int8", 6: "int64"}
+_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+def _np():
+    from . import numpy as np
+    return np
+
+
+def runtime_info():
+    import jax
+    devs = jax.devices()
+    return f"platform={devs[0].platform};devices={len(devs)}"
+
+
+def seed(n):
+    from . import random
+    random.seed(int(n))
+    return True
+
+
+def wait_all():
+    from . import engine
+    engine.wait_all()
+    return True
+
+
+def ndarray_from_bytes(payload, shape, dtype_code):
+    """bytes (or None for zeros) + shape tuple + mshadow dtype code."""
+    dt = _DTYPES[int(dtype_code)]
+    if payload is None:
+        return _np().zeros(tuple(shape), dtype=dt)
+    host = onp.frombuffer(payload, dtype=dt).reshape(tuple(shape))
+    return _np().array(host, dtype=dt)
+
+
+def ndarray_shape(nd):
+    return tuple(int(d) for d in nd.shape)
+
+
+def ndarray_dtype_code(nd):
+    return _CODES[str(nd.dtype)]
+
+
+def ndarray_to_bytes(nd):
+    return nd.asnumpy().tobytes()
+
+
+def _parse_kwargs(kw):
+    """ABI kwargs arrive as strings (reference C API convention); parse
+    python literals where possible, pass raw strings through otherwise."""
+    out = {}
+    for k, v in kw.items():
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = v
+    return out
+
+
+def _resolve(op_name):
+    """npx -> mx.np -> legacy CamelCase, the same order python users see."""
+    from . import ndarray as legacy_nd
+    from . import numpy as np
+    from . import numpy_extension as npx
+    for mod in (npx, np):
+        fn = getattr(mod, op_name, None)
+        if callable(fn):
+            return fn
+    fn = getattr(legacy_nd, op_name, None)
+    if callable(fn):
+        return fn
+    raise ValueError(f"unknown operator '{op_name}' "
+                     "(searched npx, np, legacy nd)")
+
+
+def invoke(op_name, inputs, kwargs):
+    fn = _resolve(op_name)
+    out = fn(*inputs, **_parse_kwargs(kwargs))
+    if out is None:
+        return []
+    if isinstance(out, (list, tuple)):
+        return list(out)
+    return [out]

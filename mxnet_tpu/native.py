@@ -32,6 +32,22 @@ _LINK_FLAGS = {
 }
 
 
+def _python_embed_flags():
+    """Compile/link flags for libraries embedding CPython (the C ABI).
+    Links libpython when a shared build exists so a pure-C host works;
+    otherwise symbols stay undefined and resolve from a Python host."""
+    import sysconfig
+    cflags = ["-I" + sysconfig.get_paths()["include"]]
+    ldflags = []
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or ""
+    if libdir and ver and os.path.exists(
+            os.path.join(libdir, f"libpython{ver}.so")):
+        ldflags += ["-L" + libdir, f"-lpython{ver}",
+                    "-Wl,-rpath," + libdir]
+    return cflags, ldflags
+
+
 def _build(name):
     src = os.path.join(_SRC_DIR, f"{name}.cc")
     out = os.path.join(_build_dir(), f"lib{name}.so")
@@ -40,8 +56,12 @@ def _build(name):
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     os.makedirs(_build_dir(), exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           src, "-o", out] + _LINK_FLAGS.get(name, [])
+    cflags, ldflags = ([], [])
+    if name == "mxtpu_capi":
+        cflags, ldflags = _python_embed_flags()
+    cmd = (["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17"]
+           + cflags + [src, "-o", out]
+           + _LINK_FLAGS.get(name, []) + ldflags)
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")
@@ -159,6 +179,36 @@ class NativeRecordFile:
                 yield rec, ctypes.string_at(buf, ln.value)
         finally:
             self._lib.mxtpu_prefetch_destroy(pf)
+
+
+def capi_lib():
+    """The stable C ABI (native/mxtpu_capi.cc, header mxtpu_c_api.h) —
+    reference include/mxnet/c_api.h. Loaded here only for self-testing
+    from Python; real consumers are non-Python hosts that dlopen the .so
+    and call MXTpuInit()."""
+    lib = load("mxtpu_capi")
+    if lib is not None and not getattr(lib, "_sigs_set", False):
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        h = ctypes.c_void_p
+        lib.MXTpuInit.restype = ctypes.c_int
+        lib.MXTpuGetLastError.restype = ctypes.c_char_p
+        lib.MXTpuRuntimeInfo.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.MXTpuRandomSeed.argtypes = [ctypes.c_int]
+        lib.MXTpuNDArrayCreate.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, i64p,
+            ctypes.c_int, ctypes.POINTER(h)]
+        lib.MXTpuNDArrayFree.argtypes = [h]
+        lib.MXTpuNDArrayShape.argtypes = [
+            h, ctypes.POINTER(ctypes.c_int), i64p]
+        lib.MXTpuNDArrayDType.argtypes = [h, ctypes.POINTER(ctypes.c_int)]
+        lib.MXTpuNDArraySyncCopyToCPU.argtypes = [
+            h, ctypes.c_void_p, ctypes.c_uint64]
+        lib.MXTpuImperativeInvoke.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(h), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int, ctypes.POINTER(h), ctypes.POINTER(ctypes.c_int)]
+        lib._sigs_set = True
+    return lib
 
 
 def decode_lib():

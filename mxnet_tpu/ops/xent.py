@@ -40,8 +40,9 @@ def _xent_fwd(logits, labels, axis):
     xf = logits.astype(jnp.float32)      # fuses into the reductions below
     m = jnp.max(xf, axis=axis, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(xf - m), axis=axis)) + jnp.squeeze(m, axis)
-    labels = _clip_labels(labels, logits, axis)
-    idx = jnp.expand_dims(labels, axis)
+    # clip into a local: the residual must keep the ORIGINAL labels so the
+    # bwd rule sees their true dtype (float labels need a float cotangent)
+    idx = jnp.expand_dims(_clip_labels(labels, logits, axis), axis)
     # gather from the ORIGINAL array: N elements move, not a cast of (N, V)
     picked = jnp.squeeze(jnp.take_along_axis(logits, idx, axis), axis)
     loss = lse - picked.astype(jnp.float32)
@@ -64,8 +65,14 @@ def _xent_bwd(axis, res, g):
     iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, ax)
     onehot = iota == jnp.expand_dims(_clip_labels(labels, logits, axis), axis)
     dx = (p - onehot.astype(jnp.float32)) * jnp.expand_dims(g, axis)
-    zeros = onp.zeros(labels.shape, dtype=jax.dtypes.float0)
-    return dx.astype(logits.dtype), zeros
+    # labels carry no gradient; the cotangent's dtype must still match the
+    # primal's: float0 for integer labels, zeros for float labels (MXNet
+    # data iters conventionally ship labels as float32)
+    if jnp.issubdtype(labels.dtype, jnp.inexact):
+        dlab = jnp.zeros(labels.shape, labels.dtype)
+    else:
+        dlab = onp.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dx.astype(logits.dtype), dlab
 
 
 sparse_softmax_xent.defvjp(_xent_fwd, _xent_bwd)

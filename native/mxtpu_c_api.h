@@ -1,0 +1,67 @@
+/* mxtpu C ABI — stable C89-compatible surface for non-Python bindings.
+ *
+ * Reference parity: include/mxnet/c_api.h (MXNDArrayCreate*,
+ * MXImperativeInvoke, MXNDArraySyncCopyToCPU, MXGetLastError ...).
+ * TPU-native design: the runtime is Python/JAX, so this library hosts an
+ * embedded CPython interpreter (or attaches to the enclosing one when the
+ * caller is itself Python) and forwards each call through
+ * mxnet_tpu.capi_bridge. Handles are opaque; every function returns 0 on
+ * success and -1 on failure with the message retrievable via
+ * MXTpuGetLastError() (thread-local, like the reference's MXGetLastError).
+ *
+ * dtype codes follow the reference's mshadow enumeration:
+ *   0=float32 1=float64 2=float16 3=uint8 4=int32 5=int8 6=int64
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+
+/* Start (or attach to) the runtime. Safe to call more than once. */
+int MXTpuInit(void);
+/* Tear down only an interpreter this library created itself. */
+int MXTpuShutdown(void);
+/* Thread-local message for the most recent failing call in this thread. */
+const char* MXTpuGetLastError(void);
+
+/* Runtime info: writes a NUL-terminated string ("platform=...;devices=N")
+ * into buf (truncating at cap). */
+int MXTpuRuntimeInfo(char* buf, uint64_t cap);
+
+/* Seed the global RNG (reference: MXRandomSeed). */
+int MXTpuRandomSeed(int seed);
+/* Block until all dispatched work completes (MXNDArrayWaitAll). */
+int MXTpuWaitAll(void);
+
+/* Create an ndarray by copying `data` (may be NULL for zeros) of
+ * `dtype` with `shape[ndim]`. */
+int MXTpuNDArrayCreate(const void* data, uint64_t nbytes, int dtype,
+                       const int64_t* shape, int ndim, NDArrayHandle* out);
+int MXTpuNDArrayFree(NDArrayHandle h);
+/* ndim is in/out: in = capacity of shape[], out = actual rank. */
+int MXTpuNDArrayShape(NDArrayHandle h, int* ndim, int64_t* shape);
+int MXTpuNDArrayDType(NDArrayHandle h, int* dtype);
+/* Synchronously copy the full buffer to host memory (nbytes must match). */
+int MXTpuNDArraySyncCopyToCPU(NDArrayHandle h, void* out, uint64_t nbytes);
+
+/* Invoke an operator by name with positional ndarray inputs and string
+ * keyword arguments (values parsed as python literals where possible).
+ * `num_outputs` is in/out: in = capacity of outputs[], out = count.
+ * Names resolve against mxnet_tpu.numpy_extension (npx), mxnet_tpu.numpy
+ * and the legacy CamelCase table — the same registry python callers use. */
+int MXTpuImperativeInvoke(const char* op_name,
+                          NDArrayHandle* inputs, int num_inputs,
+                          const char** keys, const char** vals, int num_kw,
+                          NDArrayHandle* outputs, int* num_outputs);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

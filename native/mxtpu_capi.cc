@@ -1,0 +1,269 @@
+// mxtpu C ABI implementation (see mxtpu_c_api.h).
+//
+// Reference parity: src/c_api/c_api.cc — but where the reference's C API
+// fronts a C++ engine, this one fronts the Python/JAX runtime: it embeds
+// CPython (or attaches, when the host process already runs one — e.g. a
+// ctypes consumer) and forwards through mxnet_tpu/capi_bridge.py. All
+// Python-touching paths hold the GIL via PyGILState_Ensure, so the ABI is
+// callable from any host thread, matching the reference's thread-safe
+// C API entry points (c_api.cc MXAPIThreadLocalEntry).
+#include "mxtpu_c_api.h"
+
+#include <Python.h>
+
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+std::atomic<bool> g_we_initialized{false};
+std::mutex g_init_mutex;
+PyObject* g_bridge = nullptr;  // mxnet_tpu.capi_bridge module (owned ref)
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// RAII GIL hold: every exported function body runs inside one of these.
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+int fail(const char* msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+// Call bridge.<method>(args...) returning a new reference (or null+err).
+PyObject* bridge_call(const char* method, PyObject* args) {
+  if (!g_bridge) {
+    g_last_error = "MXTpuInit not called";
+    return nullptr;
+  }
+  PyObject* fn = PyObject_GetAttrString(g_bridge, method);
+  if (!fn) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  if (!out) set_error_from_python();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXTpuInit(void) {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    // the embedded interpreter starts with this thread holding the GIL;
+    // release it so Gil{} below (and other host threads) can acquire it
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  if (g_bridge) return 0;
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+  if (!mod) {
+    set_error_from_python();
+    return -1;
+  }
+  g_bridge = mod;
+  return 0;
+}
+
+int MXTpuShutdown(void) {
+  if (!g_we_initialized.exchange(false)) return 0;  // attached: not ours
+  {
+    Gil gil;
+    Py_XDECREF(g_bridge);
+    g_bridge = nullptr;
+  }
+  // finalization must run on a thread holding the GIL
+  PyGILState_Ensure();
+  Py_Finalize();
+  return 0;
+}
+
+const char* MXTpuGetLastError(void) { return g_last_error.c_str(); }
+
+int MXTpuRuntimeInfo(char* buf, uint64_t cap) {
+  if (!buf || cap == 0) return fail("null buffer");
+  Gil gil;
+  PyObject* out = bridge_call("runtime_info", nullptr);
+  if (!out) return -1;
+  const char* c = PyUnicode_AsUTF8(out);
+  if (!c) {
+    Py_DECREF(out);
+    set_error_from_python();
+    return -1;
+  }
+  std::strncpy(buf, c, cap - 1);
+  buf[cap - 1] = '\0';
+  Py_DECREF(out);
+  return 0;
+}
+
+int MXTpuRandomSeed(int seed) {
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", seed);
+  PyObject* out = bridge_call("seed", args);
+  Py_DECREF(args);
+  if (!out) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int MXTpuWaitAll(void) {
+  Gil gil;
+  PyObject* out = bridge_call("wait_all", nullptr);
+  if (!out) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int MXTpuNDArrayCreate(const void* data, uint64_t nbytes, int dtype,
+                       const int64_t* shape, int ndim, NDArrayHandle* out) {
+  if (!out || ndim < 0 || (ndim > 0 && !shape)) return fail("bad arguments");
+  Gil gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* payload =
+      data ? PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                       static_cast<Py_ssize_t>(nbytes))
+           : (Py_INCREF(Py_None), Py_None);
+  PyObject* args = PyTuple_Pack(3, payload, shp, PyLong_FromLong(dtype));
+  Py_DECREF(payload);
+  Py_DECREF(shp);
+  PyObject* nd = bridge_call("ndarray_from_bytes", args);
+  Py_DECREF(args);
+  if (!nd) return -1;
+  *out = nd;  // handle owns the reference
+  return 0;
+}
+
+int MXTpuNDArrayFree(NDArrayHandle h) {
+  if (!h) return 0;
+  Gil gil;
+  Py_DECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+int MXTpuNDArrayShape(NDArrayHandle h, int* ndim, int64_t* shape) {
+  if (!h || !ndim) return fail("bad arguments");
+  Gil gil;
+  PyObject* args = PyTuple_Pack(1, static_cast<PyObject*>(h));
+  PyObject* shp = bridge_call("ndarray_shape", args);
+  Py_DECREF(args);
+  if (!shp) return -1;
+  Py_ssize_t n = PyTuple_Check(shp) ? PyTuple_Size(shp) : -1;
+  if (n < 0 || (n > 0 && (!shape || *ndim < n))) {
+    Py_DECREF(shp);
+    return fail("shape buffer too small");
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    shape[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shp, i));
+  *ndim = static_cast<int>(n);
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXTpuNDArrayDType(NDArrayHandle h, int* dtype) {
+  if (!h || !dtype) return fail("bad arguments");
+  Gil gil;
+  PyObject* args = PyTuple_Pack(1, static_cast<PyObject*>(h));
+  PyObject* out = bridge_call("ndarray_dtype_code", args);
+  Py_DECREF(args);
+  if (!out) return -1;
+  *dtype = static_cast<int>(PyLong_AsLong(out));
+  Py_DECREF(out);
+  return 0;
+}
+
+int MXTpuNDArraySyncCopyToCPU(NDArrayHandle h, void* out, uint64_t nbytes) {
+  if (!h || !out) return fail("bad arguments");
+  Gil gil;
+  PyObject* args = PyTuple_Pack(1, static_cast<PyObject*>(h));
+  PyObject* b = bridge_call("ndarray_to_bytes", args);
+  Py_DECREF(args);
+  if (!b) return -1;
+  char* src = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(b, &src, &n) != 0 ||
+      static_cast<uint64_t>(n) != nbytes) {
+    Py_DECREF(b);
+    return fail("size mismatch in SyncCopyToCPU");
+  }
+  std::memcpy(out, src, n);
+  Py_DECREF(b);
+  return 0;
+}
+
+int MXTpuImperativeInvoke(const char* op_name, NDArrayHandle* inputs,
+                          int num_inputs, const char** keys,
+                          const char** vals, int num_kw,
+                          NDArrayHandle* outputs, int* num_outputs) {
+  if (!op_name || !num_outputs || (num_inputs > 0 && !inputs) ||
+      (num_kw > 0 && (!keys || !vals)))
+    return fail("bad arguments");
+  Gil gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* o = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject* kw = PyDict_New();
+  for (int i = 0; i < num_kw; ++i) {
+    PyObject* v = PyUnicode_FromString(vals[i]);
+    PyDict_SetItemString(kw, keys[i], v);
+    Py_DECREF(v);
+  }
+  PyObject* args = Py_BuildValue("(sOO)", op_name, ins, kw);
+  Py_DECREF(ins);
+  Py_DECREF(kw);
+  PyObject* outs = bridge_call("invoke", args);
+  Py_DECREF(args);
+  if (!outs) return -1;
+  Py_ssize_t n = PyList_Check(outs) ? PyList_Size(outs) : -1;
+  if (n < 0 || (n > 0 && (!outputs || *num_outputs < n))) {
+    Py_DECREF(outs);
+    return fail("outputs buffer too small");
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(outs, i);
+    Py_INCREF(o);
+    outputs[i] = o;
+  }
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(outs);
+  return 0;
+}
+
+}  // extern "C"

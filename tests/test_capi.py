@@ -1,0 +1,165 @@
+"""Stable C ABI (native/mxtpu_capi.cc + mxtpu_c_api.h; reference
+include/mxnet/c_api.h + src/c_api/c_api.cc).
+
+The library is exercised exactly as a foreign host would: dlopen via
+ctypes, MXTpuInit (attaches to this interpreter), then raw C calls —
+no python objects cross the boundary."""
+import ctypes
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.capi_lib()
+    if lib is None:
+        pytest.skip("toolchain unavailable")
+    assert lib.MXTpuInit() == 0, native
+    return lib
+
+
+def _make(lib, arr):
+    arr = onp.ascontiguousarray(arr)
+    code = {"float32": 0, "float64": 1, "uint8": 3,
+            "int32": 4, "int64": 6}[str(arr.dtype)]
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    rc = lib.MXTpuNDArrayCreate(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, code, shape,
+        arr.ndim, ctypes.byref(h))
+    assert rc == 0, lib.MXTpuGetLastError()
+    return h
+
+
+def _fetch(lib, h, shape, dtype):
+    out = onp.empty(shape, dtype)
+    rc = lib.MXTpuNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+    assert rc == 0, lib.MXTpuGetLastError()
+    return out
+
+
+def test_runtime_info_and_seed(lib):
+    buf = ctypes.create_string_buffer(256)
+    assert lib.MXTpuRuntimeInfo(buf, 256) == 0
+    assert b"platform=" in buf.value and b"devices=" in buf.value
+    assert lib.MXTpuRandomSeed(7) == 0
+    assert lib.MXTpuWaitAll() == 0
+
+
+def test_ndarray_roundtrip_shape_dtype(lib):
+    x = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    h = _make(lib, x)
+    nd = ctypes.c_int(8)
+    shp = (ctypes.c_int64 * 8)()
+    assert lib.MXTpuNDArrayShape(h, ctypes.byref(nd), shp) == 0
+    assert list(shp[:nd.value]) == [3, 4]
+    dt = ctypes.c_int()
+    assert lib.MXTpuNDArrayDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0
+    onp.testing.assert_array_equal(_fetch(lib, h, (3, 4), onp.float32), x)
+    assert lib.MXTpuNDArrayFree(h) == 0
+
+
+def test_create_zeros_when_data_null(lib):
+    shape = (ctypes.c_int64 * 2)(2, 5)
+    h = ctypes.c_void_p()
+    assert lib.MXTpuNDArrayCreate(None, 0, 4, shape, 2,
+                                  ctypes.byref(h)) == 0
+    onp.testing.assert_array_equal(_fetch(lib, h, (2, 5), onp.int32),
+                                   onp.zeros((2, 5), onp.int32))
+    lib.MXTpuNDArrayFree(h)
+
+
+def _invoke(lib, op, handles, kw=None, max_out=4):
+    kw = kw or {}
+    ins = (ctypes.c_void_p * max(1, len(handles)))(*[h.value for h in handles])
+    keys = (ctypes.c_char_p * max(1, len(kw)))(*[k.encode() for k in kw])
+    vals = (ctypes.c_char_p * max(1, len(kw)))(*[v.encode()
+                                                for v in kw.values()])
+    outs = (ctypes.c_void_p * max_out)()
+    n_out = ctypes.c_int(max_out)
+    rc = lib.MXTpuImperativeInvoke(op.encode(), ins, len(handles), keys,
+                                   vals, len(kw), outs, ctypes.byref(n_out))
+    return rc, [ctypes.c_void_p(outs[i]) for i in range(n_out.value)] \
+        if rc == 0 else rc and (rc, [])
+
+
+def test_imperative_invoke_add_and_activation(lib):
+    a = onp.random.RandomState(0).randn(4, 5).astype(onp.float32)
+    b = onp.random.RandomState(1).randn(4, 5).astype(onp.float32)
+    ha, hb = _make(lib, a), _make(lib, b)
+    rc, outs = _invoke(lib, "add", [ha, hb])
+    assert rc == 0, lib.MXTpuGetLastError()
+    onp.testing.assert_allclose(_fetch(lib, outs[0], (4, 5), onp.float32),
+                                a + b, rtol=1e-6)
+    rc, outs2 = _invoke(lib, "activation", [ha],
+                        {"act_type": "'relu'"})
+    assert rc == 0, lib.MXTpuGetLastError()
+    onp.testing.assert_allclose(_fetch(lib, outs2[0], (4, 5), onp.float32),
+                                onp.maximum(a, 0), rtol=1e-6)
+    for h in (ha, hb, outs[0], outs2[0]):
+        lib.MXTpuNDArrayFree(h)
+
+
+def test_invoke_kwargs_literal_parsing(lib):
+    x = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    h = _make(lib, x)
+    rc, outs = _invoke(lib, "reshape", [h], {"newshape": "(3, 2)"})
+    assert rc == 0, lib.MXTpuGetLastError()
+    onp.testing.assert_array_equal(_fetch(lib, outs[0], (3, 2), onp.float32),
+                                   x.reshape(3, 2))
+    lib.MXTpuNDArrayFree(h)
+    lib.MXTpuNDArrayFree(outs[0])
+
+
+def test_unknown_op_sets_last_error(lib):
+    x = _make(lib, onp.zeros((2,), onp.float32))
+    rc, _ = _invoke(lib, "definitely_not_an_op", [x])
+    assert rc != 0
+    assert b"definitely_not_an_op" in lib.MXTpuGetLastError()
+    lib.MXTpuNDArrayFree(x)
+
+
+def test_output_capacity_error(lib):
+    a = _make(lib, onp.ones((2, 2), onp.float32))
+    outs = (ctypes.c_void_p * 1)()
+    n_out = ctypes.c_int(0)  # no capacity
+    ins = (ctypes.c_void_p * 1)(a.value)
+    keys = (ctypes.c_char_p * 1)()
+    vals = (ctypes.c_char_p * 1)()
+    rc = lib.MXTpuImperativeInvoke(b"relu", ins, 1, keys, vals, 0, outs,
+                                   ctypes.byref(n_out))
+    assert rc != 0 and b"capacity" in lib.MXTpuGetLastError() or \
+        b"buffer" in lib.MXTpuGetLastError()
+    lib.MXTpuNDArrayFree(a)
+
+
+def test_pure_c_host_end_to_end(tmp_path):
+    """Compile example/capi_host.c with gcc and run it as a genuinely
+    non-Python process: embeds CPython via the ABI, creates arrays,
+    invokes add, copies results back."""
+    import os
+    import shutil
+    import subprocess
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native.capi_lib()  # ensure the .so is built
+    exe = str(tmp_path / "capi_host")
+    rc = subprocess.run(
+        ["gcc", os.path.join(root, "example", "capi_host.c"),
+         "-I" + os.path.join(root, "native"),
+         "-L" + os.path.join(root, "native", "build"), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.join(root, "native", "build"), "-o", exe],
+        capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    env = dict(os.environ, PYTHONPATH=root, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # plain 1-device CPU for the child
+    run = subprocess.run([exe], capture_output=True, text=True, env=env,
+                         timeout=240)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "C host OK" in run.stdout

@@ -42,6 +42,26 @@ def test_matches_naive_with_grads(dtype, shape, axis):
                                 atol=5e-2 if dtype == jnp.bfloat16 else 1e-4)
 
 
+def test_float_labels_backward():
+    # MXNet data iters conventionally ship labels as float32; the label
+    # input is differentiable-shaped through _invoke, so the VJP must
+    # return a zero float cotangent (not float0) without crashing
+    x = jnp.asarray(onp.random.RandomState(2).randn(4, 6), jnp.float32)
+    l = jnp.array([0.0, 3.0, 5.0, 1.0], jnp.float32)
+    g, gl = jax.grad(lambda x, l: sparse_softmax_xent(x, l).sum(),
+                     argnums=(0, 1))(x, l)
+    assert bool(jnp.isfinite(g).all()) and bool((gl == 0).all())
+
+    from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+    pred = np.array(onp.random.RandomState(4).randn(4, 6).astype('float32'))
+    lbl = np.array(l)
+    pred.attach_grad()
+    with autograd.record():
+        out = SoftmaxCrossEntropyLoss()(pred, lbl).sum()
+    out.backward()
+    assert onp.isfinite(pred.grad.asnumpy()).all()
+
+
 def test_out_of_range_labels_clip():
     # npx.pick(mode='clip') parity: -1 clamps to 0, >=V clamps to V-1,
     # finite loss and grads either way (no NaN poisoning from a corrupt
