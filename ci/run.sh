@@ -1,14 +1,23 @@
 #!/bin/sh
-# CI entrypoint (the role of the reference's ci/build.py stages,
-# minus docker: sanity -> unit tests -> driver contracts).
+# CI entrypoint (the role of the reference's ci/build.py + Jenkinsfile
+# stage matrix, minus docker).
 #
 # Stages:
-#   sanity     - compile-check every python file, regen proto drift check
+#   sanity     - compile-check every python file, onnx gencode drift check
 #   unit       - pytest tests/ on a virtual 8-device CPU mesh
+#   native     - force-rebuild every native/*.cc lib, then run the C-ABI
+#                host example as a pure C process
 #   contracts  - __graft_entry__.py (jit entry + multichip dryrun), bench
 #                smoke on CPU
+#   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
+#                tests/nightly analog
+#   tpu        - hardware-only: Mosaic kernel checks + full bench grid
+#                (skipped with a notice when no TPU is attached)
 #
-# Usage: ci/run.sh [sanity|unit|contracts|all]
+# The stage x platform matrix (what the reference spreads across
+# Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
+#
+# Usage: ci/run.sh [sanity|unit|native|contracts|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -46,6 +55,21 @@ unit() {
     python -m pytest tests/ -q
 }
 
+native() {
+    echo "== native: force-rebuild every helper library =="
+    rm -rf native/build
+    python - <<'PY'
+from mxnet_tpu import native
+for name in ("mxtpu_pool", "mxtpu_io", "mxtpu_decode",
+             "mxtpu_plugin_example", "mxtpu_capi"):
+    lib = native.load(name)
+    assert lib is not None, f"build failed: {name}"
+    print(f"built lib{name}.so")
+PY
+    echo "== native: pure-C ABI host =="
+    python -m pytest tests/test_capi.py -q
+}
+
 contracts() {
     echo "== contracts: driver entrypoints =="
     python __graft_entry__.py
@@ -53,10 +77,27 @@ contracts() {
     JAX_PLATFORMS=cpu python bench.py
 }
 
+nightly() {
+    echo "== nightly: slow bucket (reference tests/nightly analog) =="
+    MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
+}
+
+tpu() {
+    echo "== tpu: hardware stage =="
+    if ! python tools/_tpu_probe.py; then
+        echo "no TPU attached; stage skipped"; return 0
+    fi
+    python tools/tpu_kernel_check.py
+    python bench.py
+}
+
 case "$stage" in
     sanity) sanity ;;
     unit) unit ;;
+    native) native ;;
     contracts) contracts ;;
-    all) sanity; unit; contracts ;;
+    nightly) nightly ;;
+    tpu) tpu ;;
+    all) sanity; unit; native; contracts ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -13,7 +13,10 @@ int main(void) {
     return 1;
   }
   char info[256];
-  MXTpuRuntimeInfo(info, sizeof info);
+  if (MXTpuRuntimeInfo(info, sizeof info) != 0) {
+    fprintf(stderr, "runtime info failed: %s\n", MXTpuGetLastError());
+    return 1;
+  }
   printf("runtime: %s\n", info);
 
   float a[6] = {1, 2, 3, 4, 5, 6}, b[6] = {10, 20, 30, 40, 50, 60};

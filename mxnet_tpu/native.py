@@ -48,12 +48,23 @@ def _python_embed_flags():
     return cflags, ldflags
 
 
+# extra source dependencies per library (headers the staleness check
+# must consider alongside the .cc)
+_EXTRA_DEPS = {
+    "mxtpu_capi": ["mxtpu_c_api.h"],
+}
+
+
 def _build(name):
     src = os.path.join(_SRC_DIR, f"{name}.cc")
     out = os.path.join(_build_dir(), f"lib{name}.so")
     if not os.path.exists(src):
         raise FileNotFoundError(src)
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    newest_src = max([os.path.getmtime(src)] +
+                     [os.path.getmtime(os.path.join(_SRC_DIR, d))
+                      for d in _EXTRA_DEPS.get(name, ())
+                      if os.path.exists(os.path.join(_SRC_DIR, d))])
+    if os.path.exists(out) and os.path.getmtime(out) >= newest_src:
         return out
     os.makedirs(_build_dir(), exist_ok=True)
     cflags, ldflags = ([], [])

@@ -84,8 +84,9 @@ def _invoke(lib, op, handles, kw=None, max_out=4):
     n_out = ctypes.c_int(max_out)
     rc = lib.MXTpuImperativeInvoke(op.encode(), ins, len(handles), keys,
                                    vals, len(kw), outs, ctypes.byref(n_out))
-    return rc, [ctypes.c_void_p(outs[i]) for i in range(n_out.value)] \
-        if rc == 0 else rc and (rc, [])
+    got = ([ctypes.c_void_p(outs[i]) for i in range(n_out.value)]
+           if rc == 0 else [])
+    return rc, got
 
 
 def test_imperative_invoke_add_and_activation(lib):
@@ -133,8 +134,8 @@ def test_output_capacity_error(lib):
     vals = (ctypes.c_char_p * 1)()
     rc = lib.MXTpuImperativeInvoke(b"relu", ins, 1, keys, vals, 0, outs,
                                    ctypes.byref(n_out))
-    assert rc != 0 and b"capacity" in lib.MXTpuGetLastError() or \
-        b"buffer" in lib.MXTpuGetLastError()
+    err = lib.MXTpuGetLastError()
+    assert rc != 0 and (b"capacity" in err or b"buffer" in err)
     lib.MXTpuNDArrayFree(a)
 
 

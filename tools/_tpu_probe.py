@@ -1,0 +1,12 @@
+"""Exit 0 iff a TPU backend is attached and responsive (subprocess probe
+with a hard timeout — the axon tunnel can wedge jax.devices() forever)."""
+import subprocess
+import sys
+
+try:
+    r = subprocess.run([sys.executable, "-c",
+                        "import jax; print(jax.devices()[0].platform)"],
+                       capture_output=True, text=True, timeout=240)
+except subprocess.TimeoutExpired:
+    sys.exit(3)
+sys.exit(0 if (r.returncode == 0 and "tpu" in r.stdout) else 3)

@@ -10,8 +10,11 @@ Usage:  python tools/tpu_kernel_check.py
 """
 from __future__ import annotations
 
+import os
 import sys
 import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
