@@ -84,7 +84,12 @@ nightly() {
 
 tpu() {
     echo "== tpu: hardware stage =="
-    if ! python tools/_tpu_probe.py; then
+    python tools/_tpu_probe.py; probe=$?
+    if [ "$probe" -eq 2 ]; then
+        # a wedged tunnel on the dedicated TPU runner is a red build,
+        # not a skip — otherwise hardware regressions hide forever
+        echo "TPU probe TIMED OUT (wedged tunnel?); failing stage"; return 1
+    elif [ "$probe" -ne 0 ]; then
         echo "no TPU attached; stage skipped"; return 0
     fi
     python tools/tpu_kernel_check.py

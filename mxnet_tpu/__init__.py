@@ -50,6 +50,8 @@ from . import profiler
 from . import util
 from . import runtime
 from . import library
+from . import log
+from . import registry
 from . import test_utils
 from . import symbol
 from . import symbol as sym

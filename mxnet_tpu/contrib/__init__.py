@@ -1,9 +1,15 @@
 """mx.contrib — experimental / auxiliary drivers.
 
 Reference parity: python/mxnet/contrib/ (quantization.py calibration
-driver, tensorboard.py logging bridge, plus onnx/tensorrt drivers whose
-roles live in mx.onnx and the XLA pipeline here).
+driver, tensorboard.py logging bridge, io.py DataLoaderIter, the
+ndarray/symbol contrib op namespaces, and the onnx/tensorrt drivers whose
+real implementations live in mx.onnx and the XLA pipeline here).
 """
+from . import io
+from . import ndarray
+from . import onnx
 from . import quantization
+from . import symbol
 from . import tensorboard
+from . import tensorrt
 from . import text  # noqa: F401,E402 (vocab + pretrained embeddings)

@@ -1,0 +1,63 @@
+"""mx.contrib.io — adapters between Gluon data loaders and legacy DataIter.
+
+Reference parity: python/mxnet/contrib/io.py (DataLoaderIter wrapping a
+``gluon.data.DataLoader`` so 1.x module-style training loops can consume
+it). The reference peeks one batch to learn shapes and zero-pads the last
+partial batch up to ``batch_size``; same contract here, built on this
+package's DataIter/DataBatch (io/__init__.py).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import numpy as _np
+from ..io import DataDesc, DataIter
+
+
+def _pad_to(arr, batch_size, dtype):
+    """Zero-pad axis 0 of `arr` (host or device) up to `batch_size`."""
+    a = onp.asarray(arr, dtype=dtype)
+    if a.shape[0] == batch_size:
+        return _np.array(a)
+    out = onp.zeros((batch_size,) + a.shape[1:], dtype=dtype)
+    out[: a.shape[0]] = a
+    return _np.array(out)
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a ``gluon.data.DataLoader`` through the DataIter interface.
+
+    The loader must yield ``(data, label)`` pairs. Shapes are taken from
+    the first batch; a trailing partial batch is zero-padded and its pad
+    count reported via ``getpad()`` (reference contrib/io.py:50-93).
+    """
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        data, label = next(iter(loader))
+        super().__init__(batch_size=int(data.shape[0]))
+        self._loader = loader
+        self._iter = iter(loader)
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, tuple(data.shape))]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape))]
+        self._batch = None
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def iter_next(self):
+        self._batch = next(self._iter, None)
+        return self._batch is not None
+
+    def getpad(self):
+        return self.batch_size - int(self._batch[0].shape[0])
+
+    def getdata(self):
+        return [_pad_to(self._batch[0], self.batch_size, self.dtype)]
+
+    def getlabel(self):
+        return [_pad_to(self._batch[1], self.batch_size, self.dtype)]
+
+    def getindex(self):
+        return None

@@ -1,0 +1,16 @@
+"""mx.contrib.ndarray — 1.x import-path alias of the nd.contrib namespace.
+
+Reference parity: python/mxnet/contrib/ndarray.py (an empty module the op
+generator populated with `_contrib_*` wrappers at import). Here the real
+namespace lives in ndarray/contrib.py; this module forwards to it so both
+``mx.nd.contrib.foo`` and ``mx.contrib.ndarray.foo`` resolve.
+"""
+from ..ndarray import contrib as _impl
+
+
+def __getattr__(name):
+    return getattr(_impl, name)
+
+
+def __dir__():
+    return dir(_impl)

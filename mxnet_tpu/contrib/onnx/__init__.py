@@ -1,0 +1,31 @@
+"""mx.contrib.onnx — deprecated 1.x import path for the ONNX tools.
+
+Reference parity: python/mxnet/contrib/onnx/__init__.py (forwards to
+mx.onnx with a deprecation notice). The real implementation lives in
+mxnet_tpu/onnx/ (jaxpr→ONNX exporter + runtime). Imports are lazy so this
+facade inherits the parent package's protobuf-missing degradation
+(mxnet_tpu/__init__.py guards `from . import onnx`): without protobuf the
+package still imports and only these calls raise.
+"""
+import warnings as _warnings
+
+
+def _onnx():
+    import mxnet_tpu
+    return mxnet_tpu.onnx  # the guarded module (or _OnnxUnavailable shim)
+
+
+def export_model(*args, **kwargs):
+    _warnings.warn("mx.contrib.onnx is deprecated; use mx.onnx",
+                   DeprecationWarning, stacklevel=2)
+    return _onnx().export_model(*args, **kwargs)
+
+
+def import_model(*args, **kwargs):
+    _warnings.warn("mx.contrib.onnx is deprecated; use mx.onnx",
+                   DeprecationWarning, stacklevel=2)
+    return _onnx().import_model(*args, **kwargs)
+
+
+def __getattr__(name):
+    return getattr(_onnx(), name)

@@ -1,0 +1,14 @@
+"""mx.contrib.symbol — 1.x import-path alias for symbolic contrib ops.
+
+Reference parity: python/mxnet/contrib/symbol.py (empty namespace the op
+generator filled with `_contrib_*` symbol wrappers). Symbolic ops in this
+build all resolve through the shared CamelCase table in symbol/symbol.py's
+module ``__getattr__``; this module forwards there, so
+``mx.contrib.symbol.MultiBoxPrior(...)`` builds the same graph node as
+``mx.sym.contrib`` style calls.
+"""
+from .. import symbol as _sym
+
+
+def __getattr__(name):
+    return getattr(_sym, name)

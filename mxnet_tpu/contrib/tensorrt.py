@@ -1,0 +1,28 @@
+"""mx.contrib.tensorrt — pointer-stub (documented N/A on TPU).
+
+Reference parity: python/mxnet/contrib/tensorrt.py (set_use_fp16 /
+get_use_fp16 / init_tensorrt_params driving the TensorRT subgraph backend,
+src/operator/subgraph/tensorrt/). TensorRT is a CUDA inference runtime;
+the TPU-native equivalent of "hand the graph to an inference engine" is
+XLA itself — use ``HybridBlock.optimize_for(backend=...)`` (gluon/block.py)
+or AMP bf16 policies for reduced-precision inference. These functions keep
+the import path alive and fail with that guidance.
+"""
+from ..base import MXNetError
+
+_MSG = ("TensorRT is a CUDA-only inference runtime with no TPU analog; "
+        "inference here is XLA-compiled already. Use "
+        "HybridBlock.optimize_for(backend=...) for custom rewrite hooks "
+        "or mx.amp for reduced-precision inference.")
+
+
+def set_use_fp16(status):  # noqa: ARG001 — parity signature
+    raise MXNetError(_MSG)
+
+
+def get_use_fp16():
+    raise MXNetError(_MSG)
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):  # noqa: ARG001
+    raise MXNetError(_MSG)

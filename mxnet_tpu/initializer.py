@@ -201,7 +201,11 @@ class Mixed:
         raise MXNetError(f"no initializer pattern matches {name!r}")
 
 
-def create(name, **kwargs):
-    if isinstance(name, Initializer):
-        return name
-    return _registry.get(name)(**kwargs)
+# expose this module's registry through the generic mx.registry factory
+# (reference initializer.py:277-279 builds its triple the same way), so
+# alias/create share one namespace and one config grammar with it
+from . import registry as _registry_mod  # noqa: E402
+
+_registry_mod._REGISTRIES[Initializer] = _registry
+alias = _registry_mod.get_alias_func(Initializer, "initializer")
+create = _registry_mod.get_create_func(Initializer, "initializer")

@@ -1,0 +1,120 @@
+"""mx.registry — generic factory registry for serializable objects.
+
+Reference parity: python/mxnet/registry.py (get_registry /
+get_register_func / get_alias_func / get_create_func). The reference keys
+one flat dict per base class and hands back closures; 1.x users reach it
+directly (``mx.registry.get_create_func(Initializer, 'initializer')``) and
+`initializer.py:277-279` builds its register/alias/create triple from it.
+
+This build keeps the same four-function surface but backs each base class
+with the shared `base._Registry` (thread-safe, alias-aware) so objects
+registered here and objects registered through the framework's own module
+registries are one namespace per base class. ``create`` accepts the same
+config forms as the reference: an instance (passthrough), a dict, a
+``'["name", {kwargs}]'`` json list, a ``'{"nickname": ...}'`` json object,
+or a plain registered name.
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import MXNetError, _Registry
+
+# one registry per base class; exposed (copied) via get_registry
+_REGISTRIES: dict[type, _Registry] = {}
+
+
+def _registry_for(base_class, nickname=None):
+    reg = _REGISTRIES.get(base_class)
+    if reg is None:
+        reg = _REGISTRIES.setdefault(
+            base_class, _Registry(nickname or base_class.__name__.lower()))
+    return reg
+
+
+def get_registry(base_class):
+    """Return a copy of ``{name: class}`` registered under `base_class`."""
+    return dict(_registry_for(base_class)._map)
+
+
+def get_register_func(base_class, nickname):
+    """Return ``register(klass, name=None)`` for `base_class`.
+
+    Warns (like the reference) when a name is re-registered, then replaces.
+    """
+    reg = _registry_for(base_class, nickname)
+
+    def register(klass, name=None):
+        if not (isinstance(klass, type) and issubclass(klass, base_class)):
+            raise MXNetError(
+                f"can only register subclasses of {base_class.__name__}, "
+                f"got {klass!r}")
+        key = (name or klass.__name__).lower()
+        prev = reg.find(key)
+        if prev is not None and prev is not klass:
+            warnings.warn(
+                f"new {nickname} {klass.__module__}.{klass.__name__} "
+                f"registered with name {key} is overriding existing "
+                f"{nickname} {prev.__module__}.{prev.__name__}",
+                UserWarning, stacklevel=2)
+        reg.register(key)(klass)
+        return klass
+
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Return a decorator registering a class under several names."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def _reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return _reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Return ``create(...)`` instantiating registered classes from config."""
+    reg = _registry_for(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args:
+            name, args = args[0], args[1:]
+        elif nickname in kwargs:
+            name = kwargs.pop(nickname)
+        else:
+            raise MXNetError(
+                f"config must name the {nickname} (positionally or via "
+                f"the '{nickname}' key); got keys {sorted(kwargs)}")
+        if isinstance(name, base_class):
+            if args or kwargs:
+                raise MXNetError(
+                    f"{nickname} is already an instance; additional "
+                    "arguments are invalid")
+            return name
+        if isinstance(name, dict):
+            return create(**name)
+        if not isinstance(name, str):
+            raise MXNetError(f"{nickname} must be a string, got {name!r}")
+        if name.startswith("["):
+            if args or kwargs:
+                raise MXNetError("json-list config takes no extra arguments")
+            name, kwargs = json.loads(name)
+            return create(name, **kwargs)
+        if name.startswith("{"):
+            if args or kwargs:
+                raise MXNetError("json-dict config takes no extra arguments")
+            return create(**json.loads(name))
+        klass = reg.find(name)
+        if klass is None:
+            raise MXNetError(
+                f"{name} is not registered. Please register with "
+                f"{nickname}.register first. Registered: {reg.list()}")
+        return klass(*args, **kwargs)
+
+    return create

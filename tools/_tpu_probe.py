@@ -1,5 +1,12 @@
-"""Exit 0 iff a TPU backend is attached and responsive (subprocess probe
-with a hard timeout — the axon tunnel can wedge jax.devices() forever)."""
+"""TPU-attachment probe with a wedge-proof timeout.
+
+Exit codes (consumed by ci/run.sh tpu stage):
+  0 — a TPU backend is attached and responsive
+  2 — probe TIMED OUT: a TPU environment exists but jax.devices() wedged
+      (the axon tunnel can hang forever) — callers must treat this as a
+      hardware FAILURE, not as "no TPU"
+  3 — no TPU attached (probe ran, platform is not tpu)
+"""
 import subprocess
 import sys
 
@@ -8,5 +15,5 @@ try:
                         "import jax; print(jax.devices()[0].platform)"],
                        capture_output=True, text=True, timeout=240)
 except subprocess.TimeoutExpired:
-    sys.exit(3)
+    sys.exit(2)
 sys.exit(0 if (r.returncode == 0 and "tpu" in r.stdout) else 3)
