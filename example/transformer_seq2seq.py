@@ -1,0 +1,126 @@
+"""Transformer encoder-decoder seq2seq on a synthetic reversal task.
+
+Reference parity: the reference ships the fused transformer attention ops
+(src/operator/contrib/transformer.cc:675-828) and a speech-seq2seq LSTM
+example (example/speech_recognition); gluon-nlp carried the actual
+machine-translation transformer. This example is that seq2seq recipe on
+the TPU-native layer family (gluon.nn.TransformerEncoder/DecoderCell):
+teacher-forced training with hybridize() (one XLA executable per step)
+and greedy autoregressive decoding at eval.
+
+Task: given a token sequence, emit it reversed — forces the decoder to
+use cross-attention positions rather than copy locally.
+
+Run: python example/transformer_seq2seq.py [--steps N]
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+BOS, VOCAB = 10, 11  # tokens 0..9 + BOS
+
+
+class Seq2SeqTransformer(gluon.HybridBlock):
+    def __init__(self, units=64, heads=4, hidden=128, layers=2,
+                 seq_len=8):
+        super().__init__()
+        self.seq_len = seq_len
+        self._units = units
+        self.embed = nn.Embedding(VOCAB, units)
+        self.encoder = nn.TransformerEncoder(layers, units, hidden, heads,
+                                             activation="relu")
+        self._dec_cells = []
+        for i in range(layers):
+            cell = nn.TransformerDecoderCell(units, hidden, heads,
+                                             activation="relu")
+            setattr(self, f"dec{i}", cell)
+            self._dec_cells.append(cell)
+        self.head = nn.Dense(VOCAB, flatten=False)
+        self._pos = None
+
+    def _pos_table(self, units):
+        if self._pos is None:
+            self._pos = nn.transformer.positional_encoding(
+                self.seq_len + 1, units)
+        return self._pos
+
+    def encode(self, src):
+        pos = self._pos_table(self._units)
+        return self.encoder(self.embed(src) + pos[: src.shape[1]])
+
+    def decode(self, tgt_in, mem):
+        pos = self._pos_table(self._units)
+        x = self.embed(tgt_in) + pos[: tgt_in.shape[1]]
+        for cell in self._dec_cells:
+            x = cell(x, mem)
+        return self.head(x)                          # (N, T, VOCAB)
+
+    def forward(self, src, tgt_in):
+        """src (N, T) int; tgt_in (N, T) int (BOS-shifted targets)."""
+        return self.decode(tgt_in, self.encode(src))
+
+    def greedy_decode(self, src):
+        """Autoregressive greedy decode, teacher-free (host loop).
+
+        Encodes once; each step runs only the decoder stack on the
+        growing prefix (a new prefix length is a new compiled shape, so
+        this costs T decoder compiles but no encoder re-runs)."""
+        n, t = src.shape
+        mem = self.encode(src)
+        out = onp.full((n, t + 1), BOS, dtype="int32")
+        for i in range(t):
+            logits = self.decode(mx.np.array(out[:, : i + 1]), mem)
+            out[:, i + 1] = logits.asnumpy()[:, i].argmax(-1)
+        return out[:, 1:]
+
+
+def batch(rng, n, seq_len):
+    x = rng.randint(0, 10, (n, seq_len)).astype("int32")
+    y = x[:, ::-1].copy()
+    tgt_in = onp.concatenate(
+        [onp.full((n, 1), BOS, "int32"), y[:, :-1]], axis=1)
+    return x, tgt_in, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = onp.random.RandomState(0)
+    net = Seq2SeqTransformer(seq_len=args.seq_len)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for step in range(args.steps):
+        xv, tv, yv = batch(rng, args.batch, args.seq_len)
+        x, t, y = mx.np.array(xv), mx.np.array(tv), mx.np.array(yv)
+        with mx.autograd.record():
+            loss = loss_fn(net(x, t), y).mean()
+        loss.backward()
+        trainer.step(args.batch)
+        if step % 100 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  loss {float(loss):.4f}")
+
+    xv, _, yv = batch(rng, 128, args.seq_len)
+    pred = net.greedy_decode(mx.np.array(xv))
+    acc = float((pred == yv).mean())
+    print(f"greedy reversal token accuracy: {acc:.3f}")
+    assert acc > 0.95, "seq2seq transformer failed to learn reversal"
+
+
+if __name__ == "__main__":
+    main()
