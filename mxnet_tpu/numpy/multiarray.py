@@ -57,6 +57,16 @@ def _is_inexact(x):
 
 _64BIT = frozenset(("int64", "uint64", "float64", "complex128"))
 
+# ops that jax.vjp cannot linearize fall back to record-without-grad;
+# the exception set is version-dependent (jax 0.9 dropped TracerError)
+_VJP_FALLBACK_ERRORS = tuple(
+    e for e in (TypeError,
+                NotImplementedError,
+                getattr(jax.errors, "TracerError", None),
+                getattr(jax.errors, "TracerArrayConversionError", None),
+                getattr(jax.errors, "ConcretizationTypeError", None))
+    if e is not None)
+
 
 def _wants_x64(dt):
     """True when a dtype spec names a 64-bit type that JAX's default
@@ -226,8 +236,7 @@ def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
         if recording:
             try:
                 out, vjp_fn = jax.vjp(fn, *raws)
-            except (TypeError, jax.errors.TracerError,
-                    jax.errors.ConcretizationTypeError):
+            except _VJP_FALLBACK_ERRORS:
                 recording = False
                 out = fn(*raws)
         else:
@@ -293,8 +302,7 @@ def _invoke_flat(prim, args, name, x64, amp_dt):
         if recording:
             try:
                 out, vjp_fn = jax.vjp(fn, *raws)
-            except (TypeError, jax.errors.TracerError,
-                    jax.errors.ConcretizationTypeError):
+            except _VJP_FALLBACK_ERRORS:
                 recording = False
                 out = fn(*raws)
         elif amp_dt is None and not use_x64:

@@ -1,0 +1,59 @@
+"""opperf_diff regression gate (reference analog: opperf artifact
+consumers; here the diffing is first-class)."""
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "benchmark"))
+from opperf_diff import diff  # noqa: E402
+
+PREV = [
+    {"op": "add", "e2e_us": 10.0, "dispatch_us": 1.0},
+    {"op": "matmul", "e2e_us": 100.0, "dispatch_us": 1.0},
+    {"op": "softmax", "e2e_us": 50.0, "dispatch_us": 1.0},
+    {"op": "gone", "e2e_us": 5.0, "dispatch_us": 1.0},
+    {"op": "was_err", "error": "boom"},
+]
+CUR = [
+    {"op": "add", "e2e_us": 20.0, "dispatch_us": 1.0},       # +100% reg
+    {"op": "matmul", "e2e_us": 60.0, "dispatch_us": 1.0},    # -40% imp
+    {"op": "softmax", "e2e_us": 55.0, "dispatch_us": 1.0},   # +10% noise
+    {"op": "new_op", "e2e_us": 1.0, "dispatch_us": 1.0},
+    {"op": "was_err", "e2e_us": 2.0, "dispatch_us": 1.0},    # FIXED
+]
+
+
+def _maps():
+    return ({r["op"]: r for r in PREV}, {r["op"]: r for r in CUR})
+
+
+def test_diff_classification():
+    prev, cur = _maps()
+    regs, imps, status = diff(prev, cur, "e2e_us", 0.25)
+    assert [r[0] for r in regs] == ["add"]
+    assert [r[0] for r in imps] == ["matmul"]
+    kinds = {op: k for op, k, _ in status}
+    assert kinds == {"gone": "REMOVED", "new_op": "NEW", "was_err": "FIXED"}
+
+
+def test_cli_exit_codes(tmp_path):
+    p, c = tmp_path / "p.json", tmp_path / "c.json"
+    p.write_text(json.dumps(PREV))
+    c.write_text(json.dumps(CUR))
+    tool = os.path.join(os.path.dirname(__file__), "..", "benchmark",
+                        "opperf_diff.py")
+    r = subprocess.run([sys.executable, tool, str(p), str(c)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1 and "REGRESSED" in r.stdout  # add regressed
+    # identical files: clean exit
+    r2 = subprocess.run([sys.executable, tool, str(p), str(p)],
+                        capture_output=True, text=True)
+    assert r2.returncode == 0 and "0 regressions" in r2.stdout
+    # a NEW op that lands already erroring must fail the gate
+    c2 = tmp_path / "c2.json"
+    c2.write_text(json.dumps(PREV + [{"op": "broken_new", "error": "boom"}]))
+    r3 = subprocess.run([sys.executable, tool, str(p), str(c2)],
+                        capture_output=True, text=True)
+    assert r3.returncode == 1
