@@ -345,6 +345,75 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=16,
     return row
 
 
+def bench_gpt_train(precision: str, on_cpu: bool, peak, bs=8, seq=1024,
+                    k_steps=8):
+    """Decoder-only LM pretraining step (gpt2-124m class).
+
+    Causal attention routes through the Pallas flash kernel from seq 512
+    up (ops/attention.py _FLASH_MIN_SEQ_CAUSAL — measured crossover on
+    v5e) instead of materializing (s, s) scores in HBM, so BOTH grid rows
+    (seq 1024 and 2048) are flash rows; the row difference is pure
+    sequence-length scaling, and each row records the path in
+    row['flash_attention']."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import functional
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+    from mxnet_tpu.parallel import scan_steps
+
+    if on_cpu:
+        bs, seq, k_steps = 2, 32, 2
+        units, layers, heads, vocab = 64, 2, 4, 1000
+    else:  # GPT-2 small: 12 layers, 768 units, 12 heads
+        units, layers, heads, vocab = 768, 12, 12, 50257
+    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    net = GPTForCausalLM(vocab_size=vocab, units=units,
+                         hidden_size=units * 4, num_layers=layers,
+                         num_heads=heads, max_length=seq,
+                         dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((2, seq), dtype="int32"))
+    trainable, aux = functional.split_params(net)
+    opt_m = jax.tree_util.tree_map(jnp.zeros_like, trainable)
+    n_params = sum(int(v.size) for v in trainable.values())
+
+    def train_step(trainable, opt_m, ids):
+        def loss_fn(tr):
+            from mxnet_tpu.ops.xent import sparse_softmax_xent
+            logits, _ = functional.functional_call(
+                net, {**_cast_tree(tr, cdtype), **aux}, ids[:, :-1],
+                train=True)
+            return jnp.mean(sparse_softmax_xent(logits, ids[:, 1:]))
+        loss, grads = jax.value_and_grad(loss_fn)(trainable)
+        opt_m = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g.astype(m.dtype), opt_m, grads)
+        trainable = jax.tree_util.tree_map(
+            lambda w, m: w - 1e-3 * m, trainable, opt_m)
+        return trainable, opt_m, loss
+
+    loop = scan_steps(train_step, n_state=2)
+    step = jax.jit(loop, donate_argnums=(0, 1))
+    ids = jnp.asarray(onp.random.randint(0, vocab, (k_steps, bs, seq + 1)),
+                      jnp.int32)
+    step, xla_flops = _compile(step, trainable, opt_m,
+                               jax.ShapeDtypeStruct(ids.shape, ids.dtype))
+    sec, _ = _measure(step, (trainable, opt_m, ids), n_state=2)
+    sec /= k_steps
+    flops = 6.0 * n_params * bs * seq  # 6ND training rule
+    row = _row(f"gpt2_124m_pretrain_bs{bs}_seq{seq}_{precision}", sec, bs,
+               flops, precision, peak, xla_flops=xla_flops)
+    row["steps_per_call"] = k_steps
+    row["params_m"] = round(n_params / 1e6, 1)
+    from mxnet_tpu.ops.attention import _FLASH_MIN_SEQ_CAUSAL
+    row["flash_attention"] = bool(seq >= _FLASH_MIN_SEQ_CAUSAL
+                                  and not on_cpu)
+    return row
+
+
 def bench_augmentation(precision, on_cpu, peak, bs=256, k_steps=8):
     """Batched image-augmentation throughput (mx.image.apply_batch):
     the ImageIter/DataLoader device-side augment pass."""
@@ -469,6 +538,8 @@ def main():
         (bench_bert_train, dict(precision="bf16", bs=32)),
         (bench_bert_train, dict(precision="bf16", bs=48)),
         (bench_bert_train, dict(precision="bf16", bs=64)),
+        (bench_gpt_train, dict(precision="bf16", bs=8, seq=1024)),
+        (bench_gpt_train, dict(precision="bf16", bs=4, seq=2048)),
         (bench_augmentation, dict(precision="fp32")),
         (bench_dataloader_workers, dict(precision="fp32")),
     ]:
@@ -478,6 +549,8 @@ def main():
             # the CPU fallback shrinks every CNN row to one tiny config —
             # the batch-size grid rows would be identical duplicates
             continue
+        if on_cpu and fn is bench_gpt_train and kwargs.get("seq") != 1024:
+            continue  # same dedup for the shrunken GPT rows
         from mxnet_tpu import config as _cfg
         fused_prior = _cfg.get("fused_conv_bn")
         row = None

@@ -46,7 +46,10 @@ def diff(prev, cur, metric="e2e_us", threshold=0.25):
             continue
         if p_err:  # both error: nothing to compare
             continue
-        pv, cv = p[metric], c[metric]
+        pv, cv = p.get(metric), c.get(metric)
+        if pv is None or cv is None:  # artifact predates this metric
+            status.append((op, "NO-METRIC", metric))
+            continue
         if pv <= 0:
             continue
         rel = (cv - pv) / pv
@@ -66,7 +69,8 @@ def main():
     ap.add_argument("--threshold", type=float, default=0.25)
     args = ap.parse_args()
 
-    regs, imps, status = diff(_load(args.prev), _load(args.cur),
+    prev_map, cur_map = _load(args.prev), _load(args.cur)
+    regs, imps, status = diff(prev_map, cur_map,
                               args.metric, args.threshold)
     for op, kind, detail in status:
         print(f"{kind:10s} {op:24s} {detail}")
@@ -76,7 +80,6 @@ def main():
     for op, pv, cv, rel in sorted(regs, key=lambda r: -r[3]):
         print(f"{'REGRESSED':10s} {op:24s} {pv:10.2f} -> {cv:10.2f} "
               f"({rel:+.0%})")
-    cur_map = _load(args.cur)
     n_err = sum(1 for op, k, _ in status
                 if k == "NOW-ERROR"
                 or (k == "NEW" and "error" in cur_map[op]))

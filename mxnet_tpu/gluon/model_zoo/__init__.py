@@ -1,4 +1,5 @@
 """gluon.model_zoo (reference: python/mxnet/gluon/model_zoo/)."""
 from . import vision  # noqa: F401
 from . import bert  # noqa: F401
+from . import gpt  # noqa: F401
 from . import model_store  # noqa: F401

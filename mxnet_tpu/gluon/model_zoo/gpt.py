@@ -1,0 +1,77 @@
+"""Decoder-only LLM family (GPT-2 layout).
+
+Reference parity: the reference's transformer story is the fused attention
+ops (src/operator/contrib/transformer.cc:675-828) consumed by gluon-nlp
+models (model/gpt.py: GPT2Model/gpt2_117m/gpt2_345m). This is that family
+TPU-native: pre-norm causal blocks whose attention routes through the
+Pallas flash kernel at long sequence (ops/attention.py — no (s, s) score
+materialization in HBM), learned positions, tied LM head; shard with
+mxnet_tpu.parallel (tp specs on the projections, sp ring for very long
+context).
+"""
+from __future__ import annotations
+
+from ... import numpy as np
+from ..block import HybridBlock
+from ..nn import Dropout, Embedding, LayerNorm
+from ..nn.transformer import TransformerEncoder
+
+__all__ = ["GPTModel", "GPTForCausalLM", "gpt2_124m", "gpt2_355m"]
+
+
+class GPTModel(HybridBlock):
+    """Causal pre-norm transformer decoder stack (GPT-2 layout).
+
+    forward(inputs (b, s) int) -> hidden states (b, s, units)
+    """
+
+    def __init__(self, vocab_size=50257, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=1024,
+                 dropout=0.1, embed_dropout=0.1):
+        super().__init__()
+        self._units = units
+        self.word_embed = Embedding(vocab_size, units)
+        self.position_embed = Embedding(max_length, units)
+        self.embed_dropout = Dropout(embed_dropout) if embed_dropout else None
+        self.decoder = TransformerEncoder(
+            num_layers, units, hidden_size, num_heads, dropout=dropout,
+            attention_dropout=dropout, activation="gelu", pre_norm=True,
+            causal=True)
+        self.final_ln = LayerNorm(epsilon=1e-5)
+
+    def forward(self, inputs):
+        b, s = inputs.shape
+        pos = np.arange(s, dtype="int32").reshape(1, s)
+        x = self.word_embed(inputs) + self.position_embed(pos)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        return self.final_ln(self.decoder(x))
+
+
+class GPTForCausalLM(HybridBlock):
+    """Next-token LM head over GPTModel, weight-tied to the embedding.
+
+    forward -> logits (b, s, vocab)
+    """
+
+    def __init__(self, backbone=None, **kwargs):
+        super().__init__()
+        self.backbone = backbone if backbone is not None \
+            else GPTModel(**kwargs)
+
+    def forward(self, inputs):
+        h = self.backbone(inputs)
+        w = self.backbone.word_embed.weight.data()
+        return np.dot(h, w.T)
+
+
+def gpt2_124m(vocab_size=50257, **kwargs):
+    """GPT-2 small: 12 layers, 768 units, 12 heads (117M-class)."""
+    return GPTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                    num_layers=12, num_heads=12, **kwargs)
+
+
+def gpt2_355m(vocab_size=50257, **kwargs):
+    """GPT-2 medium: 24 layers, 1024 units, 16 heads (345M-class)."""
+    return GPTModel(vocab_size=vocab_size, units=1024, hidden_size=4096,
+                    num_layers=24, num_heads=16, **kwargs)

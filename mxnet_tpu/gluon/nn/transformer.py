@@ -138,11 +138,13 @@ class TransformerEncoderCell(HybridBlock):
     """
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 attention_dropout=0.0, activation="gelu", pre_norm=False):
+                 attention_dropout=0.0, activation="gelu", pre_norm=False,
+                 causal=False):
         super().__init__()
         self._pre_norm = pre_norm
         self.attention = MultiHeadAttention(units, num_heads,
-                                            dropout=attention_dropout)
+                                            dropout=attention_dropout,
+                                            causal=causal)
         self.attn_ln = LayerNorm()
         self.ffn = PositionwiseFFN(units, hidden_size, activation, dropout)
         self.ffn_ln = LayerNorm()
@@ -202,13 +204,13 @@ class TransformerEncoder(HybridBlock):
 
     def __init__(self, num_layers, units, hidden_size, num_heads,
                  dropout=0.0, attention_dropout=0.0, activation="gelu",
-                 pre_norm=False):
+                 pre_norm=False, causal=False):
         super().__init__()
         self._layers = []
         for i in range(num_layers):
             cell = TransformerEncoderCell(
                 units, hidden_size, num_heads, dropout, attention_dropout,
-                activation, pre_norm)
+                activation, pre_norm, causal)
             setattr(self, f"layer{i}", cell)
             self._layers.append(cell)
 

@@ -78,14 +78,14 @@ def __getattr__(name):
                 if jax.default_backend() != "cpu":
                     from .. import autograd
                     from .multiarray import ndarray, _wrap_out
-                    if autograd.is_recording() and not any(
-                            isinstance(getattr(a, "_data", a),
-                                       jax.core.Tracer) for a in args):
+                    if autograd.is_recording():
                         # geev has no gradient anywhere (reference
-                        # np_eig.cc registers no backward; jax defines no
-                        # eig JVP) — under record() compute values
-                        # eagerly OUTSIDE the tape rather than letting
-                        # jax.vjp trace into the host round-trip
+                        # np_eig.cc registers no backward; jax defines
+                        # no eig JVP/JVP-of-callback) — under record()
+                        # compute values OUTSIDE the tape rather than
+                        # letting jax.vjp trace into the host hop.
+                        # Tracer inputs (hybridized re-trace) route to
+                        # pure_callback inside _host_eig_impl.
                         raws = [a._data if isinstance(a, ndarray) else a
                                 for a in args]
                         return _wrap_out(_host_eig_impl(_name, *raws))

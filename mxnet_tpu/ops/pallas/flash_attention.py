@@ -23,16 +23,19 @@ _NEG_INF = -1e30
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
                 causal, scale, block_q):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    # keep MXU operands in the input dtype (bf16 on TPU): fp32 matmul
+    # costs ~8x the MXU passes; accumulation is fp32 regardless via
+    # preferred_element_type. Softmax math stays fp32.
+    q = q_ref[0]                                      # (bq, d)
     bq, d = q.shape
     nk = pl.cdiv(seq_k, block_k)
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         # dynamic-slice loads clamp at the array end, so a partial final
@@ -48,7 +51,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_k,
         corr = jnp.exp(m_prev - m_new)
         l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc = corr * acc + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
@@ -128,7 +131,9 @@ def _bwd_block(q, do, lse, delta, kb, vb, q0, k0, seq_q, seq_k, causal,
     """Shared recompute for one (q-block, k-block) tile: returns (p, ds).
 
     p = exp(s - lse) rebuilt from saved logsumexp; ds = p*(dp - delta)*scale
-    (standard flash-attention backward tile math). All fp32 on the MXU.
+    (standard flash-attention backward tile math). MXU operands stay in
+    the input dtype with fp32 accumulation; only the softmax algebra is
+    fp32.
     """
     bq, bk = q.shape[0], kb.shape[0]
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
@@ -159,18 +164,18 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dv_ref[...] = jnp.zeros_like(dv_ref)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse, delta = lse_ref[0], delta_ref[0]
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
+        kb = k_ref[0]
+        vb = v_ref[0]
         p, ds = _bwd_block(q, do, lse, delta, kb, vb, qi * block_q,
                            j * block_k, seq_q, seq_k, causal, scale)
         dv_ref[0] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_ref[0] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -190,15 +195,15 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
         dq_ref[...] = jnp.zeros_like(dq_ref)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse, delta = lse_ref[0], delta_ref[0]
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
+        kb = k_ref[0]
+        vb = v_ref[0]
         _, ds = _bwd_block(q, do, lse, delta, kb, vb, qi * block_q,
                            j * block_k, seq_q, seq_k, causal, scale)
         dq_ref[0] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -281,8 +286,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=256, interpret=False):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
+                    block_k=512, interpret=False):
     """Multi-head attention, scores never materialized in HBM.
 
     q: (batch, heads, seq_q, head_dim); k/v: (batch, heads, seq_k, head_dim).
@@ -295,5 +300,14 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
+    # TPU lanes are 128 wide: a 64-dim head halves every load/store and
+    # forces relayouts. Zero-pad head_dim to the lane width — zeros add
+    # nothing to q·k^T and the padded tail of out is exactly zero.
+    d_pad = -d % 128 if d < 128 else 0
+    if d_pad:
+        pad = ((0, 0), (0, 0), (0, d_pad))
+        qr, kr, vr = (jnp.pad(qr, pad), jnp.pad(kr, pad), jnp.pad(vr, pad))
     out = _flash(qr, kr, vr, causal, scale, block_q, block_k, interpret)
+    if d_pad:
+        out = out[..., :d]
     return out.reshape(b, h, sq, d)
