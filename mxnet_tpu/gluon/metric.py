@@ -197,11 +197,14 @@ class F1(EvalMetric):
             self._fn += float(((pred == 0) & (label == 1)).sum())
             self.num_inst += label.shape[0]
 
+    beta = 1.0  # F1 == Fbeta(beta=1); Fbeta overrides per instance
+
     def get(self):
         prec = self._tp / max(self._tp + self._fp, 1e-12)
         rec = self._tp / max(self._tp + self._fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return self.name, f1
+        b2 = self.beta ** 2
+        fb = (1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12)
+        return self.name, fb
 
 
 @register()
@@ -257,6 +260,129 @@ class PearsonCorrelation(EvalMetric):
         return self.name, float(onp.corrcoef(l, p)[0, 1])
 
 
+@register()
+class Fbeta(F1):
+    """F-beta over the binary confusion counts (reference metric.py:816:
+    Fbeta = (1+b^2) * P * R / (b^2 * P + R); beta=1 reduces to F1 — the
+    formula itself lives on F1.get, parameterized by ``beta``)."""
+
+    def __init__(self, name="fbeta", beta=1, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.beta = float(beta)
+
+
+@register()
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of binary / multilabel scores against a threshold
+    (reference metric.py:877)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            hard = (pred > self.threshold).astype(label.dtype)
+            self.sum_metric += float((hard.ravel() == label.ravel()).sum())
+            self.num_inst += label.size
+
+
+@register()
+class MeanPairwiseDistance(EvalMetric):
+    """Mean per-sample Lp distance over the trailing axes
+    (reference metric.py:1202)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:  # one vector = one sample
+                label, pred = label[None], pred[None]
+            diff = (onp.abs(pred - label) ** self.p).reshape(
+                label.shape[0], -1).sum(axis=1) ** (1.0 / self.p)
+            self.sum_metric += float(diff.sum())
+            self.num_inst += label.shape[0]
+
+
+@register()
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis
+    (reference metric.py:1269)."""
+
+    def __init__(self, name="cos_sim", eps=1e-8, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:
+                label, pred = label[None], pred[None]
+            num = (label * pred).sum(axis=-1)
+            den = onp.maximum(
+                onp.linalg.norm(label, axis=-1)
+                * onp.linalg.norm(pred, axis=-1), self.eps)
+            sim = num / den
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register()
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation from a K x K confusion matrix
+    (reference metric.py:1595 — the discrete multiclass MCC:
+    (c*s - t.p) / sqrt((s^2 - p.p)(s^2 - t.t)))."""
+
+    def __init__(self, name="pcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._cm = onp.zeros((0, 0), dtype=onp.float64)
+
+    def reset(self):
+        super().reset()
+        self._cm = onp.zeros((0, 0), dtype=onp.float64)
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = onp.zeros((k, k), dtype=onp.float64)
+            n = self._cm.shape[0]
+            cm[:n, :n] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            label = label.ravel().astype(onp.int64)
+            pred = pred.ravel().astype(onp.int64)
+            if label.size and (label.min() < 0 or pred.min() < 0):
+                raise MXNetError(
+                    "PCC requires non-negative class ids (negative "
+                    "ignore-markers would wrap into the confusion matrix)")
+            k = int(max(label.max(), pred.max())) + 1
+            self._grow(k)
+            onp.add.at(self._cm, (label, pred), 1.0)
+            self.num_inst += label.shape[0]
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        cm = self._cm
+        s = cm.sum()
+        c = onp.trace(cm)
+        t = cm.sum(axis=1)  # true-class totals
+        p = cm.sum(axis=0)  # predicted totals
+        den = onp.sqrt(max(s * s - (p @ p), 0.0)) * \
+            onp.sqrt(max(s * s - (t @ t), 0.0))
+        if den <= 0:
+            return self.name, 0.0
+        return self.name, float((c * s - t @ p) / den)
+
+
 @register("loss")
 class Loss(EvalMetric):
     def __init__(self, name="loss", **kwargs):
@@ -268,6 +394,15 @@ class Loss(EvalMetric):
             loss = float(_as_np(pred).sum())
             self.sum_metric += loss
             self.num_inst += _as_np(pred).size
+
+
+@register()
+class Torch(Loss):
+    """Named Loss alias kept for torch-criterion scripts
+    (reference metric.py:1745)."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name=name, **kwargs)
 
 
 class CompositeEvalMetric(EvalMetric):

@@ -10,3 +10,9 @@ from .conv_rnn_cell import (  # noqa: F401
     Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell,
 )
 from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
+
+# 1.x names: with tracing-first cells, hybrid == regular (the reference
+# split existed only because HybridRecurrentCell was the traceable base,
+# gluon/rnn/rnn_cell.py HybridRecurrentCell/HybridSequentialRNNCell)
+HybridRecurrentCell = RecurrentCell
+HybridSequentialRNNCell = SequentialRNNCell

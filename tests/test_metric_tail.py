@@ -1,0 +1,102 @@
+"""Metric-class tail (reference: gluon/metric.py BinaryAccuracy :877,
+Fbeta :816, MeanPairwiseDistance :1202, MeanCosineSimilarity :1269,
+PCC :1595, Torch :1745). Values oracle-checked by hand / numpy."""
+import numpy as onp
+
+import mxnet_tpu as mx
+
+M = mx.gluon.metric
+
+
+def test_binary_accuracy_threshold():
+    m = M.BinaryAccuracy(threshold=0.6)
+    m.update([mx.np.array([0.0, 1.0, 0.0])],
+             [mx.np.array([0.7, 1.0, 0.55])])
+    # 0.7>0.6 wrong, 1.0 right, 0.55<=0.6 right  (reference doctest)
+    assert abs(m.get()[1] - 2.0 / 3.0) < 1e-9
+
+
+def test_fbeta_reduces_to_f1_and_weights_recall():
+    y = [mx.np.array([1, 1, 0, 0, 1])]
+    p = [mx.np.array([1, 0, 0, 1, 1])]  # tp=2 fp=1 fn=1
+    f1 = M.F1()
+    f1.update(y, p)
+    fb1 = M.Fbeta(beta=1)
+    fb1.update(y, p)
+    assert abs(f1.get()[1] - fb1.get()[1]) < 1e-9
+    fb2 = M.Fbeta(beta=2)
+    fb2.update(y, p)
+    prec = rec = 2.0 / 3.0
+    expect = 5 * prec * rec / (4 * prec + rec)
+    assert abs(fb2.get()[1] - expect) < 1e-9
+
+
+def test_mean_pairwise_distance():
+    lab = onp.array([[0.0, 0.0], [1.0, 1.0]])
+    pred = onp.array([[3.0, 4.0], [1.0, 1.0]])
+    m = M.MeanPairwiseDistance()
+    m.update([mx.np.array(lab)], [mx.np.array(pred)])
+    assert abs(m.get()[1] - (5.0 + 0.0) / 2) < 1e-9  # L2 rows: 5, 0
+    # a 1-D pair is ONE sample, not n scalar samples
+    m1 = M.MeanPairwiseDistance()
+    m1.update([mx.np.array([0.0, 0.0])], [mx.np.array([3.0, 4.0])])
+    assert abs(m1.get()[1] - 5.0) < 1e-9
+
+
+def test_mean_cosine_similarity():
+    lab = onp.array([[1.0, 0.0], [1.0, 1.0]])
+    pred = onp.array([[0.0, 1.0], [2.0, 2.0]])
+    m = M.MeanCosineSimilarity()
+    m.update([mx.np.array(lab)], [mx.np.array(pred)])
+    assert abs(m.get()[1] - (0.0 + 1.0) / 2) < 1e-6
+
+
+def test_pcc_binary_matches_mcc():
+    rng = onp.random.RandomState(0)
+    y = rng.randint(0, 2, 200)
+    p = onp.where(rng.rand(200) < 0.8, y, 1 - y)  # 80% agree
+    pcc = M.PCC()
+    pcc.update([mx.np.array(y)], [mx.np.array(p)])
+    mcc = M.MCC()
+    mcc.update([mx.np.array(y)], [mx.np.array(p)])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+
+
+def test_pcc_multiclass_and_incremental():
+    y1, p1 = onp.array([0, 1, 2, 2]), onp.array([0, 1, 2, 1])
+    y2, p2 = onp.array([2, 0]), onp.array([2, 0])
+    inc = M.PCC()
+    inc.update([mx.np.array(y1)], [mx.np.array(p1)])
+    inc.update([mx.np.array(y2)], [mx.np.array(p2)])
+    allatonce = M.PCC()
+    allatonce.update([mx.np.array(onp.concatenate([y1, y2]))],
+                     [mx.np.array(onp.concatenate([p1, p2]))])
+    assert abs(inc.get()[1] - allatonce.get()[1]) < 1e-12
+    assert 0.5 < inc.get()[1] <= 1.0
+
+
+def test_pcc_rejects_negative_ids():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    m = M.PCC()
+    with pytest.raises(MXNetError, match="non-negative"):
+        m.update([mx.np.array([-1, 0, 1])], [mx.np.array([0, 0, 1])])
+
+
+def test_torch_is_loss_alias():
+    m = M.Torch()
+    m.update(None, [mx.np.array([1.0, 3.0])])
+    assert m.get()[0] == "torch" and abs(m.get()[1] - 2.0) < 1e-9
+
+
+def test_registry_create_names():
+    for name in ("binaryaccuracy", "fbeta", "meanpairwisedistance",
+                 "meancosinesimilarity", "pcc", "torch"):
+        m = M.create(name)
+        assert isinstance(m, M.EvalMetric)
+
+
+def test_hybrid_rnn_cell_aliases():
+    from mxnet_tpu.gluon import rnn
+    assert rnn.HybridRecurrentCell is rnn.RecurrentCell
+    assert rnn.HybridSequentialRNNCell is rnn.SequentialRNNCell
