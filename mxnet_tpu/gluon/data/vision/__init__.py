@@ -2,5 +2,5 @@
 from . import transforms  # noqa: F401
 from .datasets import (  # noqa: F401
     MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset,
-    ImageFolderDataset,
+    ImageFolderDataset, ImageListDataset,
 )

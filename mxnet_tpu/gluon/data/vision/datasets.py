@@ -17,6 +17,7 @@ import struct
 import numpy as onp
 
 from .... import numpy as _np
+from ....base import MXNetError
 from ..dataset import Dataset
 
 
@@ -177,6 +178,50 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageListDataset(Dataset):
+    """Images named by a .lst file or an in-memory list.
+
+    Reference: datasets.py:365 ImageListDataset — entries are either
+    tab-separated ``index\\tlabel...\\trelpath`` lines (the im2rec .lst
+    format, tools/im2rec.py) or ``[label, relpath]`` pairs; multi-value
+    labels come back as float arrays, scalar labels as python floats.
+    """
+
+    def __init__(self, root=".", imglist=None, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.items = []  # (relpath, label) in list order
+        if isinstance(imglist, str):
+            with open(os.path.join(self._root, imglist), "rt") as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        raise MXNetError(
+                            f"malformed .lst line (need idx\\tlabel\\t"
+                            f"path): {line!r}")
+                    label = [float(v) for v in parts[1:-1]]
+                    self.items.append((parts[-1], label))
+        else:
+            for entry in imglist or []:
+                label, path = entry[:-1], entry[-1]
+                if len(label) == 1 and isinstance(label[0], (list, tuple)):
+                    label = label[0]  # [[l0, l1], path] nested form
+                self.items.append((path, [float(v) for v in label]))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        relpath, label = self.items[idx]
+        img = imread(os.path.join(self._root, relpath), self._flag)
+        lab = label[0] if len(label) == 1 else onp.array(label, "float32")
+        if self._transform is not None:
+            return self._transform(img, lab)
+        return img, lab
 
     def __len__(self):
         return len(self.items)

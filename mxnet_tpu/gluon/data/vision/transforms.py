@@ -13,7 +13,7 @@ import numpy as onp
 
 from .... import numpy_extension as npx
 from ...block import Block, HybridBlock
-from ...nn import Sequential
+from ...nn import HybridSequential, Sequential
 
 
 class Compose(Sequential):
@@ -191,3 +191,132 @@ class RandomLighting(Block):
 
     def forward(self, x):
         return npx.image.random_lighting(x, self._alpha)
+
+
+class HybridCompose(HybridSequential):
+    """Compose over hybridizable transforms; hybridizes immediately so the
+    whole chain traces into one executable (reference:
+    transforms/__init__.py:81)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            if not isinstance(t, HybridBlock):
+                # a host-randomness Block would have its coin frozen
+                # into the trace (reference raises the same way)
+                raise ValueError(
+                    f"HybridCompose requires HybridBlocks, got {type(t)}; "
+                    "use Compose for host-random transforms")
+        self.add(*transforms)
+        self.hybridize()
+
+
+class RandomApply(Block):
+    """Apply `transforms` with probability `p` (host coin; reference:
+    transforms/__init__.py:138 — a Sequential whose forward gates on
+    random.random())."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self.transforms = transforms
+        self.p = p
+
+    def forward(self, x):
+        if self.p < onp.random.random():
+            return x
+        return self.transforms(x)
+
+
+class HybridRandomApply(HybridBlock):
+    """Traceable RandomApply: the coin is a traced draw and both branches
+    are data-flow (np.where), so one compiled program covers apply and
+    skip (reference: transforms/__init__.py:168 via npx.cond; on TPU a
+    select is cheaper than real branching)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        if not isinstance(transforms, HybridBlock):
+            raise TypeError("HybridRandomApply requires a HybridBlock")
+        self.transforms = transforms
+        self.p = p
+
+    def forward(self, x):
+        from .... import numpy as _np
+        from .... import random as _random
+        coin = _random.uniform(0, 1, size=())
+        return _np.where(coin < self.p, self.transforms(x), x)
+
+
+class CropResize(HybridBlock):
+    """Fixed crop then optional resize (reference: transforms/image.py:260
+    over _npi.crop + image resize). HWC or NHWC."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=None):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interp = 1 if interpolation is None else interpolation
+
+    def forward(self, data):
+        out = npx.image.crop(data, self._x, self._y, self._w, self._h)
+        if self._size:
+            out = npx.image.resize(out, self._size, False, self._interp)
+        return out
+
+
+class RandomGray(HybridBlock):
+    """Convert to 3-channel luma with probability `p` (reference:
+    transforms/image.py:664; that implementation's weight matrix
+    broadcasts w_c * sum(RGB) — this build uses the intended BT.601
+    luma replicated per channel)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        from .... import numpy as _np
+        from .... import random as _random
+        w = _np.array([0.2989, 0.5870, 0.1140], dtype="float32")
+        xf = x.astype("float32")
+        luma = (xf * w).sum(-1, keepdims=True)
+        gray = _np.broadcast_to(luma, xf.shape)
+        coin = _random.uniform(0, 1, size=())
+        return _np.where(coin < self.p, gray, xf)
+
+
+class Rotate(Block):
+    """Rotate by a fixed angle, CHW/NCHW float32 (reference:
+    transforms/image.py:144 over image.imrotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._args = (rotation_degrees, zoom_in, zoom_out)
+
+    def forward(self, x):
+        from ....image import imrotate
+        return imrotate(x, *self._args)
+
+
+class RandomRotation(Block):
+    """Rotate by a uniform random angle in `angle_limits` with
+    probability `rotate_with_proba` (reference: transforms/image.py:175
+    over image.random_rotate)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        lower, upper = angle_limits
+        if lower >= upper:
+            raise ValueError("`angle_limits` must be an ordered tuple")
+        if not 0 <= rotate_with_proba <= 1:
+            raise ValueError("rotate_with_proba must be in [0, 1]")
+        self._args = (angle_limits, zoom_in, zoom_out)
+        self._proba = rotate_with_proba
+
+    def forward(self, x):
+        if onp.random.random() > self._proba:
+            return x
+        from ....image import random_rotate
+        return random_rotate(x, *self._args)

@@ -249,6 +249,117 @@ def random_size_crop(src, size, area, ratio, interp=2):
     return center_crop(src, size, interp)
 
 
+def _rotate_grid_sample(img, rad, zoom_in, zoom_out):
+    """Rotate one CHW fp32 image by `rad` (bilinear, zero-pad outside).
+
+    jnp math mirrors the reference's grid construction
+    (image/image.py:618-725: rotate a centered grid, normalize AFTER
+    rotation to keep aspect, zoom scale from the rotated corner extents,
+    BilinearSampler with zero padding); the sampler here is a vectorized
+    gather instead of the reference's GPU kernel.
+    """
+    import jax.numpy as jnp
+
+    c, h, w = img.shape
+    hs, ws = (h - 1) / 2.0, (w - 1) / 2.0
+    hm = jnp.arange(h, dtype=jnp.float32)[:, None] - hs
+    wm = jnp.arange(w, dtype=jnp.float32)[None, :] - ws
+    ca, sa = jnp.cos(rad), jnp.sin(rad)
+    gx = (wm * ca - hm * sa) / ws
+    gy = (wm * sa + hm * ca) / hs
+    if zoom_in or zoom_out:
+        rho = jnp.sqrt(jnp.asarray(float(h * h + w * w)))
+        ang = jnp.arctan(h / w)
+        c1x = jnp.abs(rho * jnp.cos(ang + jnp.abs(rad)))
+        c1y = jnp.abs(rho * jnp.sin(ang + jnp.abs(rad)))
+        c2x = jnp.abs(rho * jnp.cos(ang - jnp.abs(rad)))
+        c2y = jnp.abs(rho * jnp.sin(ang - jnp.abs(rad)))
+        mx_, my = jnp.maximum(c1x, c2x), jnp.maximum(c1y, c2y)
+        if zoom_out:
+            scale = jnp.maximum(mx_ / w, my / h)
+        else:
+            scale = jnp.minimum(w / mx_, h / my)
+        gx, gy = gx * scale, gy * scale
+    # [-1,1] -> pixel coords, bilinear gather with zero outside
+    x = (gx + 1.0) * ws
+    y = (gy + 1.0) * hs
+    x0, y0 = jnp.floor(x), jnp.floor(y)
+    wx, wy = x - x0, y - y0
+
+    def gather(yy, xx):
+        valid = (xx >= 0) & (xx <= w - 1) & (yy >= 0) & (yy <= h - 1)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        return jnp.where(valid[None], img[:, yc, xc], 0.0)
+
+    return (gather(y0, x0) * (1 - wx) * (1 - wy)
+            + gather(y0, x0 + 1) * wx * (1 - wy)
+            + gather(y0 + 1, x0) * (1 - wx) * wy
+            + gather(y0 + 1, x0 + 1) * wx * wy)
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate CHW / NCHW float32 image(s) (reference image.py:618).
+
+    Batch input takes a per-image angle vector or a scalar; `zoom_in`
+    crops so no padding shows, `zoom_out` shrinks so the whole source
+    stays visible.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .base import MXNetError
+    from .numpy.multiarray import _invoke, ndarray
+
+    if zoom_in and zoom_out:
+        raise MXNetError("`zoom_in` and `zoom_out` cannot be both True")
+    raw = src._data if isinstance(src, ndarray) else jnp.asarray(src)
+    if raw.dtype != jnp.float32:
+        raise MXNetError("imrotate supports float32 only (call after "
+                         "ToTensor); got " + str(raw.dtype))
+    single = raw.ndim == 3
+    if raw.ndim not in (3, 4):
+        raise MXNetError("imrotate takes CHW or NCHW input")
+    n = 1 if single else raw.shape[0]
+    if onp.isscalar(rotation_degrees):
+        deg = onp.full((n,), rotation_degrees, "float32")
+    else:
+        deg = onp.asarray(
+            rotation_degrees.asnumpy()
+            if isinstance(rotation_degrees, ndarray) else rotation_degrees,
+            "float32").reshape(-1)
+        if single:
+            raise MXNetError("single image takes a scalar angle")
+    if len(deg) != n:
+        raise MXNetError(f"{n} images but {len(deg)} angles")
+
+    def fn(x):
+        rad = jnp.asarray(deg) * (onp.pi / 180.0)
+        batch = x[None] if single else x
+        out = jax.vmap(_rotate_grid_sample,
+                       in_axes=(0, 0, None, None))(batch, rad,
+                                                   zoom_in, zoom_out)
+        return out[0] if single else out
+
+    return _invoke(fn, (src,), name="imrotate")
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by angle(s) drawn uniformly from `angle_limits`
+    (reference image.py:727)."""
+    from .base import MXNetError
+    lo, hi = angle_limits
+    if lo >= hi:
+        raise MXNetError("`angle_limits` must be an ordered tuple")
+    nd = getattr(src, "ndim", 3)
+    if nd == 3:
+        angle = float(onp.random.uniform(lo, hi))
+    else:
+        angle = onp.random.uniform(lo, hi, size=(src.shape[0],)) \
+            .astype("float32")
+    return imrotate(src, angle, zoom_in, zoom_out)
+
+
 # ---------------------------------------------------------------------------
 # augmenters: one batched XLA program per step
 # ---------------------------------------------------------------------------

@@ -278,3 +278,45 @@ def test_image_det_record_iter_surface(tmp_path):
     lab = b.label[0].asnumpy()
     assert lab.shape[0] == 2 and lab.shape[2] == 5
     assert (lab[:, 0, 0] >= 0).all()
+
+
+def test_image_list_dataset(tmp_path):
+    """ImageListDataset: .lst file + in-memory list forms
+    (reference datasets.py:365; .lst format from tools/im2rec.py)."""
+    import numpy as onp
+    from PIL import Image
+
+    from mxnet_tpu.gluon.data.vision import ImageListDataset
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    rng = onp.random.RandomState(0)
+    names = []
+    for i in range(4):
+        arr = rng.randint(0, 255, (8, 8, 3)).astype("uint8")
+        name = f"im{i}.png"
+        Image.fromarray(arr).save(root / name)
+        names.append(name)
+    # .lst file: idx \t label \t relpath (one multi-value label row)
+    lst = "\n".join(f"{i}\t{i % 2}\t{n}" for i, n in enumerate(names[:3]))
+    lst += f"\n3\t1\t2\t{names[3]}\n"  # 2-value label
+    (root / "data.lst").write_text(lst)
+
+    ds = ImageListDataset(root=str(root), imglist="data.lst")
+    assert len(ds) == 4
+    img, lab = ds[1]
+    assert img.shape == (8, 8, 3) and lab == 1.0
+    img3, lab3 = ds[3]
+    assert tuple(onp.asarray(lab3)) == (1.0, 2.0)
+
+    # in-memory list form
+    ds2 = ImageListDataset(root=str(root),
+                           imglist=[[0, names[0]], [1, names[1]]])
+    assert len(ds2) == 2 and ds2[1][1] == 1.0
+
+    # malformed line raises
+    (root / "bad.lst").write_text("0\tonly_path_no_label")
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        ImageListDataset(root=str(root), imglist="bad.lst")

@@ -96,3 +96,107 @@ def test_random_crop_transform_with_pad():
     x = mx.np.array(_img(32, 32))
     out = T.RandomCrop(32, pad=4).forward(x)
     assert out.shape == (32, 32, 3)
+
+
+def test_image_list_transform_tail():
+    """CropResize / RandomGray / RandomApply family / HybridCompose
+    (reference: transforms/__init__.py:81-196, transforms/image.py:260,664)."""
+    x = mx.np.array(_img(16, 16))
+    out = T.CropResize(2, 2, 8, 8, size=(4, 4))(x)
+    assert out.shape == (4, 4, 3)
+    outb = T.CropResize(2, 2, 8, 8)(mx.np.stack([x, x]))
+    assert outb.shape == (2, 8, 8, 3)
+
+    g = T.RandomGray(p=1.0)(x).asnumpy()
+    lum = (x.asnumpy() * onp.array([0.2989, 0.587, 0.114])).sum(-1)
+    assert onp.allclose(g[..., 0], lum, atol=1e-5)
+    assert onp.allclose(g[..., 0], g[..., 2])  # replicated channels
+    same = T.RandomGray(p=0.0)(x).asnumpy()
+    assert onp.allclose(same, x.asnumpy(), atol=1e-6)
+
+    ra = T.RandomApply(T.Compose([T.Cast("float32")]), p=1.0)
+    assert ra(x).shape == x.shape
+    hra = T.HybridRandomApply(T.Cast("float32"), p=0.0)
+    assert onp.allclose(hra(x).asnumpy(), x.asnumpy(), atol=1e-6)
+    hc = T.HybridCompose([T.ToTensor(), T.Normalize(0.5, 0.25)])
+    assert hc(x).shape == (3, 16, 16)
+
+
+def test_rotate_transforms():
+    """imrotate grid sampling (reference image.py:618): 90deg == rot90,
+    zero angle == identity, zoom flags scale; RandomRotation draws."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    a = onp.zeros((1, 5, 5), "float32")
+    a[0, 0, :] = [1, 2, 3, 4, 5]
+    rot = T.Rotate(90.0)(mx.np.array(a)).asnumpy()[0]
+    assert onp.allclose(rot, onp.rot90(a[0], 1), atol=1e-4)
+    ident = T.Rotate(0.0)(mx.np.array(a)).asnumpy()[0]
+    assert onp.allclose(ident, a[0], atol=1e-5)
+
+    # batch with per-image angles
+    from mxnet_tpu.image import imrotate
+    batch = mx.np.array(onp.stack([a, a]).reshape(2, 1, 5, 5))
+    out = imrotate(batch, mx.np.array([0.0, 90.0])).asnumpy()
+    assert onp.allclose(out[0], a, atol=1e-5)
+    assert onp.allclose(out[1, 0], onp.rot90(a[0], 1), atol=1e-4)
+
+    with pytest.raises(MXNetError):
+        imrotate(mx.np.array(a), 10.0, zoom_in=True, zoom_out=True)
+    with pytest.raises(MXNetError):  # uint8 rejected
+        imrotate(mx.np.array(a.astype("uint8")), 10.0)
+
+    rr = T.RandomRotation((-30, 30), rotate_with_proba=1.0)
+    assert rr(mx.np.array(a)).shape == (1, 5, 5)
+    skip = T.RandomRotation((-30, 30), rotate_with_proba=0.0)
+    assert onp.allclose(skip(mx.np.array(a)).asnumpy(), a, atol=1e-6)
+    with pytest.raises(ValueError):
+        T.RandomRotation((30, -30))
+
+
+def test_rotate_zoom_scaling():
+    """zoom_in at 45deg crops to the inscribed region -> NO padding;
+    zoom_out keeps the whole source visible -> rotated diamond with
+    corner padding (reference image.py:693-711 semantics)."""
+    n = 33  # odd so the center pixel is exact
+    img = onp.ones((1, n, n), "float32")
+    mid = n // 2
+    # zoom_in: every output pixel samples inside the source
+    zi = T.Rotate(45.0, zoom_in=True)(mx.np.array(img)).asnumpy()[0]
+    assert zi.min() > 0.99, "zoom_in must show no padding"
+    # plain 45deg rotation pads the corners with zeros
+    plain = T.Rotate(45.0)(mx.np.array(img)).asnumpy()[0]
+    assert plain[0, 0] < 0.01 and plain[0, -1] < 0.01
+    # zoom_out: diamond touches the edge midpoints, corners are padding
+    zo = T.Rotate(45.0, zoom_out=True)(mx.np.array(img)).asnumpy()[0]
+    assert zo[0, 0] < 0.01 and (zo[mid] > 0.5).all()
+    # zoom_in at 45deg shrinks the visible span by sqrt(2): a ramp's
+    # outer values never reach the output
+    ramp = onp.tile(onp.linspace(0, 1, n, dtype="float32"), (n, 1))[None]
+    zi45 = T.Rotate(45.0, zoom_in=True)(mx.np.array(ramp)).asnumpy()[0]
+    vals = zi45[mid]
+    assert vals.min() > 0.1 and vals.max() < 0.95, \
+        "zoom_in should crop away the ramp's outer ends"
+
+
+def test_hybrid_compose_rejects_host_random_blocks():
+    import pytest
+    with pytest.raises(ValueError, match="HybridBlocks"):
+        T.HybridCompose([T.RandomApply(T.Compose([T.Cast()]), p=0.5)])
+
+
+def test_image_list_dataset_flat_multilabel(tmp_path):
+    from PIL import Image
+
+    from mxnet_tpu.gluon.data.vision import ImageListDataset
+    arr = onp.zeros((4, 4, 3), "uint8")
+    Image.fromarray(arr).save(tmp_path / "z.png")
+    ds = ImageListDataset(root=str(tmp_path),
+                          imglist=[[1.0, 2.0, "z.png"]])
+    _, lab = ds[0]
+    assert tuple(onp.asarray(lab)) == (1.0, 2.0)
+    ds2 = ImageListDataset(root=str(tmp_path),
+                           imglist=[[[3.0, 4.0], "z.png"]])
+    assert tuple(onp.asarray(ds2[0][1])) == (3.0, 4.0)
