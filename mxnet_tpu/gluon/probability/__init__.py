@@ -6,6 +6,7 @@ StochasticBlock in block/). TPU-native: densities/samplers are jnp +
 jax.random compositions (fully jittable, explicit PRNG keys via the global
 mx.random facade), so everything traces into hybridized blocks.
 """
+from . import constraint  # noqa: F401
 from .distributions import *  # noqa: F401,F403
 from .distributions import kl_divergence, register_kl  # noqa: F401
 from .transformation import (  # noqa: F401

@@ -1,0 +1,302 @@
+"""Distribution support constraints.
+
+Reference parity: python/mxnet/gluon/probability/distributions/
+constraint.py (Constraint base + ~25 region classes + the
+dependent_property decorator; validation flows through the
+_npx_constraint_check op). Here ``check`` evaluates the region predicate
+with jnp and validates through npx.constraint_check — eager calls raise
+ValueError immediately; traced calls return the value with the predicate
+deferred to the caller (the reference's op raises at engine sync the
+same way).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import numpy_extension as npx
+from ...numpy.multiarray import ndarray
+
+__all__ = [
+    "Constraint", "Real", "Boolean", "Interval", "OpenInterval",
+    "HalfOpenInterval", "IntegerInterval", "IntegerOpenInterval",
+    "IntegerHalfOpenInterval", "GreaterThan", "GreaterThanEq", "LessThan",
+    "LessThanEq", "IntegerGreaterThan", "IntegerGreaterThanEq",
+    "IntegerLessThan", "IntegerLessThanEq", "Positive", "NonNegative",
+    "PositiveInteger", "NonNegativeInteger", "UnitInterval", "Simplex",
+    "LowerTriangular", "LowerCholesky", "PositiveDefinite", "Cat", "Stack",
+    "is_dependent", "dependent", "dependent_property",
+]
+
+
+def _raw(x):
+    return x._data if isinstance(x, ndarray) else jnp.asarray(x)
+
+
+class Constraint:
+    """A region over which a variable is valid. ``check(value)`` returns
+    the value when every element lies in the region, raises ValueError
+    otherwise (deferred to sync under a trace)."""
+
+    def _condition(self, v):
+        raise NotImplementedError
+
+    def _message(self):
+        return f"Constraint violated: value must satisfy {type(self).__name__}"
+
+    def check(self, value):
+        npx.constraint_check(self._condition(_raw(value)), self._message())
+        return value
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class _Dependent(Constraint):
+    """Support depends on other variables; cannot validate standalone
+    (reference constraint.py:53)."""
+
+    def check(self, value):
+        raise ValueError("Cannot validate dependent constraint")
+
+
+def is_dependent(constraint):
+    return isinstance(constraint, _Dependent)
+
+
+class _DependentProperty(property, _Dependent):
+    """@property that reads as a _Dependent constraint on the class
+    (reference constraint.py:66: Uniform.support pattern)."""
+
+
+dependent = _Dependent()
+dependent_property = _DependentProperty
+
+
+class Real(Constraint):
+    def _condition(self, v):
+        return v == v  # noqa: PLR0124 — NaN check
+
+    def _message(self):
+        return "Constraint violated: value should be a real tensor"
+
+
+class Boolean(Constraint):
+    def _condition(self, v):
+        return (v == 0) | (v == 1)
+
+    def _message(self):
+        return "Constraint violated: value should be either 0 or 1"
+
+
+class _Bounded(Constraint):
+    """Shared machinery for (open/half-open/closed, integer) intervals
+    and one-sided bounds: subclasses declare comparison ops."""
+
+    integer = False
+
+    def __init__(self, lower_bound=None, upper_bound=None):
+        self._lower_bound = lower_bound
+        self._upper_bound = upper_bound
+
+    def _cmp_lower(self, v):  # closed by default
+        return v >= self._lower_bound
+
+    def _cmp_upper(self, v):
+        return v <= self._upper_bound
+
+    def _condition(self, v):
+        cond = True
+        if self.integer:
+            cond = v % 1 == 0
+        if self._lower_bound is not None:
+            cond = cond & self._cmp_lower(v)
+        if self._upper_bound is not None:
+            cond = cond & self._cmp_upper(v)
+        return cond
+
+    def _message(self):
+        kind = "integer in " if self.integer else ""
+        return (f"Constraint violated: value should be {kind}"
+                f"{type(self).__name__}"
+                f"({self._lower_bound}, {self._upper_bound})")
+
+
+class Interval(_Bounded):
+    """[lower, upper]"""
+
+
+class OpenInterval(_Bounded):
+    """(lower, upper)"""
+
+    def _cmp_lower(self, v):
+        return v > self._lower_bound
+
+    def _cmp_upper(self, v):
+        return v < self._upper_bound
+
+
+class HalfOpenInterval(_Bounded):
+    """[lower, upper)"""
+
+    def _cmp_upper(self, v):
+        return v < self._upper_bound
+
+
+class IntegerInterval(Interval):
+    integer = True
+
+
+class IntegerOpenInterval(OpenInterval):
+    integer = True
+
+
+class IntegerHalfOpenInterval(HalfOpenInterval):
+    integer = True
+
+
+class GreaterThan(_Bounded):
+    def __init__(self, lower_bound):
+        super().__init__(lower_bound=lower_bound)
+
+    def _cmp_lower(self, v):
+        return v > self._lower_bound
+
+
+class GreaterThanEq(_Bounded):
+    def __init__(self, lower_bound):
+        super().__init__(lower_bound=lower_bound)
+
+
+class LessThan(_Bounded):
+    def __init__(self, upper_bound):
+        super().__init__(upper_bound=upper_bound)
+
+    def _cmp_upper(self, v):
+        return v < self._upper_bound
+
+
+class LessThanEq(_Bounded):
+    def __init__(self, upper_bound):
+        super().__init__(upper_bound=upper_bound)
+
+
+class IntegerGreaterThan(GreaterThan):
+    integer = True
+
+
+class IntegerGreaterThanEq(GreaterThanEq):
+    integer = True
+
+
+class IntegerLessThan(LessThan):
+    integer = True
+
+
+class IntegerLessThanEq(LessThanEq):
+    integer = True
+
+
+class Positive(GreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegative(GreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class PositiveInteger(IntegerGreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegativeInteger(IntegerGreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0, 1)
+
+
+class Simplex(Constraint):
+    def _condition(self, v):
+        return jnp.all(v >= 0, axis=-1) & (jnp.abs(v.sum(-1) - 1) < 1e-6)
+
+    def _message(self):
+        return ("Constraint violated: trailing axis should be "
+                "non-negative and sum to 1")
+
+
+class LowerTriangular(Constraint):
+    def _condition(self, v):
+        return jnp.all(jnp.tril(v) == v, axis=(-2, -1))
+
+    def _message(self):
+        return "Constraint violated: value should be lower-triangular"
+
+
+class LowerCholesky(Constraint):
+    def _condition(self, v):
+        tri = jnp.all(jnp.tril(v) == v, axis=(-2, -1))
+        diag = jnp.all(jnp.diagonal(v, axis1=-2, axis2=-1) > 0, axis=-1)
+        return tri & diag
+
+    def _message(self):
+        return ("Constraint violated: value should be lower-triangular "
+                "with positive diagonal")
+
+
+class PositiveDefinite(Constraint):
+    def _condition(self, v):
+        sym = jnp.all(jnp.abs(v - jnp.swapaxes(v, -1, -2)) < 1e-6,
+                      axis=(-2, -1))
+        # symmetric PD <=> all eigenvalues of (v + v^T)/2 positive;
+        # eigvalsh has TPU/CPU lowerings everywhere (unlike geev)
+        eig = jnp.all(
+            jnp.linalg.eigvalsh((v + jnp.swapaxes(v, -1, -2)) / 2) > 0,
+            axis=-1)
+        return sym & eig
+
+    def _message(self):
+        return "Constraint violated: value should be positive-definite"
+
+
+class Cat(Constraint):
+    """Apply constraints[i] to segments of `lengths[i]` along `dim`
+    (reference constraint.py Cat)."""
+
+    def __init__(self, constraints, dim=0, lengths=None):
+        self.constraints = list(constraints)
+        self.dim = dim
+        self.lengths = list(lengths) if lengths is not None \
+            else [1] * len(self.constraints)
+        if len(self.lengths) != len(self.constraints):
+            raise ValueError("constraints and lengths must align")
+
+    def check(self, value):
+        v = _raw(value)
+        start = 0
+        for cons, length in zip(self.constraints, self.lengths):
+            seg = jnp.take(v, jnp.arange(start, start + length),
+                           axis=self.dim)
+            cons.check(seg)
+            start += length
+        return value
+
+
+class Stack(Constraint):
+    """Apply constraints[i] to slice i along `dim`
+    (reference constraint.py Stack)."""
+
+    def __init__(self, constraints, dim=0):
+        self.constraints = list(constraints)
+        self.dim = dim
+
+    def check(self, value):
+        v = _raw(value)
+        for i, cons in enumerate(self.constraints):
+            cons.check(jnp.take(v, i, axis=self.dim))
+        return value

@@ -101,6 +101,10 @@ def test_normal_against_torch():
      lambda: torch.distributions.Dirichlet(
          torch.tensor([2.0, 3.0, 4.0])),
      onp.array([[0.2, 0.3, 0.5], [0.1, 0.6, 0.3]], onp.float32)),
+    (lambda: mgp.Weibull(2.0, 1.5),
+     lambda: torch.distributions.Weibull(torch.tensor(1.5),
+                                         torch.tensor(2.0)),
+     onp.array([0.5, 1.0, 3.0])),
 ])
 def test_logprob_oracles(mk_ours, mk_torch, values):
     _assert_logprob(mk_ours(), mk_torch(), values)
@@ -237,3 +241,72 @@ def test_stochastic_block_vae_style():
     assert out.shape == (2, 8)
     assert len(net.losses) == 1
     assert net.losses[0].shape == (2, 4)
+
+
+def test_weibull_moments_and_sampling():
+    """Weibull mean/var/entropy vs torch; inverse-CDF sampler moments."""
+    d = mgp.Weibull(2.0, 1.5)
+    t = torch.distributions.Weibull(torch.tensor(1.5), torch.tensor(2.0))
+    onp.testing.assert_allclose(float(d.mean), float(t.mean), rtol=1e-5)
+    onp.testing.assert_allclose(float(d.variance), float(t.variance),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(float(d.entropy()), float(t.entropy()),
+                                rtol=1e-5)
+    mx.random.seed(3)
+    s = d.sample((20000,)).asnumpy()
+    assert abs(s.mean() - float(t.mean)) < 0.02
+    # cdf/icdf round-trip
+    u = onp.array([0.1, 0.5, 0.9], "float32")
+    x = d.icdf(np.array(u)).asnumpy()
+    onp.testing.assert_allclose(d.cdf(np.array(x)).asnumpy(), u, atol=1e-5)
+
+
+def test_constraints():
+    """Constraint namespace (reference distributions/constraint.py)."""
+    import pytest
+
+    from mxnet_tpu.gluon.probability import constraint as C
+
+    ok = np.array([0.5, 0.2])
+    assert C.Positive().check(ok) is ok
+    with pytest.raises(ValueError):
+        C.Positive().check(np.array([0.0, 1.0]))  # open bound
+    assert C.NonNegative().check(np.array([0.0, 1.0])) is not None
+    with pytest.raises(ValueError):
+        C.Real().check(np.array([onp.nan]))
+    with pytest.raises(ValueError):
+        C.Boolean().check(np.array([0.0, 2.0]))
+    C.Interval(0, 1).check(np.array([0.0, 1.0]))
+    with pytest.raises(ValueError):
+        C.OpenInterval(0, 1).check(np.array([0.0]))
+    C.IntegerInterval(0, 5).check(np.array([0.0, 5.0]))
+    with pytest.raises(ValueError):
+        C.IntegerInterval(0, 5).check(np.array([1.5]))
+    C.Simplex().check(np.array([[0.2, 0.8], [0.5, 0.5]]))
+    with pytest.raises(ValueError):
+        C.Simplex().check(np.array([0.2, 0.3]))
+    L = onp.array([[1.0, 0.0], [0.5, 2.0]], "float32")
+    C.LowerCholesky().check(np.array(L))
+    with pytest.raises(ValueError):
+        C.LowerCholesky().check(np.array(-L))
+    C.PositiveDefinite().check(np.array(L @ L.T))
+    with pytest.raises(ValueError):
+        C.PositiveDefinite().check(np.array([[0.0, 1.0], [1.0, 0.0]]))
+    # Cat / Stack segment application
+    C.Cat([C.Positive(), C.Interval(0, 1)], dim=0, lengths=[1, 1]).check(
+        np.array([2.0, 0.5]))
+    with pytest.raises(ValueError):
+        C.Stack([C.Positive(), C.Boolean()], dim=0).check(
+            np.array([2.0, 0.5]))
+    # dependent constraints cannot be validated standalone
+    with pytest.raises(ValueError):
+        C.dependent.check(ok)
+    assert C.is_dependent(C.dependent)
+
+
+def test_weibull_zero_boundary():
+    """log_prob(0): finite log(1/scale) at k==1, -inf at k>1, never NaN."""
+    got = mgp.Weibull(1.0, 2.0).log_prob(np.array([0.0])).asnumpy()
+    onp.testing.assert_allclose(got, [onp.log(0.5)], atol=1e-6)
+    assert mgp.Weibull(2.0, 1.0).log_prob(np.array([0.0])).asnumpy() == -onp.inf
+    assert mgp.Weibull(2.0, 1.0).log_prob(np.array([-1.0])).asnumpy() == -onp.inf
