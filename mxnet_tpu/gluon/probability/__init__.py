@@ -12,6 +12,9 @@ from .distributions import kl_divergence, register_kl  # noqa: F401
 from .transformation import (  # noqa: F401
     Transformation, ExpTransform, AffineTransform, SigmoidTransform,
     LogTransform, AbsTransform, PowerTransform, ComposeTransform,
-    TransformedDistribution,
+    SoftmaxTransform, TransformedDistribution,
 )
-from .stochastic_block import StochasticBlock, StochasticBlockGrad  # noqa: F401
+from .domain_map import biject_to, domain_map, transform_to  # noqa: F401
+from .stochastic_block import (  # noqa: F401
+    StochasticBlock, StochasticBlockGrad, StochasticSequential,
+)

@@ -1,0 +1,90 @@
+"""Constraint → transformation registry (biject_to / transform_to).
+
+Reference parity: python/mxnet/gluon/probability/transformation/
+domain_map.py (a type-keyed registry mapping support constraints to
+bijections from unconstrained space; used for variational parameter
+reparameterization). Same registration set: Positive → Exp,
+GreaterThan(Eq) → Exp∘Affine(lb, 1), LessThan → Exp∘Affine(ub, −1),
+Interval/HalfOpenInterval → Sigmoid (unit) or Sigmoid∘Affine(lb, width).
+"""
+from __future__ import annotations
+
+from numbers import Number
+
+from . import constraint as C
+from .transformation import (AffineTransform, ComposeTransform, ExpTransform,
+                             SigmoidTransform)
+
+
+class domain_map:  # noqa: N801 — reference-parity name
+    """Registry from Constraint types to transformation factories."""
+
+    def __init__(self):
+        self._storage = {}
+
+    def register(self, constraint, factory=None):
+        if factory is None:  # decorator mode
+            return lambda f: self.register(constraint, f)
+        if isinstance(constraint, C.Constraint):
+            constraint = type(constraint)
+        if not (isinstance(constraint, type)
+                and issubclass(constraint, C.Constraint)):
+            raise TypeError(
+                f"expected a Constraint subclass or instance, "
+                f"got {constraint!r}")
+        self._storage[constraint] = factory
+        return factory
+
+    def __call__(self, constraint):
+        factory = self._storage.get(type(constraint))
+        if factory is None:
+            raise NotImplementedError(
+                f"Cannot transform {type(constraint).__name__} constraints")
+        return factory(constraint)
+
+
+biject_to = domain_map()
+transform_to = domain_map()
+
+
+@biject_to.register(C.Positive)
+@biject_to.register(C.NonNegative)
+@transform_to.register(C.Positive)
+@transform_to.register(C.NonNegative)
+def _to_positive(constraint):  # noqa: ARG001
+    return ExpTransform()
+
+
+@biject_to.register(C.GreaterThan)
+@biject_to.register(C.GreaterThanEq)
+@transform_to.register(C.GreaterThan)
+@transform_to.register(C.GreaterThanEq)
+def _to_greater_than(constraint):
+    return ComposeTransform([ExpTransform(),
+                             AffineTransform(constraint._lower_bound, 1)])
+
+
+@biject_to.register(C.LessThan)
+@biject_to.register(C.LessThanEq)
+@transform_to.register(C.LessThan)
+@transform_to.register(C.LessThanEq)
+def _to_less_than(constraint):
+    return ComposeTransform([ExpTransform(),
+                             AffineTransform(constraint._upper_bound, -1)])
+
+
+@biject_to.register(C.Interval)
+@biject_to.register(C.HalfOpenInterval)
+@biject_to.register(C.OpenInterval)
+@biject_to.register(C.UnitInterval)
+@transform_to.register(C.Interval)
+@transform_to.register(C.HalfOpenInterval)
+@transform_to.register(C.OpenInterval)
+@transform_to.register(C.UnitInterval)
+def _to_interval(constraint):
+    lb, ub = constraint._lower_bound, constraint._upper_bound
+    if (isinstance(lb, Number) and lb == 0
+            and isinstance(ub, Number) and ub == 1):
+        return SigmoidTransform()
+    return ComposeTransform([SigmoidTransform(),
+                             AffineTransform(lb, ub - lb)])

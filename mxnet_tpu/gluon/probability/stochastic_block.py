@@ -47,3 +47,34 @@ class StochasticBlock(HybridBlock):
 
 class StochasticBlockGrad(StochasticBlock):
     """Kept for API parity (reference exports both names)."""
+
+
+class StochasticSequential(StochasticBlock):
+    """Stack StochasticBlocks; child losses bubble up (reference:
+    block/stochastic_block.py:87)."""
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)):
+                args, x = list(x[1:]), x[0]
+            # collect NOW: the next call to a weight-shared block rebinds
+            # its _losses, and index alignment with layers must hold even
+            # for calls that added nothing
+            if hasattr(block, "_losses"):
+                self.add_loss(list(block._losses))
+        if args:
+            x = tuple([x] + args)
+        return x
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)

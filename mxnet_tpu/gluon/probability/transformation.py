@@ -91,6 +91,20 @@ class SigmoidTransform(Transformation):
         return -jax.nn.softplus(-x) - jax.nn.softplus(x)
 
 
+class SoftmaxTransform(Transformation):
+    """Map reals to the simplex along the last axis (reference:
+    transformation.py:264; not bijective — log is a one-sided inverse)."""
+
+    bijective = False
+    event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
 class AbsTransform(Transformation):
     bijective = False
 

@@ -310,3 +310,86 @@ def test_weibull_zero_boundary():
     onp.testing.assert_allclose(got, [onp.log(0.5)], atol=1e-6)
     assert mgp.Weibull(2.0, 1.0).log_prob(np.array([0.0])).asnumpy() == -onp.inf
     assert mgp.Weibull(2.0, 1.0).log_prob(np.array([-1.0])).asnumpy() == -onp.inf
+
+
+def test_domain_map_biject_to():
+    """biject_to/transform_to map support constraints to bijections that
+    land inside the constraint (reference transformation/domain_map.py)."""
+    from mxnet_tpu.gluon.probability import biject_to, transform_to
+    from mxnet_tpu.gluon.probability import constraint as C
+
+    x = np.array([-2.0, 0.0, 3.0])
+    y = biject_to(C.Positive())(x)
+    assert (y.asnumpy() > 0).all()
+    y = biject_to(C.GreaterThan(5.0))(x)
+    assert (y.asnumpy() > 5).all()
+    y = biject_to(C.LessThan(-1.0))(x)
+    assert (y.asnumpy() < -1).all()
+    y = biject_to(C.UnitInterval())(x)
+    assert ((y.asnumpy() > 0) & (y.asnumpy() < 1)).all()
+    t = biject_to(C.Interval(2.0, 6.0))
+    y = t(x)
+    assert ((y.asnumpy() > 2) & (y.asnumpy() < 6)).all()
+    # inverse round-trips
+    onp.testing.assert_allclose(t.inv(y).asnumpy(), x.asnumpy(),
+                                atol=1e-5)
+    import pytest
+    with pytest.raises(NotImplementedError):
+        transform_to(C.Simplex())
+    # SoftmaxTransform lands on the simplex
+    s = mgp.SoftmaxTransform()(np.array([[1.0, 2.0, 3.0]]))
+    onp.testing.assert_allclose(s.asnumpy().sum(-1), 1.0, atol=1e-6)
+
+
+def test_stochastic_sequential():
+    """Child losses bubble to the stack (reference block/stochastic_block
+    StochasticSequential)."""
+    from mxnet_tpu.gluon import nn
+
+    class KLLayer(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4, flatten=False)
+
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            h = self.dense(x)
+            self.add_loss((h ** 2).mean())
+            return h
+
+    seq = mgp.StochasticSequential()
+    seq.add(KLLayer(), KLLayer())
+    seq.initialize()
+    out = seq(np.ones((2, 4)))
+    assert out.shape == (2, 4)
+    assert len(seq.losses) == 2 and len(seq[0].losses) == 1
+    assert len(seq) == 2
+    assert len(seq.collect_params()) == 4  # 2 layers x (weight, bias)
+
+
+def test_stochastic_sequential_weight_sharing():
+    """Adding the SAME block twice must keep both calls' losses."""
+    from mxnet_tpu.gluon import nn
+
+    class Marker(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(3, flatten=False)
+
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            h = self.dense(x)
+            self.add_loss(h.sum())
+            return h
+
+    blk = Marker()
+    seq = mgp.StochasticSequential()
+    seq.add(blk, blk)  # weight-shared
+    seq.initialize()
+    seq(np.ones((1, 3)))
+    assert len(seq.losses) == 2
+    # the two entries are from DIFFERENT calls (different values)
+    v0, v1 = float(seq.losses[0][0]), float(seq.losses[1][0])
+    assert v0 != v1
+    # shared block: both prefixes resolve to the same Parameter objects
+    assert len({id(p) for p in seq.collect_params().values()}) == 2
