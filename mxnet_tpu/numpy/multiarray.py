@@ -422,7 +422,19 @@ class ndarray:
 
     # -- conversion --------------------------------------------------------
     def asnumpy(self):
-        return onp.asarray(jax.device_get(self._data))
+        """Host copy with MXNet's contract: C-contiguous and writable.
+
+        device_get is allowed to hand back a strided / read-only view
+        (the axon TPU runtime returns non-C-contiguous buffers — a
+        `.astype(...).reshape(-1)` then silently copies and in-place
+        writes vanish, observed as all-zero finite differences on
+        hardware); the reference's asnumpy always yields an owned dense
+        buffer (ndarray.cc SyncCopyToCPU), so normalize here.
+        """
+        host = onp.asarray(jax.device_get(self._data))
+        if not (host.flags["C_CONTIGUOUS"] and host.flags["WRITEABLE"]):
+            host = host.copy(order="C")  # owned, dense, writable
+        return host
 
     def asscalar(self):
         return self.asnumpy().item()

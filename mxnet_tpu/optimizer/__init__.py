@@ -11,3 +11,5 @@ from .optimizer import (  # noqa: F401
     SGD, SGLD, Signum, NAG, Adam, AdamW, AdaBelief, AdaGrad, AdaDelta,
     RMSProp, Ftrl, LAMB, LARS, LANS, Nadam, DCASGD, Adamax, FTML,
 )
+from . import contrib  # noqa: F401
+from .contrib import GroupAdaGrad  # noqa: F401

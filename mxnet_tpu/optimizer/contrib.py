@@ -1,0 +1,65 @@
+"""Contrib optimizers.
+
+Reference parity: python/mxnet/optimizer/contrib.py (GroupAdaGrad over
+src/operator/contrib/optimizer_op.cc group_adagrad_update: AdaGrad with
+one learning-rate history cell per ROW — the embedding-training
+optimizer, O(rows) state instead of O(elements)).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..numpy.multiarray import _wrap
+from .optimizer import Optimizer, register
+
+__all__ = ["GroupAdaGrad"]
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with row-wise accumulators (reference contrib.py:26).
+
+    update:
+        history += mean(grad**2, axis=1, keepdims=True)
+        weight  -= lr * grad / (sqrt(history) + epsilon)
+
+    Weight decay is not supported (reference asserts the same).
+    """
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+        self.lazy_update = True  # sparse grads update touched rows only
+
+    def create_state(self, index, weight):
+        if len(weight.shape) != 2:
+            raise MXNetError(
+                "GroupAdaGrad expects 2-D (row-partitioned) weights, got "
+                f"shape {tuple(weight.shape)}")
+        return _wrap(jnp.zeros((weight.shape[0], 1), weight.dtype))
+
+    def _update_impl(self, w, g, state, lr, wd):
+        if wd != 0:
+            raise MXNetError(
+                "Weight decay is not supported for GroupAdaGrad")
+        g = self._prep_grad(g)
+        hist = state._data + jnp.mean(g * g, axis=1, keepdims=True)
+        state._rebind(hist)
+        return w - lr * g / (jnp.sqrt(hist) + self.epsilon), state
+
+    def _lazy_update_impl(self, w, rsp, state, lr, wd):
+        """O(nnz-rows) update for row-sparse gradients — the whole point
+        of the row-wise history (reference group_adagrad_update sparse
+        path). Sentinel padding rows drop out of the scatters."""
+        if wd != 0:
+            raise MXNetError(
+                "Weight decay is not supported for GroupAdaGrad")
+        idx = rsp.indices._data
+        g = self._prep_grad(rsp.data._data.astype(w.dtype))
+        hist_rows = jnp.take(state._data, idx, axis=0, mode="clip")
+        hist_rows = hist_rows + jnp.mean(g * g, axis=1, keepdims=True)
+        state._rebind(state._data.at[idx].set(hist_rows, mode="drop"))
+        w_rows = jnp.take(w, idx, axis=0, mode="clip")
+        new_rows = w_rows - lr * g / (jnp.sqrt(hist_rows) + self.epsilon)
+        return w.at[idx].set(new_rows, mode="drop"), state

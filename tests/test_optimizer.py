@@ -205,3 +205,56 @@ def test_dist_kvstore_single_process():
     out = np.zeros(2)
     kv.pushpull(0, [np.ones(2)], out=out)
     assert_almost_equal(out, onp.ones(2))
+
+
+def test_group_adagrad():
+    """Row-wise AdaGrad (reference optimizer/contrib.py:26): history is
+    one cell per row; update matches a hand-rolled numpy transcription."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    o = opt.create("groupadagrad", learning_rate=0.5)
+    w = np.array(onp.ones((3, 4), "float32"))
+    state = o.create_state(0, w)
+    assert state.shape == (3, 1)
+    rng = onp.random.RandomState(0)
+    wref = onp.ones((3, 4), "float32")
+    href = onp.zeros((3, 1), "float32")
+    for _ in range(3):
+        g = rng.randn(3, 4).astype("float32")
+        o.update(0, w, np.array(g), state)
+        href += (g * g).mean(axis=1, keepdims=True)
+        wref -= 0.5 * g / (onp.sqrt(href) + 1e-6)
+    onp.testing.assert_allclose(w.asnumpy(), wref, rtol=1e-5)
+    onp.testing.assert_allclose(state.asnumpy(), href, rtol=1e-5)
+    # 1-D weights and weight decay are rejected like the reference
+    with pytest.raises(MXNetError):
+        o.create_state(0, np.array(onp.ones(3, "float32")))
+    o2 = opt.create("groupadagrad", learning_rate=0.5, wd=0.1)
+    with pytest.raises(MXNetError):
+        o2.update(0, w, np.array(onp.ones((3, 4), "float32")), state)
+
+
+def test_group_adagrad_lazy_sparse():
+    """Row-sparse grads touch only their rows (O(nnz) path)."""
+    from mxnet_tpu.ndarray import sparse
+
+    o = opt.create("groupadagrad", learning_rate=0.5)
+    w = np.array(onp.ones((5, 4), "float32"))
+    state = o.create_state(0, w)
+    g_rows = onp.array([[1.0] * 4, [2.0] * 4], "float32")
+    rsp = sparse.row_sparse_array((np.array(g_rows),
+                                   np.array(onp.array([1, 3], "int64"))),
+                                  shape=(5, 4))
+    o.update(0, w, rsp, state)
+    wn, hn = w.asnumpy(), state.asnumpy()
+    # untouched rows unchanged, zero history
+    for r in (0, 2, 4):
+        assert (wn[r] == 1.0).all() and hn[r] == 0.0
+    # touched rows follow the dense formula
+    for r, g in ((1, 1.0), (3, 2.0)):
+        h = g * g
+        assert abs(hn[r] - h) < 1e-6
+        assert onp.allclose(wn[r], 1.0 - 0.5 * g / (onp.sqrt(h) + 1e-6),
+                            rtol=1e-6)
