@@ -281,6 +281,58 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     return row
 
 
+def bench_resnet50_infer_int8(on_cpu: bool, peak, k_steps=16, bs=32,
+                              **_ignored):
+    """Post-training-quantized ResNet-50 inference (contrib.quantization):
+    int8 MXU matmuls/convs with int32 accumulation. MFU is reported
+    against the int8 peak (2x bf16 on v5e), so the row's mfu is directly
+    comparable to the bf16 rows' as a fraction of what each dtype's MXU
+    path could do."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import functional
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import scan_steps
+
+    size = 224
+    if on_cpu:
+        bs, size, k_steps = 4, 64, 2
+
+    net = resnet50_v1()
+    net.initialize()
+    calib = mx.np.array(onp.random.RandomState(0)
+                        .rand(bs, 3, size, size).astype("float32"))
+    # quantize_net's own eager calibration forward triggers deferred init
+    qnet = q.quantize_net(net, calib_data=[calib], calib_mode="naive")
+    qnet.hybridize()
+    params = functional.param_arrays(qnet)
+
+    def fwd(carry, x):
+        out, _ = functional.functional_call(
+            qnet, params, x + carry.astype(x.dtype), train=False)
+        return jnp.max(out).astype(jnp.float32), jnp.sum(out,
+                                                         dtype=jnp.float32)
+
+    step = jax.jit(scan_steps(fwd, n_state=1))
+    xs = jax.random.normal(jax.random.PRNGKey(0),
+                           (k_steps, bs, 3, size, size), jnp.float32)
+    step, xla_flops = _compile(step, jax.ShapeDtypeStruct((), jnp.float32),
+                               jax.ShapeDtypeStruct(xs.shape, xs.dtype))
+    sec, _ = _measure(step, (jnp.zeros(()), xs), n_state=1)
+    sec /= k_steps
+    flops = bs * RESNET50_INFER_FLOPS_PER_IMG * (size / 224.0) ** 2
+    int8_peak = peak * 2 if peak else None  # v5e: 394 TOPS int8
+    row = _row(f"resnet50_infer_int8_bs{bs}", sec, bs, flops,
+               "int8", int8_peak, xla_flops=xla_flops)
+    row["steps_per_call"] = k_steps
+    row["peak_basis"] = "int8 (2x bf16)"
+    return row
+
+
 def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=16,
                      dropout=0.0):
     import jax
@@ -533,6 +585,7 @@ def main():
         (bench_resnet50_infer, dict(precision="bf16", bs=1)),
         (bench_resnet50_infer, dict(precision="bf16")),   # bs32
         (bench_resnet50_infer, dict(precision="bf16", bs=128)),
+        (bench_resnet50_infer_int8, dict()),
         (bench_inception_train, dict(precision="bf16")),  # bs32
         (bench_inception_train, dict(precision="bf16", bs=64)),
         (bench_bert_train, dict(precision="bf16", bs=32)),

@@ -254,7 +254,10 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
     over `calib_data` (an iterable of input batches or (data, ...) tuples)
     with `calib_mode` in {'naive', 'entropy', 'percentile'}, then returns
     a **new** network (deep copy) whose targeted layers are replaced by
-    QuantizedDense/QuantizedConv.  The original network is untouched.
+    QuantizedDense/QuantizedConv.  The original network comes back
+    unchanged, but DURING the call its hybridization is temporarily
+    switched off so calibration hooks see concrete values — do not run
+    concurrent forwards on `network` while quantize_net is calibrating.
     """
     if quantized_dtype != "int8":
         raise NotImplementedError("TPU path supports int8 only")
@@ -294,12 +297,27 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
         h = mk(path)
         layer.register_forward_pre_hook(h)
         hooks.append((layer, h))
+    # hooks need CONCRETE layer inputs: temporarily drop to eager for the
+    # calibration forwards (compiled replays skip python hooks; flipping
+    # _active directly preserves the user's hybridize flags and compiled
+    # caches, unlike re-calling hybridize())
+    from ..gluon.block import HybridBlock as _HB
+    hybrid_state = []
+    stack = [network]
+    while stack:
+        blk = stack.pop()
+        if isinstance(blk, _HB) and getattr(blk, "_active", False):
+            hybrid_state.append(blk)
+            blk._active = False
+        stack.extend(getattr(blk, "_children", {}).values())
     try:
         for i, batch in enumerate(calib_data):
             if num_calib_batches is not None and i >= num_calib_batches:
                 break
             network(_first_array(batch))
     finally:
+        for blk in hybrid_state:
+            blk._active = True
         for layer, h in hooks:
             layer._forward_pre_hooks.remove(h)
 
@@ -334,13 +352,11 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
         replaced += 1
     log.info("quantized %d/%d target layers", replaced, len(targets))
     if targets and replaced == 0:
-        # hooks only ever saw tracers (actively hybridized network) or the
-        # calibration iterable was empty — returning an unquantized copy
-        # as "success" would be a silent no-op
+        # returning an unquantized copy as "success" would be a silent
+        # no-op (calibration runs eagerly even on hybridized nets, so
+        # this means the iterable was empty or produced zero data)
         raise MXNetError(
             "quantize_net calibrated 0 of "
-            f"{len(targets)} target layers. If the network is hybridized, "
-            "call net.hybridize(False) for the calibration pass (compiled "
-            "replays skip forward hooks); also check calib_data is "
-            "non-empty.")
+            f"{len(targets)} target layers: calib_data was empty or "
+            "yielded all-zero batches.")
     return qnet

@@ -303,6 +303,7 @@ class _CachedGraph:
         # not evict the trace state of a call in progress
         self._inflight = {}
 
+
     def _pure(self, trainable_raws, aux_raws, input_raws, rng_key, sig_key):
         if self._rw._readers:
             # tracing rebinds the shared Parameter buffers to tracers; doing
@@ -597,6 +598,21 @@ class HybridBlock(Block):
         self._flags = {}
         self._backend = None
         self._last_input_sig = None
+
+    def __deepcopy__(self, memo):
+        """Copies drop the compiled cache: _CachedGraph holds locks and
+        jit executables that are process-local, and a copied net must
+        re-trace against its OWN (copied) parameters anyway. The
+        reference rebuilds CachedOp on copy the same way; quantize_net
+        deep-copies hybridized nets through here."""
+        import copy as _copy
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            new.__dict__[k] = {} if k == "_cached_graphs" \
+                else _copy.deepcopy(v, memo)
+        return new
 
     def hybridize(self, active=True, backend=None, backend_opts=None,
                   clear=True, static_alloc=False, static_shape=False,

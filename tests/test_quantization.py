@@ -150,3 +150,32 @@ def test_quantize_net_hybridized_runs():
     a = qnet(x).asnumpy()
     b = qnet(x).asnumpy()     # second call: compiled path
     onp.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_quantize_net_of_hybridized_net():
+    """Deep-copying a hybridized net must reset its compiled cache
+    (locks/executables are process-local); quantize_net exercises it."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.Activation("relu"),
+            nn.Dense(3))
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .rand(2, 2, 8, 8).astype("float32"))
+    net.hybridize()
+    net(x)  # builds the compiled cache (incl. the RW lock)
+    qnet = q.quantize_net(net, calib_data=[x], calib_mode="naive")
+    out_q = qnet(x)
+    assert out_q.shape == (2, 3)
+    # the original still replays through its untouched cache
+    assert net(x).shape == (2, 3)
+    # and a plain deepcopy of a hybridized net works + retraces
+    import copy
+    net2 = copy.deepcopy(net)
+    assert net2(x).shape == (2, 3)
+    assert net2._cached_graphs is not net._cached_graphs
