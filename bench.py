@@ -56,12 +56,29 @@ PEAK_BF16 = {
 }
 
 
+# int8 MXU speedup over bf16 per generation (public specs): v5e/v6e
+# double; v4/v5p run int8 at the bf16 rate
+PEAK_INT8_FACTOR = {
+    "v5 lite": 2.0, "v5e": 2.0, "v6 lite": 2.0, "v6e": 2.0,
+    "v4": 1.0, "v5p": 1.0, "v5": 1.0,
+}
+
+
 def _chip_peak(device) -> float | None:
     kind = getattr(device, "device_kind", "").lower()
     for sub, peak in PEAK_BF16.items():
         if sub in kind:
             return peak
     return None
+
+
+def _int8_factor() -> float:
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for sub, f in PEAK_INT8_FACTOR.items():
+        if sub in kind:
+            return f
+    return 1.0
 
 
 def _measure(step, args, n_state: int, target_s: float = 1.2,
@@ -240,8 +257,12 @@ def bench_inception_train(precision: str, on_cpu: bool, peak, k_steps=None,
 
 def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
                          bs=32):
+    """bf16/fp32 inference; precision='int8' routes through post-training
+    quantization (contrib.quantization) and scores against the chip's
+    int8 peak (PEAK_INT8_FACTOR — v4 has no int8 doubling)."""
     import jax
     import jax.numpy as jnp
+    import numpy as onp
 
     import mxnet_tpu as mx
     from mxnet_tpu import functional
@@ -251,12 +272,22 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     size = 224
     if on_cpu:
         bs, size, k_steps = 4, 64, 2
-    cdtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    int8 = precision == "int8"
+    cdtype = jnp.float32 if int8 else (
+        jnp.bfloat16 if precision == "bf16" else jnp.float32)
 
     net = resnet50_v1()
     net.initialize()
-    net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
-    params = _cast_tree(functional.param_arrays(net), cdtype)
+    if int8:
+        from mxnet_tpu.contrib import quantization as q
+        calib = mx.np.array(onp.random.RandomState(0)
+                            .rand(bs, 3, size, size).astype("float32"))
+        net = q.quantize_net(net, calib_data=[calib], calib_mode="naive")
+        params = functional.param_arrays(net)
+        peak = peak * _int8_factor() if peak else None
+    else:
+        net(mx.np.zeros((bs, 3, size, size), dtype="float32"))
+        params = _cast_tree(functional.param_arrays(net), cdtype)
 
     def fwd(carry, x):
         # `carry` threads a data dependency so chained calls serialize
@@ -275,61 +306,11 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     row = _row(f"resnet50_infer_bs{bs}_{precision}", sec, bs, flops,
                precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    if int8:
+        row["peak_basis"] = f"int8 ({_int8_factor():g}x bf16)"
     base = BASE_R50_INFER_FP16.get(bs)
-    if base and not on_cpu:
+    if base and not on_cpu and not int8:
         row["vs_v100_fp16_baseline"] = round(bs / sec / base, 2)
-    return row
-
-
-def bench_resnet50_infer_int8(on_cpu: bool, peak, k_steps=16, bs=32,
-                              **_ignored):
-    """Post-training-quantized ResNet-50 inference (contrib.quantization):
-    int8 MXU matmuls/convs with int32 accumulation. MFU is reported
-    against the int8 peak (2x bf16 on v5e), so the row's mfu is directly
-    comparable to the bf16 rows' as a fraction of what each dtype's MXU
-    path could do."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as onp
-
-    import mxnet_tpu as mx
-    from mxnet_tpu import functional
-    from mxnet_tpu.contrib import quantization as q
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
-    from mxnet_tpu.parallel import scan_steps
-
-    size = 224
-    if on_cpu:
-        bs, size, k_steps = 4, 64, 2
-
-    net = resnet50_v1()
-    net.initialize()
-    calib = mx.np.array(onp.random.RandomState(0)
-                        .rand(bs, 3, size, size).astype("float32"))
-    # quantize_net's own eager calibration forward triggers deferred init
-    qnet = q.quantize_net(net, calib_data=[calib], calib_mode="naive")
-    qnet.hybridize()
-    params = functional.param_arrays(qnet)
-
-    def fwd(carry, x):
-        out, _ = functional.functional_call(
-            qnet, params, x + carry.astype(x.dtype), train=False)
-        return jnp.max(out).astype(jnp.float32), jnp.sum(out,
-                                                         dtype=jnp.float32)
-
-    step = jax.jit(scan_steps(fwd, n_state=1))
-    xs = jax.random.normal(jax.random.PRNGKey(0),
-                           (k_steps, bs, 3, size, size), jnp.float32)
-    step, xla_flops = _compile(step, jax.ShapeDtypeStruct((), jnp.float32),
-                               jax.ShapeDtypeStruct(xs.shape, xs.dtype))
-    sec, _ = _measure(step, (jnp.zeros(()), xs), n_state=1)
-    sec /= k_steps
-    flops = bs * RESNET50_INFER_FLOPS_PER_IMG * (size / 224.0) ** 2
-    int8_peak = peak * 2 if peak else None  # v5e: 394 TOPS int8
-    row = _row(f"resnet50_infer_int8_bs{bs}", sec, bs, flops,
-               "int8", int8_peak, xla_flops=xla_flops)
-    row["steps_per_call"] = k_steps
-    row["peak_basis"] = "int8 (2x bf16)"
     return row
 
 
@@ -585,7 +566,7 @@ def main():
         (bench_resnet50_infer, dict(precision="bf16", bs=1)),
         (bench_resnet50_infer, dict(precision="bf16")),   # bs32
         (bench_resnet50_infer, dict(precision="bf16", bs=128)),
-        (bench_resnet50_infer_int8, dict()),
+        (bench_resnet50_infer, dict(precision="int8")),
         (bench_inception_train, dict(precision="bf16")),  # bs32
         (bench_inception_train, dict(precision="bf16", bs=64)),
         (bench_bert_train, dict(precision="bf16", bs=32)),

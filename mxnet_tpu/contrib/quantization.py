@@ -301,12 +301,11 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
     # calibration forwards (compiled replays skip python hooks; flipping
     # _active directly preserves the user's hybridize flags and compiled
     # caches, unlike re-calling hybridize())
-    from ..gluon.block import HybridBlock as _HB
     hybrid_state = []
     stack = [network]
     while stack:
         blk = stack.pop()
-        if isinstance(blk, _HB) and getattr(blk, "_active", False):
+        if isinstance(blk, HybridBlock) and getattr(blk, "_active", False):
             hybrid_state.append(blk)
             blk._active = False
         stack.extend(getattr(blk, "_children", {}).values())

@@ -1,0 +1,20 @@
+"""GPT dp x tp pretraining example smoke (ShardedTrainStep end-to-end
+through megatron specs; reference analog: distributed_training example)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_train_gpt_dp_tp():
+    script = os.path.join(os.path.dirname(__file__), "..", "example",
+                          "train_gpt.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, script, "--cpu-devices", "8", "--dp", "4",
+         "--tp", "2", "--steps", "120"],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "checkpoint save/load ok" in r.stdout
