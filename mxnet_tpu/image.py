@@ -209,6 +209,48 @@ def random_crop(src, size, interp=2):
     return fixed_crop(src, x0, y0, w, h), (x0, y0, w, h)
 
 
+def scale_down(src_size, size):
+    """Shrink a crop size to fit inside the image, keeping aspect
+    (reference image.py:214: (640,480),(720,120) -> (640,106))."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+# OpenCV border-type codes (reference copyMakeBorder forwards type=
+# straight to cv2: CONSTANT=0, REPLICATE=1, REFLECT=2, WRAP=3, 101=4)
+BORDER_CONSTANT, BORDER_REPLICATE, BORDER_REFLECT = 0, 1, 2
+BORDER_WRAP, BORDER_REFLECT_101 = 3, 4
+_BORDER_MODES = {0: "constant", 1: "edge", 2: "symmetric", 3: "wrap",
+                 4: "reflect"}
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, value=0.0):  # noqa: A002,N802
+    """Pad an HWC image's borders (reference image.py:249 over OpenCV
+    copyMakeBorder; here a jnp pad — constant/reflect/replicate/wrap/
+    reflect-101 map to numpy pad modes)."""
+    import jax.numpy as jnp
+
+    from .numpy.multiarray import _invoke
+
+    mode = _BORDER_MODES.get(type)
+    if mode is None:
+        raise MXNetError(f"unknown border type {type}")
+
+    def fn(x):
+        widths = ((top, bot), (left, right)) + ((0, 0),) * (x.ndim - 2)
+        if mode == "constant":
+            return jnp.pad(x, widths, mode="constant",
+                           constant_values=value)
+        return jnp.pad(x, widths, mode=mode)
+
+    return _invoke(fn, (src,), name="copyMakeBorder")
+
+
 def color_normalize(src, mean, std=None):
     if mean is not None:
         src = src - mean

@@ -107,3 +107,92 @@ def int64_tensor_size(active=True):
     import jax
     with jax.enable_x64(active):
         yield
+
+
+def getenv(name):
+    """Read an MXNET_* environment variable (reference util.py getenv
+    over MXGetEnv); returns None when unset. Alias of base.get_env."""
+    return get_env(name)
+
+
+def setenv(name, value):
+    """Set an MXNET_* environment variable for THIS process (reference
+    util.py setenv over MXSetEnv). Config knobs read env at use time via
+    mx.config, so changes take effect on the next read."""
+    import os
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)
+
+
+def set_np_shape(active=True):
+    """1.x toggle for numpy shape semantics (reference util.py:set_np_shape).
+    This build is numpy-semantics-only; disabling raises like MXNet 2.0
+    does once npx.set_np has been called."""
+    if not active:
+        from .base import MXNetError
+        raise MXNetError(
+            "legacy (non-numpy) shape semantics are not supported; "
+            "this framework is numpy-first (reference: deprecation in 2.0)")
+    return True
+
+
+def np_default_dtype():
+    """Default float dtype for mx.np creation funcs (reference
+    util.py np_default_dtype): float32 here (TPU-native), float64 when
+    is_np_default_dtype() — kept False permanently; use explicit
+    dtype= or util.int64_tensor_size for 64-bit work."""
+    return "float32"
+
+
+def set_np_default_dtype(is_np_default_dtype=False):  # noqa: ARG001
+    """1.x toggle for float64 creation defaults (reference
+    util.py set_np_default_dtype). This build is float32-default
+    permanently (TPU-native); requesting float64 defaults raises, the
+    matching False state is a no-op."""
+    if is_np_default_dtype:
+        from .base import MXNetError
+        raise MXNetError(
+            "float64 creation defaults are not supported on the TPU "
+            "path; pass dtype='float64' explicitly where needed "
+            "(runs under a scoped x64 mode)")
+    return False
+
+
+def np_ufunc_legal_option(key, value):
+    """Whether a ufunc kwarg is supported (reference util.py:550 — the
+    dispatch protocol uses it to reject unsupported options)."""
+    import numpy as _onp
+    if key == "where":
+        return True
+    if key == "casting":
+        return value in ("no", "equiv", "safe", "same_kind", "unsafe")
+    if key == "order":
+        return isinstance(value, str)
+    if key == "dtype":
+        return value in (_onp.int8, _onp.uint8, _onp.int32, _onp.int64,
+                         _onp.float16, _onp.float32, _onp.float64,
+                         "int8", "uint8", "int32", "int64",
+                         "float16", "float32", "float64")
+    if key == "subok":
+        return isinstance(value, bool)
+    return False
+
+
+def set_module(module):
+    """Decorator overriding __module__ for doc rendering (reference
+    util.py set_module)."""
+    def decorator(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+    return decorator
+
+
+def set_flush_denorms(value=True):  # noqa: ARG001 — parity signature
+    """Reference util.py set_flush_denorms sets CPU FTZ via SSE; XLA/TPU
+    flushes denormals by hardware design, so this is a documented no-op
+    returning False (the reference also returns False on unsupported
+    hardware)."""
+    return False

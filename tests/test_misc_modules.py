@@ -252,3 +252,58 @@ def test_symbolblock_rejects_non_symbol_outputs():
     from mxnet_tpu import gluon
     with pytest.raises(MXNetError, match="must be a Symbol"):
         gluon.SymbolBlock(object(), None, params={})
+
+
+def test_image_border_and_scale_down():
+    """copyMakeBorder / scale_down (reference image.py:214,249)."""
+    import numpy as onp
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    img = mx.np.array(onp.arange(12, dtype="float32").reshape(2, 2, 3))
+    out = mx.image.copyMakeBorder(img, 1, 0, 0, 1, value=9.0)
+    assert out.shape == (3, 3, 3)
+    assert float(out[0, 0, 0]) == 9.0      # constant fill
+    assert float(out[1, 0, 0]) == 0.0      # original top-left
+    # OpenCV codes: 1 = REPLICATE (edge), 2 = REFLECT (mirror)
+    repl = mx.image.copyMakeBorder(img, 1, 1, 1, 1, type=1).asnumpy()
+    assert repl.shape == (4, 4, 3)
+    assert (repl[0, 1] == img.asnumpy()[0, 0]).all()  # edge-replicated
+    refl = mx.image.copyMakeBorder(img, 1, 1, 1, 1, type=2).asnumpy()
+    assert (refl[0, 1] == img.asnumpy()[0, 0]).all()  # mirror of row 0
+    with pytest.raises(MXNetError):
+        mx.image.copyMakeBorder(img, 1, 1, 1, 1, type=9)
+    assert mx.image.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert mx.image.scale_down((100, 100), (50, 50)) == (50, 50)
+
+
+def test_util_env_and_compat_tail():
+    """getenv/setenv/set_np_shape/np_default_dtype/set_module/
+    set_flush_denorms (reference util.py)."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    mx.util.setenv("MXNET_UTIL_TEST", "7")
+    assert mx.util.getenv("MXNET_UTIL_TEST") == "7"
+    mx.util.setenv("MXNET_UTIL_TEST", None)
+    assert mx.util.getenv("MXNET_UTIL_TEST") is None
+    assert mx.util.set_np_shape(True)
+    with pytest.raises(MXNetError):
+        mx.util.set_np_shape(False)
+    assert mx.util.np_default_dtype() == "float32"
+    assert mx.util.set_np_default_dtype(False) is False
+    with pytest.raises(MXNetError):
+        mx.util.set_np_default_dtype(True)
+    assert mx.util.set_flush_denorms() is False
+    assert mx.util.np_ufunc_legal_option("casting", "same_kind")
+    assert not mx.util.np_ufunc_legal_option("dtype", "not-a-dtype")
+    assert mx.util.np_ufunc_legal_option("dtype", "float32")
+
+    @mx.util.set_module("mxnet_tpu.numpy")
+    def f():
+        pass
+    assert f.__module__ == "mxnet_tpu.numpy"
+    assert not mx.util.np_ufunc_legal_option("nonsense", 1)
+    assert mx.util.np_ufunc_legal_option("casting", "unsafe")
