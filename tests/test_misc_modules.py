@@ -2,6 +2,7 @@
 checkpoints, operator (CustomOp), name/attribute scopes, error types,
 dlpack, libinfo, rtc (reference: the same-named python/mxnet modules)."""
 import logging
+import os
 
 import numpy as onp
 import pytest
@@ -307,3 +308,78 @@ def test_util_env_and_compat_tail():
     assert f.__module__ == "mxnet_tpu.numpy"
     assert not mx.util.np_ufunc_legal_option("nonsense", 1)
     assert mx.util.np_ufunc_legal_option("casting", "unsafe")
+
+
+def test_tools_rec2idx_and_parse_log(tmp_path):
+    """rec2idx rebuilds a seekable .idx; parse_log tables epoch metrics
+    (reference tools/rec2idx.py, tools/parse_log.py)."""
+    import subprocess
+    import sys
+
+    from mxnet_tpu.recordio import MXIndexedRecordIO, MXRecordIO
+
+    rec = str(tmp_path / "d.rec")
+    w = MXRecordIO(rec, "w")
+    payloads = [f"payload-{i}".encode() * (i + 1) for i in range(5)]
+    for pb in payloads:
+        w.write(pb)
+    w.close()
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "rec2idx.py")
+    idx = str(tmp_path / "d.idx")
+    r = subprocess.run([sys.executable, tool, rec, idx],
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "5 index entries" in r.stdout
+    reader = MXIndexedRecordIO(idx, rec, "r")
+    assert reader.read_idx(3) == payloads[3]
+    assert reader.read_idx(0) == payloads[0]
+    reader.close()
+
+    log = tmp_path / "t.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.5\n"
+        "INFO Epoch[0] Validation-accuracy=0.4\n"
+        "INFO Epoch[0] Time cost=12.5\n"
+        "INFO Epoch[1] Train-accuracy=0.8\n"
+        "INFO Epoch[1] Time cost=11.0\n")
+    ptool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "parse_log.py")
+    r2 = subprocess.run([sys.executable, ptool, str(log)],
+                        capture_output=True, text=True)
+    assert r2.returncode == 0
+    assert "| 0 | 0.5 | 0.4 | 12.5 |" in r2.stdout
+    assert "| 1 | 0.8 |" in r2.stdout
+
+
+def test_tools_diagnose():
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "diagnose.py")
+    r = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True, timeout=300,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0
+    assert "MXNet-TPU Info" in r.stdout and "Features" in r.stdout
+
+
+def test_parse_log_prefix_metric_isolation(tmp_path):
+    """accuracy vs accuracy_top5 must not contaminate each other and
+    extra key=value text on the line is ignored."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from parse_log import parse
+
+    lines = [
+        "Epoch[0] Train-accuracy=0.5 lr=0.01\n",
+        "Epoch[0] Train-accuracy_top5=0.9\n",
+        "Epoch[0] Time cost=3.5\n",
+    ]
+    cols, rows = parse(lines, ["accuracy", "accuracy_top5"])
+    row = dict(zip(["epoch"] + cols, rows[0]))
+    assert row["train-accuracy"] == 0.5      # not 0.01, not 0.9
+    assert row["train-accuracy_top5"] == 0.9
+    assert row["time"] == 3.5

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Environment diagnosis for bug reports.
+
+Reference parity: tools/diagnose.py (prints platform/python/pip
+versions, MXNet build features, and network reachability for issue
+templates). The network checks are dropped (this environment is
+zero-egress by design); device and feature discovery are the useful
+part on TPU.
+
+Usage: python tools/diagnose.py
+"""
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("----------Platform Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("machine      :", platform.machine())
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "JAX_", "XLA_", "TPU_")):
+            print(f"{k}={v}")
+    print("----------MXNet-TPU Info----------")
+    try:
+        import mxnet_tpu as mx
+        print("Version      :", getattr(mx, "__version__", "dev"))
+        print("Directory    :", os.path.dirname(mx.__file__))
+        feats = mx.runtime.feature_list()
+        on = [f.name for f in feats if f.enabled]
+        print("Features     :", ", ".join(on))
+    except Exception as e:  # diagnosis must not crash on a broken install
+        print("import failed:", repr(e))
+        return
+    print("----------Device Info----------")
+    try:
+        import jax
+        for d in jax.devices():
+            print(f"{d.id}: platform={d.platform} "
+                  f"kind={getattr(d, 'device_kind', '?')}")
+        print("default backend:", jax.default_backend())
+        print("jax           :", jax.__version__)
+    except Exception as e:
+        print("device probe failed:", repr(e))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch metric table.
+
+Reference parity: tools/parse_log.py (regex over the standard
+``Epoch[N] Train-accuracy=...`` / ``Validation-accuracy=...`` /
+``Epoch[N] Time cost=...`` lines the fit loops and Speedometer callback
+emit; markdown table out).
+
+Usage: python tools/parse_log.py train.log [--metric-names accuracy ...]
+       [--format markdown|none]
+"""
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    # metric names are escaped and anchored to their own '=' so
+    # prefix-named metrics (accuracy vs accuracy_top5) don't contaminate
+    # each other and extra 'key=value' text on the line is ignored
+    pats = (
+        [(f"train-{m}", re.compile(
+            r".*Epoch\[(\d+)\] Train-" + re.escape(m) + r"=([.\d]+)"))
+         for m in metric_names]
+        + [(f"val-{m}", re.compile(
+            r".*Epoch\[(\d+)\] Validation-" + re.escape(m) + r"=([.\d]+)"))
+           for m in metric_names]
+        + [("time", re.compile(r".*Epoch\[(\d+)\] Time[ a-z]*=([.\d]+)"))]
+    )
+    data = {}
+    for line in lines:
+        for name, pat in pats:
+            m = pat.match(line)
+            if m is None:
+                continue
+            epoch, val = int(m.group(1)), float(m.group(2))
+            tot, cnt = data.setdefault(epoch, {}).get(name, (0.0, 0))
+            data[epoch][name] = (tot + val, cnt + 1)
+            break
+    cols = [n for n, _ in pats]
+    rows = []
+    for epoch in sorted(data):
+        row = [epoch]
+        for c in cols:
+            tot, cnt = data[epoch].get(c, (0.0, 0))
+            row.append(tot / cnt if cnt else float("nan"))
+        rows.append(row)
+    return cols, rows
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--metric-names", type=str, nargs="+",
+                   default=["accuracy"])
+    p.add_argument("--format", choices=["markdown", "none"],
+                   default="markdown")
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        cols, rows = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("| --- " * (len(cols) + 1) + "|")
+        for row in rows:
+            print("| " + " | ".join(
+                str(v) if i == 0 else f"{v:.6g}"
+                for i, v in enumerate(row)) + " |")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

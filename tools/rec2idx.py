@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Regenerate the .idx file for an existing RecordIO pack.
+
+Reference parity: tools/rec2idx.py (walks the .rec sequentially, writing
+``key\\toffset`` lines so MXIndexedRecordIO can seek). Keys are the
+record ordinal, matching im2rec.py's packing order.
+
+Usage: python tools/rec2idx.py data.rec data.idx
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.recordio import MXRecordIO  # noqa: E402
+
+
+def build_index(rec_path, idx_path):
+    reader = MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as fidx:
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            fidx.write(f"{n}\t{pos}\n")
+            n += 1
+    reader.close()
+    return n
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("record", help="path to the .rec file")
+    p.add_argument("index", help="path of the .idx file to write")
+    args = p.parse_args()
+    n = build_index(args.record, args.index)
+    print(f"wrote {n} index entries to {args.index}")
+
+
+if __name__ == "__main__":
+    main()
