@@ -5,9 +5,11 @@ event handlers; CheckpointHandler at event_handler.py:336, EarlyStopping
 :614, ValidationHandler :160) — the reference's only automatic periodic
 checkpointing lives here (SURVEY §5 checkpoint/resume).
 """
+from .batch_processor import BatchProcessor  # noqa: F401
 from .estimator import Estimator  # noqa: F401
 from .event_handler import (  # noqa: F401
-    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
-    StoppingHandler, MetricHandler, ValidationHandler, LoggingHandler,
-    CheckpointHandler, EarlyStoppingHandler,
+    EventHandler, TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+    BatchEnd, StoppingHandler, MetricHandler, ValidationHandler,
+    LoggingHandler, CheckpointHandler, EarlyStoppingHandler,
+    GradientUpdateHandler,
 )

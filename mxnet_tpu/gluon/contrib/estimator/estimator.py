@@ -1,44 +1,66 @@
-"""Estimator fit loop (reference: gluon/contrib/estimator/estimator.py)."""
+"""Estimator fit loop (reference: gluon/contrib/estimator/estimator.py).
+
+Architecture mirrors the reference: the minibatch step lives in a
+pluggable BatchProcessor (batch_processor.py), the optimizer step in
+GradientUpdateHandler at batch_end, and handlers run in ascending
+``priority`` order per event (sorted once per fit, not per dispatch).
+"""
 from __future__ import annotations
 
-from .... import autograd
 from ...metric import Accuracy, Loss as LossMetric
 from ...trainer import Trainer
+from .batch_processor import BatchProcessor
 from .event_handler import (
-    BatchBegin, BatchEnd, EpochBegin, EpochEnd, MetricHandler,
-    StoppingHandler, TrainBegin, TrainEnd,
+    GradientUpdateHandler, MetricHandler, StoppingHandler,
 )
+
+_EVENTS = ("train_begin", "train_end", "epoch_begin", "epoch_end",
+           "batch_begin", "batch_end")
 
 
 class Estimator:
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 trainer=None, context=None, device=None):
+                 trainer=None, context=None, device=None,
+                 batch_processor=None, val_net=None, val_loss=None):
         self.net = net
         self.loss = loss
+        self.val_net = val_net or net
+        self.val_loss = val_loss or loss
         self.train_metrics = train_metrics or [Accuracy()]
         if not isinstance(self.train_metrics, list):
             self.train_metrics = [self.train_metrics]
         self.train_metrics.append(LossMetric("train_loss"))
+        self.val_metrics = val_metrics
+        if self.val_metrics is not None and \
+                not isinstance(self.val_metrics, list):
+            self.val_metrics = [self.val_metrics]
         self.trainer = trainer or Trainer(
             net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.batch_processor = batch_processor or BatchProcessor()
 
     def _handlers(self, event_handlers, epochs, batches):
         handlers = list(event_handlers or [])
         stop = StoppingHandler(epochs, batches)
         handlers.append(stop)
         handlers.append(MetricHandler(self.train_metrics))
-        return handlers, stop
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
+        # per-event dispatch lists, priority-sorted once (the handler set
+        # is fixed for the whole fit)
+        by_event = {
+            ev: sorted((h for h in handlers if getattr(h, ev, None)),
+                       key=lambda h: getattr(h, "priority", 0))
+            for ev in _EVENTS}
+        return by_event, stop
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, batch_axis=0):
         epochs = epochs or (None if batches else 1)
-        handlers, stop = self._handlers(event_handlers, epochs, batches)
+        by_event, stop = self._handlers(event_handlers, epochs, batches)
 
         def _dispatch(kind, *args, **kwargs):
-            for h in handlers:
-                fn = getattr(h, kind, None)
-                if fn is not None:
-                    fn(self, *args, **kwargs)
+            for h in by_event[kind]:
+                getattr(h, kind)(self, *args, **kwargs)
 
         _dispatch("train_begin")
         while not stop.stop_training:
@@ -46,15 +68,11 @@ class Estimator:
             for batch in train_data:
                 if stop.stop_training:
                     break
-                data, label = batch[0], batch[1]
                 _dispatch("batch_begin")
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(data.shape[batch_axis])
-                _dispatch("batch_end", pred=[pred], label=[label],
-                          loss=[loss])
+                _, label, pred, loss = self.batch_processor.fit_batch(
+                    self, batch, batch_axis)
+                _dispatch("batch_end", pred=pred, label=label, loss=loss,
+                          num_samples=batch[0].shape[batch_axis])
             _dispatch("epoch_end")
             if epochs is None and batches is None:
                 break
@@ -62,13 +80,15 @@ class Estimator:
         return self
 
     def evaluate(self, val_data, val_metrics=None, batch_axis=0):
-        metrics = val_metrics or self.train_metrics
+        metrics = val_metrics or self.val_metrics or self.train_metrics
         for m in metrics:
             m.reset()
         for batch in val_data:
-            data, label = batch[0], batch[1]
-            pred = self.net(data)
+            _, label, pred, loss = self.batch_processor.evaluate_batch(
+                self, batch, batch_axis)
             for m in metrics:
-                if not isinstance(m, LossMetric):
-                    m.update([label], [pred])
+                if isinstance(m, LossMetric):
+                    m.update(None, loss)
+                else:
+                    m.update(label, pred)
         return metrics

@@ -9,32 +9,39 @@ import time
 import numpy as onp
 
 
-class TrainBegin:
+class EventHandler:
+    """Common base (reference event_handler.py EventHandler); handlers
+    may set ``priority`` — lower runs earlier within an event."""
+
+    priority = 0
+
+
+class TrainBegin(EventHandler):
     def train_begin(self, estimator, *args, **kwargs):
         pass
 
 
-class TrainEnd:
+class TrainEnd(EventHandler):
     def train_end(self, estimator, *args, **kwargs):
         pass
 
 
-class EpochBegin:
+class EpochBegin(EventHandler):
     def epoch_begin(self, estimator, *args, **kwargs):
         pass
 
 
-class EpochEnd:
+class EpochEnd(EventHandler):
     def epoch_end(self, estimator, *args, **kwargs):
         pass
 
 
-class BatchBegin:
+class BatchBegin(EventHandler):
     def batch_begin(self, estimator, *args, **kwargs):
         pass
 
 
-class BatchEnd:
+class BatchEnd(EventHandler):
     def batch_end(self, estimator, *args, **kwargs):
         pass
 
@@ -220,3 +227,25 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd):
             self.wait += 1
             if self.wait >= self.patience:
                 self.stop_training = True
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step at batch end (reference
+    event_handler.py:722; priority -2000 so it runs before metric and
+    logging handlers that read the post-step state)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        # the data batch size, passed by the fit loop, is the correct
+        # gradient normalizer (Trainer.step sets rescale_grad = 1/n);
+        # loss shapes mislead for mean-reduced losses or batch_axis != 0
+        batch_size = kwargs.get("num_samples")
+        if not batch_size:
+            loss = kwargs.get("loss", [])
+            if not isinstance(loss, (list, tuple)):
+                loss = [loss]
+            batch_size = sum(
+                (l.shape[0] if getattr(l, "ndim", 0) else 1) for l in loss)
+        estimator.trainer.step(max(batch_size, 1))
