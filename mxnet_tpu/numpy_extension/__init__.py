@@ -1216,3 +1216,89 @@ def nonzero(data):
 
 
 from . import image  # noqa: E402,F401  (npx.image.* operator namespace)
+
+
+def savez(file, *args, **kwargs):
+    """Save arrays to .npz (reference numpy_extension/utils.py savez over
+    cnpy): positional arrays become arr_0.. keys, keyword arrays keep
+    their names."""
+    merged = {f"arr_{i}": a for i, a in enumerate(args)}
+    clash = sorted(set(merged) & set(kwargs))
+    if clash:  # numpy.savez raises for exactly this
+        raise MXNetError(
+            f"cannot use un-named arrays with keyword(s) {clash}; "
+            "rename the keyword or name every array")
+    merged.update(kwargs)
+    save(file, merged)
+
+
+def seed(seed, ctx="all"):  # noqa: A002,ARG001 — parity signature
+    """Seed the global RNG stream (reference numpy_extension/random.py:27
+    — per-ctx seeding collapses to one splittable key stream here)."""
+    from .. import random as _random
+    _random.seed(seed)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
+              out=None):
+    """Binary samples from probs or logits, exactly one given
+    (reference numpy_extension/random.py:77)."""
+    from .. import random as _r
+    from ..numpy.multiarray import _invoke, _writeback
+
+    if (prob is None) == (logit is None):
+        raise MXNetError("pass exactly one of prob or logit")
+    key = _r._next_key()
+
+    def fn(p_or_l):
+        p = jax.nn.sigmoid(p_or_l) if prob is None else p_or_l
+        shape = jnp.shape(p) if size is None else size
+        s = jax.random.bernoulli(key, p, shape)
+        return s.astype(dtype or "float32")
+
+    res = _invoke(fn, (prob if logit is None else logit,),
+                  name="bernoulli")
+    return _writeback(out, res)
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, ctx=None):
+    """Uniform samples of shape batch_shape + broadcast(low, high).shape
+    (reference numpy_extension/random.py:130 — the sample_n convention:
+    batch dims PREPEND the param batch)."""
+    from .. import random as _r
+    from ..numpy.multiarray import _invoke
+
+    from ..numpy.random import _shape
+    key = _r._next_key()
+    bshape = _shape(batch_shape)
+
+    def fn(lo, hi):
+        pshape = jnp.broadcast_shapes(jnp.shape(lo), jnp.shape(hi))
+        u = jax.random.uniform(key, bshape + pshape,
+                               jnp.dtype(dtype or "float32"))
+        return lo + u * (hi - lo)
+
+    return _invoke(fn, (low, high), name="uniform_n")
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, ctx=None):
+    """Normal samples of shape batch_shape + broadcast(loc, scale).shape
+    (reference numpy_extension/random.py:187)."""
+    from .. import random as _r
+    from ..numpy.multiarray import _invoke
+
+    from ..numpy.random import _shape
+    key = _r._next_key()
+    bshape = _shape(batch_shape)
+
+    def fn(mu, sigma):
+        pshape = jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(sigma))
+        z = jax.random.normal(key, bshape + pshape,
+                              jnp.dtype(dtype or "float32"))
+        return mu + sigma * z
+
+    return _invoke(fn, (loc, scale), name="normal_n")
+
+
+from . import random  # noqa: E402,F401 — npx.random submodule (must
+# import after the sampler defs above; reference exposes both spellings)

@@ -1,0 +1,16 @@
+"""npx.random — extension random samplers.
+
+Reference parity: python/mxnet/numpy_extension/random.py
+(__all__ = seed/bernoulli/normal_n/uniform_n). The implementations live
+at npx top level; this module is the documented submodule spelling.
+Other sampler names fall through to mx.np.random (the reference routes
+them the same way).
+"""
+from . import bernoulli, normal_n, seed, uniform_n  # noqa: F401
+
+__all__ = ["seed", "bernoulli", "normal_n", "uniform_n"]
+
+
+def __getattr__(name):
+    from ..numpy import random as _np_random
+    return getattr(_np_random, name)

@@ -138,3 +138,63 @@ def test_ndarray_any_all_methods():
     a = np.array([[True, False]])
     assert bool(a.any().asnumpy())
     assert not bool(a.all().asnumpy())
+
+
+def test_npx_random_tail():
+    """bernoulli/uniform_n/normal_n/seed/savez (reference
+    numpy_extension/random.py:27-252, utils.py savez)."""
+    import os
+    import tempfile
+
+    import numpy as onp
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import numpy_extension as npx
+    from mxnet_tpu.base import MXNetError
+
+    npx.seed(7)
+    b = npx.bernoulli(prob=mx.np.array([0.0, 1.0]))
+    onp.testing.assert_array_equal(b.asnumpy(), [0.0, 1.0])
+    lb = npx.bernoulli(logit=mx.np.array([-100.0, 100.0]))
+    onp.testing.assert_array_equal(lb.asnumpy(), [0.0, 1.0])
+    with pytest.raises(MXNetError):
+        npx.bernoulli(prob=0.5, logit=0.0)
+    with pytest.raises(MXNetError):
+        npx.bernoulli()
+    # statistics + sample_n shape conventions
+    npx.seed(0)
+    u = npx.uniform_n(2.0, 4.0, batch_shape=(5000,))
+    assert u.shape == (5000,)
+    assert 2.9 < float(u.asnumpy().mean()) < 3.1
+    assert float(u.asnumpy().min()) >= 2.0
+    n = npx.normal_n(mx.np.array([0.0, 10.0]), 0.1, batch_shape=(2000,))
+    assert n.shape == (2000, 2)
+    m = n.asnumpy().mean(0)
+    assert abs(m[0]) < 0.02 and abs(m[1] - 10.0) < 0.02
+    # seeding reproduces
+    npx.seed(3)
+    a1 = npx.normal_n(batch_shape=4).asnumpy()
+    npx.seed(3)
+    a2 = npx.normal_n(batch_shape=4).asnumpy()
+    onp.testing.assert_array_equal(a1, a2)
+    f = os.path.join(tempfile.mkdtemp(), "t.npz")
+    npx.savez(f, mx.np.ones(3), named=mx.np.zeros(2))
+    d = npx.load(f)
+    assert sorted(d) == ["arr_0", "named"]
+    onp.testing.assert_array_equal(d["named"].asnumpy(), [0.0, 0.0])
+
+
+def test_npx_random_submodule_and_savez_clash():
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import numpy_extension as npx
+    from mxnet_tpu.base import MXNetError
+
+    assert npx.random.bernoulli is npx.bernoulli
+    assert npx.random.uniform_n is npx.uniform_n
+    npx.random.seed(2)
+    assert npx.random.uniform(0, 1, size=(3,)).shape == (3,)  # fallthrough
+    with pytest.raises(MXNetError, match="arr_0"):
+        npx.savez("/tmp/clash.npz", mx.np.ones(2), arr_0=mx.np.zeros(2))
