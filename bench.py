@@ -529,9 +529,20 @@ def _probe_backend(timeout_s=240):
     """The axon TPU tunnel can wedge so hard that jax.devices() never
     returns (observed: multi-hour outage, round 4). Probe it in a
     subprocess first; on failure pin this process to CPU BEFORE backend
-    init so the bench always produces a result."""
+    init so the bench always produces a result.
+
+    JAX_PLATFORMS=cpu in the environment skips the probe entirely: the
+    axon plugin pins the platform env in-kernel, so honoring the
+    caller's intent needs the config route (ci/run.sh contracts runs the
+    CPU smoke this way; without this check it silently benched the real
+    chip for ~50 minutes)."""
+    import os
     import subprocess
     import sys
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu (forced by JAX_PLATFORMS)"
     try:
         r = subprocess.run(
             [sys.executable, "-c",

@@ -100,6 +100,9 @@ def multinomial(n, pvals, size=None):
 
 
 def bernoulli(prob=None, logit=None, size=None, dtype=None):
+    if (prob is None) == (logit is None):
+        from ..base import MXNetError
+        raise MXNetError("pass exactly one of prob or logit")
     if prob is not None:
         p = prob._data if isinstance(prob, ndarray) else prob
     else:

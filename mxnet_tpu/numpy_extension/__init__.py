@@ -1242,7 +1242,9 @@ def seed(seed, ctx="all"):  # noqa: A002,ARG001 — parity signature
 def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
               out=None):
     """Binary samples from probs or logits, exactly one given
-    (reference numpy_extension/random.py:77)."""
+    (reference numpy_extension/random.py:77). Hardened front door over
+    mx.np.random.bernoulli: validates the prob/logit exclusivity and
+    dispatches through _invoke (async + autograd-recorded)."""
     from .. import random as _r
     from ..numpy.multiarray import _invoke, _writeback
 
@@ -1261,43 +1263,43 @@ def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
     return _writeback(out, res)
 
 
-def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, ctx=None):
-    """Uniform samples of shape batch_shape + broadcast(low, high).shape
-    (reference numpy_extension/random.py:130 — the sample_n convention:
-    batch dims PREPEND the param batch)."""
+def _sample_n(name, draw, a, b, batch_shape, dtype):
+    """Shared sample_n scaffold: output shape is batch_shape PREPENDED to
+    broadcast(a, b).shape (reference numpy_extension/random.py:130,187);
+    64-bit dtypes run under the scoped x64 mode like every other op."""
     from .. import random as _r
-    from ..numpy.multiarray import _invoke
-
+    from ..numpy.multiarray import _invoke, _wants_x64
     from ..numpy.random import _shape
+
     key = _r._next_key()
     bshape = _shape(batch_shape)
+    dt = dtype or "float32"
 
-    def fn(lo, hi):
-        pshape = jnp.broadcast_shapes(jnp.shape(lo), jnp.shape(hi))
-        u = jax.random.uniform(key, bshape + pshape,
-                               jnp.dtype(dtype or "float32"))
-        return lo + u * (hi - lo)
+    def fn(a_, b_):
+        pshape = jnp.broadcast_shapes(jnp.shape(a_), jnp.shape(b_))
+        return draw(key, bshape + pshape, jnp.dtype(dt), a_, b_)
 
-    return _invoke(fn, (low, high), name="uniform_n")
+    return _invoke(fn, (a, b), name=name, x64=_wants_x64(dt))
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, ctx=None):
+    """Uniform samples of shape batch_shape + broadcast(low, high).shape
+    (reference numpy_extension/random.py:130)."""
+    return _sample_n(
+        "uniform_n",
+        lambda key, shape, dt, lo, hi:
+            lo + jax.random.uniform(key, shape, dt) * (hi - lo),
+        low, high, batch_shape, dtype)
 
 
 def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, ctx=None):
     """Normal samples of shape batch_shape + broadcast(loc, scale).shape
     (reference numpy_extension/random.py:187)."""
-    from .. import random as _r
-    from ..numpy.multiarray import _invoke
-
-    from ..numpy.random import _shape
-    key = _r._next_key()
-    bshape = _shape(batch_shape)
-
-    def fn(mu, sigma):
-        pshape = jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(sigma))
-        z = jax.random.normal(key, bshape + pshape,
-                              jnp.dtype(dtype or "float32"))
-        return mu + sigma * z
-
-    return _invoke(fn, (loc, scale), name="normal_n")
+    return _sample_n(
+        "normal_n",
+        lambda key, shape, dt, mu, sigma:
+            mu + sigma * jax.random.normal(key, shape, dt),
+        loc, scale, batch_shape, dtype)
 
 
 from . import random  # noqa: E402,F401 — npx.random submodule (must
