@@ -176,3 +176,137 @@ def set_default_context(ctx):
 def list_gpus():
     from .context import num_gpus
     return list(range(num_gpus()))
+
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    """numpy-style allclose assert (reference test_utils.py
+    assert_allclose, a thin alias the op suites use)."""
+    onp.testing.assert_allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol,
+                                equal_nan=equal_nan)
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """Assert calling f raises exception_type (reference
+    test_utils.py assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(
+        f"{f} did not raise {exception_type.__name__}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (onp.random.randint(1, dim0 + 1),
+            onp.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (onp.random.randint(1, dim0 + 1),
+            onp.random.randint(1, dim1 + 1),
+            onp.random.randint(1, dim2 + 1))
+
+
+def rand_coord_2d(x_low, x_high, y_low, y_high):
+    x = onp.random.randint(x_low, x_high)
+    y = onp.random.randint(y_low, y_high)
+    return x, y
+
+
+def random_arrays(*shapes):
+    """Random float32 host arrays; a single shape returns one array.
+    A shape may be a tuple/list, an int (1-D length), or () for a
+    0-d scalar (reference test_utils.py random_arrays)."""
+    def one(s):
+        if isinstance(s, int):
+            s = (s,)
+        elif not isinstance(s, (list, tuple)):
+            raise MXNetError(f"shape must be int or tuple, got {s!r}")
+        if len(s) == 0:
+            return onp.asarray(onp.random.randn(), "float32")
+        return onp.random.randn(*s).astype("float32")
+
+    arrays = [one(s) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def random_sample(population, k):
+    """Sample WITHOUT replacement, order preserved by draw (reference
+    test_utils.py random_sample)."""
+    import random as _random_mod
+    return _random_mod.sample(list(population), k)
+
+
+def same_array(a, b):
+    """True when two mx arrays alias one device buffer (reference
+    test_utils.py same_array — it mutates to prove aliasing; device
+    buffers are immutable here, so compare the underlying buffer
+    identity instead)."""
+    ra = a._data if isinstance(a, ndarray) else a
+    rb = b._data if isinstance(b, ndarray) else b
+    return ra is rb
+
+
+def check_speed(f, *args, n=20, warmup=3, **kwargs):
+    """Average seconds per call (reference test_utils.py check_speed);
+    syncs via engine.wait_all so async dispatch doesn't flatter."""
+    import time
+
+    from . import engine
+    for _ in range(warmup):
+        f(*args, **kwargs)
+    engine.wait_all()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f(*args, **kwargs)
+    engine.wait_all()
+    return (time.perf_counter() - t0) / n
+
+
+def gen_buckets_probs_with_ppf(ppf, num_buckets):
+    """Equal-probability buckets from a percent-point function
+    (reference test_utils.py gen_buckets_probs_with_ppf)."""
+    probs = [1.0 / num_buckets] * num_buckets
+    edges = [ppf(i / num_buckets) for i in range(num_buckets + 1)]
+    buckets = [(edges[i], edges[i + 1]) for i in range(num_buckets)]
+    return buckets, probs
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Chi-square goodness-of-fit for an i.i.d. sampler (reference
+    test_utils.py:2108). Returns (p_value, obs_freq, expected_freq).
+    The survival function is gammaincc(df/2, chi2/2) (no scipy in this
+    image; jax.scipy.special supplies the regularized gamma)."""
+    from jax.scipy.special import gammaincc
+
+    samples = onp.asarray(_to_np(generator(nsamples))).ravel()
+    continuous = isinstance(buckets[0], (tuple, list))
+    obs = onp.zeros(len(buckets))
+    if continuous:
+        # per-bucket low/high membership so samples in a gap between
+        # non-contiguous buckets are excluded, not mis-tallied
+        for i, (lo, hi) in enumerate(buckets):
+            obs[i] = ((samples >= lo) & (samples < hi)).sum()
+    else:
+        for i, v in enumerate(buckets):
+            obs[i] = (samples == v).sum()
+    exp = onp.asarray(probs, "float64") * samples.size
+    chi2 = float(((obs - exp) ** 2 / exp).sum())
+    df = len(buckets) - 1
+    p = float(gammaincc(df / 2.0, chi2 / 2.0))
+    return p, obs, exp
+
+
+def verify_generator(generator, buckets, probs, nsamples=1000000,
+                     nrepeat=5, success_rate=0.25, alpha=0.05):
+    """Repeat the chi-square test; pass when >= success_rate of the
+    repeats clear alpha (reference test_utils.py verify_generator —
+    RNG tests are statistical, single runs flake)."""
+    ps = [chi_square_check(generator, buckets, probs, nsamples)[0]
+          for _ in range(nrepeat)]
+    successes = sum(p > alpha for p in ps)
+    if successes / nrepeat < success_rate:
+        raise AssertionError(
+            f"generator failed the chi-square test: p values {ps} "
+            f"(needed {success_rate:.0%} above alpha={alpha})")
+    return ps

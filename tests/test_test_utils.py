@@ -1,0 +1,66 @@
+"""mx.test_utils helper tail (reference test_utils.py: chi_square_check
+:2108, verify_generator, check_speed, random helpers)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def test_chi_square_discrete():
+    p, obs, exp = tu.chi_square_check(
+        lambda n: onp.random.RandomState(0).randint(0, 4, n),
+        buckets=[0, 1, 2, 3], probs=[0.25] * 4, nsamples=100000)
+    assert p > 0.05
+    assert obs.sum() == 100000 and exp.sum() == pytest.approx(100000)
+    pbad, _, _ = tu.chi_square_check(
+        lambda n: onp.random.RandomState(0).randint(0, 3, n),
+        buckets=[0, 1, 2, 3], probs=[0.25] * 4, nsamples=100000)
+    assert pbad < 1e-6
+
+
+def test_verify_generator_continuous():
+    mx.random.seed(0)
+    buckets, probs = tu.gen_buckets_probs_with_ppf(lambda q: q, 5)
+    assert probs == [0.2] * 5 and buckets[0] == (0.0, 0.2)
+    tu.verify_generator(lambda n: mx.np.random.uniform(0, 1, size=(n,)),
+                        buckets, probs, nsamples=50000, nrepeat=3)
+    with pytest.raises(AssertionError, match="chi-square"):
+        tu.verify_generator(
+            lambda n: mx.np.random.uniform(0, 0.5, size=(n,)),
+            buckets, probs, nsamples=20000, nrepeat=2)
+
+
+def test_small_helpers():
+    assert tu.check_speed(lambda: mx.np.ones((8, 8)).sum(), n=3) > 0
+    a = mx.np.ones(3)
+    assert tu.same_array(a, a) and not tu.same_array(a, mx.np.ones(3))
+    s2 = tu.rand_shape_2d(5, 5)
+    assert len(s2) == 2 and all(1 <= d <= 5 for d in s2)
+    assert len(tu.rand_shape_3d()) == 3
+    x, y = tu.rand_coord_2d(0, 10, 20, 30)
+    assert 0 <= x < 10 and 20 <= y < 30
+    arrs = tu.random_arrays((2, 3), (4,))
+    assert arrs[0].shape == (2, 3) and arrs[1].shape == (4,)
+    assert tu.random_arrays((2, 2)).shape == (2, 2)
+    assert sorted(tu.random_sample(range(10), 10)) == list(range(10))
+    tu.assert_allclose([1.0, 2.0], [1.0, 2.0])
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    with pytest.raises(AssertionError):
+        tu.assert_exception(lambda: None, ValueError)
+
+
+def test_chi_square_gap_buckets_and_int_shapes():
+    from mxnet_tpu.base import MXNetError
+    # gap samples (1 <= x < 2) must be excluded, not mis-tallied
+    # probs are each bucket's TRUE probability mass: 1/3 each for
+    # uniform(0,3); the gap third of the samples must be dropped
+    p, obs, exp = tu.chi_square_check(
+        lambda n: onp.random.RandomState(0).uniform(0, 3, n),
+        buckets=[(0, 1), (2, 3)], probs=[1 / 3, 1 / 3], nsamples=30000)
+    assert obs.sum() == pytest.approx(20000, rel=0.05)
+    assert p > 0.01
+    assert tu.random_arrays(5).shape == (5,)
+    assert tu.random_arrays(()).shape == ()
+    with pytest.raises(MXNetError):
+        tu.random_arrays("bad")
