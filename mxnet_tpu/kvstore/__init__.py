@@ -1,5 +1,11 @@
 """mx.kvstore (reference: python/mxnet/kvstore/__init__.py)."""
-from .base import KVStoreBase, create  # noqa: F401
+from .base import KVStoreBase, TestStore, create  # noqa: F401
 from .kvstore import KVStore  # noqa: F401
 from .dist import DistAsyncKVStore, DistKVStore  # noqa: F401
 from .horovod import Horovod, BytePS  # noqa: F401
+from .kvstore_server import KVStoreServer, init_server_module  # noqa: F401
+
+# a process launched with DMLC_ROLE=server must fail fast at import with
+# the architectural pointer (reference runs _init_kvstore_server_module
+# at import the same way), not hang as a mislabelled worker
+init_server_module()

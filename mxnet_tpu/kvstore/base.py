@@ -80,3 +80,38 @@ def create(name="local"):
     if name in KVStoreBase.kv_registry:
         return KVStoreBase.kv_registry[name]()
     raise MXNetError(f"unknown KVStore type {name!r}")
+
+
+@KVStoreBase.register
+class TestStore(KVStoreBase):
+    """In-memory single-process store exercising the plugin interface
+    (reference base.py:246 — registered as 'teststore' so KVStoreBase
+    plugin tests have a trivial backend)."""
+
+    def broadcast(self, key, value, out, priority=0):  # noqa: ARG002
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            o[:] = value
+
+    def pushpull(self, key, value, out=None, priority=0):  # noqa: ARG002
+        from ..numpy.multiarray import ndarray
+        if isinstance(value, ndarray):
+            if out is not None:
+                for o in (out if isinstance(out, list) else [out]):
+                    o[:] = value
+            return
+        reduced = value[0]
+        for v in value[1:]:
+            reduced = reduced + v
+        targets = value if out is None else (
+            out if isinstance(out, list) else [out])
+        for t in targets:
+            t[:] = reduced
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in (KVStoreBase.OPTIMIZER,)
+
+    @property
+    def type(self):
+        return "teststore"

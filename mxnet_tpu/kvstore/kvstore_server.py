@@ -1,0 +1,47 @@
+"""KVStore server-role entrypoint — documented N/A pointer.
+
+Reference parity: python/mxnet/kvstore/kvstore_server.py (KVStoreServer
+wraps the C++ ps-lite server loop: a dedicated process applies optimizer
+updates for dist_sync/dist_async workers, launched with DMLC_ROLE=server
+by tools/launch.py).
+
+TPU-native design has NO server processes: parameters and optimizer
+state live sharded on the workers themselves and reduce via XLA
+collectives over the mesh (kvstore/dist.py over jax.distributed), which
+is strictly stronger — the "server" is the ICI/DCN fabric. This module
+keeps the import path and the launcher contract: a process started with
+a server role gets a clear explanation instead of a silent hang.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreServer", "init_server_module"]
+
+_MSG = ("parameter-server roles do not exist on the TPU backend: "
+        "optimizer state is worker-sharded and gradients reduce via mesh "
+        "collectives (kvstore/dist.py). Launch every process as a worker "
+        "(tools/launch.py does this; drop -s/--num-servers).")
+
+
+class KVStoreServer:
+    """Reference: kvstore_server.py KVStoreServer(kvstore). Constructing
+    one is accepted (scripts instantiate before run()); run() fails with
+    the architectural pointer."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        raise MXNetError(_MSG)
+
+
+def init_server_module():
+    """Reference: _init_kvstore_server_module — called at import when
+    DMLC_ROLE=server to hijack the process into the server loop. Here it
+    fails fast with the pointer instead of hanging a misconfigured
+    launch."""
+    if os.environ.get("DMLC_ROLE") == "server":
+        raise MXNetError(_MSG)

@@ -258,3 +258,37 @@ def test_group_adagrad_lazy_sparse():
         assert abs(hn[r] - h) < 1e-6
         assert onp.allclose(wn[r], 1.0 - 0.5 * g / (onp.sqrt(h) + 1e-6),
                             rtol=1e-6)
+
+
+def test_kvstore_teststore_and_server_pointer():
+    """TestStore plugin backend (reference kvstore/base.py:246) +
+    server-role fail-fast (kvstore_server.py)."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    kv = mx.kvstore.create("teststore")
+    assert kv.type == "teststore" and kv.num_workers == 1
+    a, b = np.ones(3), np.ones(3) * 2
+    out = np.zeros(3)
+    kv.pushpull("w", [a, b], out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [3, 3, 3])
+    kv.pushpull("w", [a, b])  # in-place reduce writes back into inputs
+    onp.testing.assert_allclose(a.asnumpy(), [3, 3, 3])
+    o2 = np.zeros(2)
+    kv.broadcast("w", np.ones(2) * 5, out=o2)
+    onp.testing.assert_allclose(o2.asnumpy(), [5, 5])
+    assert mx.kvstore.TestStore.is_capable(mx.kvstore.KVStoreBase.OPTIMIZER)
+
+    srv = mx.kvstore.KVStoreServer(kv)
+    with pytest.raises(MXNetError, match="worker"):
+        srv.run()
+    import os as _os
+    from mxnet_tpu.kvstore.kvstore_server import init_server_module
+    _os.environ["DMLC_ROLE"] = "server"
+    try:
+        with pytest.raises(MXNetError):
+            init_server_module()
+    finally:
+        _os.environ.pop("DMLC_ROLE", None)
+    init_server_module()  # no role: no-op
