@@ -30,6 +30,16 @@ class Initializer:
         # supports both init(desc, arr) legacy and init(arr) forms
         if arr is None:
             name, arr = "weight", name
+        if isinstance(name, InitDesc):
+            # reference initializer.py:131-142: an attrs['__init__'] config
+            # overrides everything; otherwise the name-pattern dispatch
+            # below runs with this initializer as the fallback
+            if name.global_init is None:
+                name.global_init = self
+            attr_init = name.attrs.get("__init__", "")
+            if attr_init:
+                create(attr_init).init_weight(str(name), arr)
+                return
         self.init_weight(str(name), arr)
 
     def init_weight(self, name, arr):
@@ -199,6 +209,60 @@ class Mixed:
                 init(name, arr)
                 return
         raise MXNetError(f"no initializer pattern matches {name!r}")
+
+
+class InitDesc(str):
+    """Initialization descriptor: a parameter NAME carrying its symbol
+    attrs and the global fallback initializer (reference
+    initializer.py:36 — init_weight dispatches on this string)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Load:
+    """Initialize by name from a params file or dict (reference
+    initializer.py:316; 'arg:'/'aux:' prefixes stripped like 1.x
+    checkpoints carry)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from . import npx
+            param = npx.load(param)
+        if not isinstance(param, dict):
+            raise MXNetError("param must be a filename or a name->array "
+                             "dict")
+        self.param = {}
+        for name, arr in param.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        import logging
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(arr.shape) != tuple(src.shape):
+                raise MXNetError(
+                    f"parameter {name} cannot be initialized by loading: "
+                    f"shape {tuple(arr.shape)} vs loaded "
+                    f"{tuple(src.shape)}")
+            arr[:] = src
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise MXNetError(
+                    f"cannot initialize {name}: not found in loaded "
+                    "params and no default initializer given")
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
 
 
 # expose this module's registry through the generic mx.registry factory

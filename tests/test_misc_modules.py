@@ -383,3 +383,38 @@ def test_parse_log_prefix_metric_isolation(tmp_path):
     assert row["train-accuracy"] == 0.5      # not 0.01, not 0.9
     assert row["train-accuracy_top5"] == 0.9
     assert row["time"] == 3.5
+
+
+def test_initializer_load_and_initdesc(tmp_path):
+    """mx.init.Load (arg:/aux: stripping, shape checks, default
+    fallback) + InitDesc (reference initializer.py:36,316)."""
+    src = {"arg:w": mx.np.ones((2, 2)) * 3, "b": mx.np.zeros(2)}
+    init = mx.init.Load(src, default_init=mx.init.Zero())
+    w = mx.np.zeros((2, 2))
+    init("w", w)
+    assert (w.asnumpy() == 3).all()
+    other = mx.np.ones(4)
+    init("unseen", other)
+    assert (other.asnumpy() == 0).all()
+    with pytest.raises(MXNetError, match="shape"):
+        init("w", mx.np.zeros((3, 3)))
+    no_default = mx.init.Load({"w": mx.np.ones(2)})
+    with pytest.raises(MXNetError, match="default"):
+        no_default("missing", mx.np.zeros(2))
+    d = mx.init.InitDesc("fc_weight", {"lr_mult": "2"})
+    assert d == "fc_weight" and d.attrs["lr_mult"] == "2"
+    assert isinstance(d, str)
+    # attrs['__init__'] overrides the calling initializer (1.x Variable
+    # init= attribute path, reference initializer.py:137-142)
+    arr = mx.np.zeros(3)
+    mx.init.Xavier()(mx.init.InitDesc("w", {"__init__": "one"}), arr)
+    assert (arr.asnumpy() == 1).all()
+    desc = mx.init.InitDesc("w")
+    mx.init.One()(desc, mx.np.zeros(2))
+    assert desc.global_init is not None
+    # file form round-trips through npx.save
+    f = str(tmp_path / "p.npz")
+    mx.npx.save(f, {"w": mx.np.full((2,), 7.0)})
+    got = mx.np.zeros(2)
+    mx.init.Load(f)("w", got)
+    assert (got.asnumpy() == 7).all()
