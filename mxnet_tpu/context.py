@@ -127,6 +127,23 @@ def num_tpus():
     return num_gpus()
 
 
+def gpu_memory_info(device_id=0):
+    """(free, total) device memory bytes (reference context.py:249 over
+    cudaMemGetInfo). PJRT exposes per-device stats where the runtime
+    supports them; otherwise this raises like the reference does on a
+    CPU-only build."""
+    from .base import MXNetError
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not 0 <= device_id < len(devs):
+        raise MXNetError(f"no accelerator device {device_id} "
+                         f"({len(devs)} available)")
+    stats = devs[device_id].memory_stats()
+    if not stats or "bytes_limit" not in stats:
+        raise MXNetError("device memory stats unavailable on this runtime")
+    total = stats["bytes_limit"]
+    return total - stats.get("bytes_in_use", 0), total
+
+
 def current_context():
     """Thread-local default context (reference: context.py current_context)."""
     stack = getattr(Context._default_ctx, "stack", None)

@@ -38,7 +38,10 @@ class Initializer:
                 name.global_init = self
             attr_init = name.attrs.get("__init__", "")
             if attr_init:
-                create(attr_init).init_weight(str(name), arr)
+                # reference calls _init_weight directly: the attr config
+                # REPLACES the name-pattern dispatch (a bias with
+                # init='one' must come out ones, not pattern-zeroed)
+                create(attr_init)._init_weight(str(name), arr)
                 return
         self.init_weight(str(name), arr)
 

@@ -12,6 +12,7 @@ TPU-native design: two layers —
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -57,7 +58,12 @@ def is_running():
 
 
 def record_event(name, category, start_us, dur_us, args=None):
-    """Internal hook used by dispatch layers."""
+    """Internal hook used by dispatch layers and the Task/Counter/Marker/
+    Event objects. Gated on the running state: instrumentation left in
+    place while the profiler is stopped must not accumulate events
+    (the reference's objects no-op the same way when unconfigured)."""
+    if not _state["running"]:
+        return
     with _lock:
         _events.append({"name": name, "cat": category, "ph": "X",
                         "ts": start_us, "dur": dur_us, "pid": os.getpid(),
@@ -173,3 +179,55 @@ class Marker:
     def mark(self, scope="process"):
         record_event(self.name, f"marker:{self.domain.name}",
                      time.perf_counter_ns() // 1000, 0)
+
+
+class Event:
+    """Standalone timed event (reference profiler.py Event over
+    ProfileEvent): start()/stop() records one span."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter_ns()
+
+    def stop(self):
+        if self._t0 is not None:
+            record_event(self.name, "event", self._t0 // 1000,
+                         (time.perf_counter_ns() - self._t0) // 1000)
+            self._t0 = None
+
+
+@contextlib.contextmanager
+def scope(name="<unk>:", append_mode=False):  # noqa: ARG001
+    """Profiler scope naming everything recorded inside it (reference
+    profiler.py scope — the GPU memory profiler used it to tag
+    allocations; here spans carry the scope as a category suffix)."""
+    with span(name.rstrip(":"), "scope"):
+        yield
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated 1.x spelling of set_config (reference profiler.py:73)."""
+    import warnings
+    warnings.warn("profiler.profiler_set_config() is deprecated; use "
+                  "profiler.set_config()", DeprecationWarning, stacklevel=2)
+    set_config(profile_symbolic=(mode in ("symbolic", "all")),
+               profile_all=(mode == "all"), filename=filename)
+
+
+def profiler_set_state(state="stop"):
+    """Deprecated 1.x spelling of set_state (reference profiler.py:112)."""
+    import warnings
+    warnings.warn("profiler.profiler_set_state() is deprecated; use "
+                  "profiler.set_state()", DeprecationWarning, stacklevel=2)
+    set_state(state)
+
+
+def dump_profile():
+    """Deprecated spelling of dump (reference profiler.py:146)."""
+    import warnings
+    warnings.warn("profiler.dump_profile() is deprecated; use "
+                  "profiler.dump()", DeprecationWarning, stacklevel=2)
+    dump(True)

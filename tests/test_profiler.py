@@ -80,3 +80,53 @@ def test_opperf_harness_runs():
     for r in rows:
         assert "error" not in r, r
         assert r["e2e_us"] >= 0 and r["dispatch_us"] >= 0
+
+
+def test_profiler_tail_events_scope_deprecated(tmp_path):
+    """Event/scope + 1.x deprecated aliases (reference profiler.py:73,
+    112,146,329)."""
+    import pytest
+
+    f = str(tmp_path / "p.json")
+    with pytest.warns(DeprecationWarning):
+        mx.profiler.profiler_set_config(mode="all", filename=f)
+    with pytest.warns(DeprecationWarning):
+        mx.profiler.profiler_set_state("run")
+    ev = mx.profiler.Event("phase")
+    ev.start()
+    with mx.profiler.scope("block1:"):
+        _ = mx.np.ones((4, 4)).sum()
+    ev.stop()
+    frame = mx.profiler.Frame(mx.profiler.Domain("d"), "f0")
+    frame.start()
+    frame.stop()
+    with pytest.warns(DeprecationWarning):
+        mx.profiler.dump_profile()
+    import json
+    evs = json.load(open(f))
+    evs = evs["traceEvents"] if isinstance(evs, dict) else evs
+    names = {e.get("name") for e in evs}
+    assert "phase" in names and "block1" in names and "f0" in names
+    # stop + restore default config so global state doesn't leak
+    mx.profiler.set_state("stop")
+    mx.profiler.set_config(filename="profile.json", profile_all=False)
+    # stopped profiler: instrumentation must not accumulate events
+    ev2 = mx.profiler.Event("orphan")
+    ev2.start()
+    ev2.stop()
+    from mxnet_tpu.profiler import _events
+    assert not any(e["name"] == "orphan" for e in _events)
+
+
+def test_gpu_memory_info():
+    import jax
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    if jax.devices()[0].platform == "cpu":
+        with pytest.raises(MXNetError):
+            mx.context.gpu_memory_info(0)
+    else:
+        free, total = mx.context.gpu_memory_info(0)
+        assert 0 < free <= total
