@@ -56,3 +56,17 @@ class Features(dict):
 
 def libinfo_features():
     return feature_list()
+
+
+def compiled_with_gcc_cxx11_abi():
+    """Whether the native helper libraries use the GCC cxx11 ABI
+    (reference runtime.py over MXLibInfoCompiledWithCXX11ABI). The
+    on-demand g++ builds here (native/*.cc via storage/io loaders) use
+    the toolchain default, which is the cxx11 ABI on every supported
+    image; returns False only if no native library is loadable at all."""
+    from . import native
+    try:
+        return (native.load("mxtpu_pool") is not None
+                or native.load("mxtpu_io") is not None)
+    except Exception:  # no toolchain: pure-python fallback everywhere
+        return False
