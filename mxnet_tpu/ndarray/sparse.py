@@ -27,7 +27,7 @@ _IDX = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
            "csr_matrix", "zeros", "retain", "dot", "add", "BaseSparseNDArray",
-           "dedupe_coo"]
+           "dedupe_coo", "subtract", "multiply", "divide", "empty", "array"]
 
 
 def dedupe_coo(indices, values, n_rows):
@@ -205,6 +205,10 @@ class CSRNDArray(BaseSparseNDArray):
         return CSRNDArray(self.data.copy(), self.indices.copy(),
                           self.indptr.copy(), self.shape)
 
+    def astype(self, dtype):
+        return CSRNDArray(self.data.astype(dtype), self.indices,
+                          self.indptr, self.shape)
+
 
 # -- constructors (reference: sparse.py row_sparse_array / csr_matrix) ------
 
@@ -301,3 +305,56 @@ def add(a, b):
     da = a.tostype("default") if isinstance(a, BaseSparseNDArray) else a
     db = b.tostype("default") if isinstance(b, BaseSparseNDArray) else b
     return da + db
+
+
+def _densify_binary(public_name, op_name):
+    """Elementwise ops without a sparse-preserving identity densify (the
+    reference's storage-fallback dispatch, sparse.py:1282-1512 — only
+    add of same-stype operands has a cheap sparse kernel; sub/mul/div
+    route through dense there too unless both rsp with scalar rhs)."""
+    import operator
+
+    op = getattr(operator, op_name)
+
+    def fn(lhs, rhs):
+        dl = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) \
+            else lhs
+        dr = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) \
+            else rhs
+        return op(dl, dr)
+
+    fn.__name__ = public_name
+    return fn
+
+
+subtract = _densify_binary("subtract", "sub")
+multiply = _densify_binary("multiply", "mul")
+divide = _densify_binary("divide", "truediv")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    """All-zero sparse array (reference sparse.py:1564 — sparse 'empty'
+    is defined as zeros; there is no uninitialized sparse storage)."""
+    return zeros(stype, shape, ctx=ctx, dtype=dtype or "float32")
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Build a sparse array from a sparse source (reference
+    sparse.py:1596 — dense input is REJECTED there with a pointer to
+    tostype(); same here so ported code fails at the call site)."""
+    if isinstance(source_array, BaseSparseNDArray):
+        out = source_array.copy()
+        if dtype is not None:
+            return out.astype(dtype)
+        return out
+    try:
+        import scipy.sparse as sp  # pragma: no cover - scipy optional
+        if sp.issparse(source_array):
+            csr = source_array.tocsr()
+            return csr_matrix((csr.data, csr.indices, csr.indptr),
+                              shape=csr.shape, dtype=dtype)
+    except ImportError:
+        pass
+    raise MXNetError(
+        "sparse.array takes a sparse source (RowSparseNDArray/CSRNDArray "
+        "or scipy.sparse); for dense input use mx.nd.array(...).tostype()")

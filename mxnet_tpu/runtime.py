@@ -64,9 +64,14 @@ def compiled_with_gcc_cxx11_abi():
     on-demand g++ builds here (native/*.cc via storage/io loaders) use
     the toolchain default, which is the cxx11 ABI on every supported
     image; returns False only if no native library is loadable at all."""
+    import os
+    import shutil
+
     from . import native
-    try:
-        return (native.load("mxtpu_pool") is not None
-                or native.load("mxtpu_io") is not None)
-    except Exception:  # no toolchain: pure-python fallback everywhere
-        return False
+    # consult already-built libs first; otherwise answer from toolchain +
+    # source presence WITHOUT triggering an on-demand g++ build (an
+    # introspection query must not shell out for seconds)
+    if any(lib is not None for lib in native._libs.values()):
+        return True
+    return (shutil.which("g++") is not None
+            and os.path.isdir(native._SRC_DIR))

@@ -149,3 +149,41 @@ def test_sparse_embedding_training_smoke():
     after = emb.weight.data().asnumpy()
     assert not onp.allclose(before[[1, 3]], after[[1, 3]])
     onp.testing.assert_array_equal(before[[0, 2, 4]], after[[0, 2, 4]])
+
+
+def test_sparse_module_binary_tail():
+    """subtract/multiply/divide/empty/array (reference sparse.py
+    :1282-1596; ops densify via the storage-fallback dispatch)."""
+    import numpy as onp
+
+    from mxnet_tpu.ndarray import sparse
+
+    a = sparse.row_sparse_array(
+        (mx.np.ones((2, 3)), mx.np.array([0, 2], dtype="int64")),
+        shape=(4, 3))
+    b = sparse.row_sparse_array(
+        (mx.np.ones((1, 3)) * 2, mx.np.array([2], dtype="int64")),
+        shape=(4, 3))
+    onp.testing.assert_allclose(sparse.subtract(a, b).asnumpy()[2],
+                                [-1, -1, -1])
+    onp.testing.assert_allclose(sparse.multiply(a, b).asnumpy()[2],
+                                [2, 2, 2])
+    d = sparse.divide(b, sparse.row_sparse_array(
+        (mx.np.ones((4, 3)) * 4, mx.np.arange(4, dtype="int64")),
+        shape=(4, 3)))
+    onp.testing.assert_allclose(d.asnumpy()[2], [0.5, 0.5, 0.5])
+    e = sparse.empty("row_sparse", (3, 2))
+    assert e.asnumpy().sum() == 0 and e.stype == "row_sparse"
+    c = sparse.array(a)
+    assert c is not a
+    onp.testing.assert_allclose(c.asnumpy(), a.asnumpy())
+    # dtype override works for both stypes
+    assert sparse.array(a, dtype="float16").dtype == onp.float16
+    csr = sparse.csr_matrix(onp.eye(3, dtype="float32"))
+    assert sparse.array(csr, dtype="float16").dtype == onp.float16
+    # dense input is rejected like the reference
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="tostype"):
+        sparse.array(onp.ones((2, 2), "float32"))
+    assert sparse.divide.__name__ == "divide"
