@@ -69,6 +69,44 @@ class Symbol:
                           f"{self.name}[{index}]", self._num_outputs, index)
         return _make("slice_index", self, index=index)
 
+    def __getattr__(self, name):
+        """Fluent op methods: ``s.abs()``, ``s.argmax(axis=1)``, ... —
+        the reference generates a FIXED list of per-op methods on Symbol
+        (symbol.py abs/argmax/.../zeros_like); only those names resolve,
+        so ``hasattr(sym, 'dtype')``-style duck-typing probes keep their
+        AttributeError contract (dtype/array/load are module callables,
+        not ops)."""
+        if name.startswith("_") or name not in _FLUENT_METHODS:
+            if name in ("asnumpy", "asscalar", "tolist", "item",
+                        "wait_to_read"):
+                # reference raises NotImplementedForSymbol: a symbol has
+                # no values until bound/evaluated
+                raise AttributeError(
+                    f"Symbol.{name} is not supported: symbols are "
+                    "abstract; bind/eval first (reference: "
+                    "NotImplementedForSymbol)")
+            raise AttributeError(f"Symbol has no attribute {name!r}")
+        fn = _module_getattr(name)
+
+        def method(*args, **kwargs):
+            return fn(self, *args, **kwargs)
+        method.__name__ = name
+        return method
+
+    def astype(self, dtype):
+        return _make("Cast", self, dtype=dtype)
+
+    def detach(self):
+        # gradients must NOT flow through (eager ndarray.detach returns
+        # an untracked array); stop_gradient is in the legacy op table
+        return _make("stop_gradient", self)
+
+    def as_np_ndarray(self):
+        return self  # one unified Symbol type (reference has np/legacy)
+
+    def as_nd_ndarray(self):
+        return self
+
     def attr(self, key):
         if key in getattr(self, "_attrs", {}):
             return self._attrs[key]
@@ -558,3 +596,25 @@ def __getattr__(name):
         return target(*args, **kwargs)
     symbolic.__name__ = name
     return symbolic
+
+
+# the reference's generated fluent-method list (symbol.py def tail),
+# minus names that are real methods/properties here and the
+# NotImplementedForSymbol set handled in __getattr__
+_FLUENT_METHODS = frozenset("""
+abs arccos arccosh arcsin arcsinh arctan arctanh argmax argmax_channel
+argmin argsort broadcast_axes broadcast_like broadcast_to cbrt ceil clip
+cos cosh degrees depth_to_space diag exp expand_dims expm1 fix flatten
+flip floor log log10 log1p log2 log_sigmoid log_softmax max mean min
+mish nanprod nansum norm one_hot ones_like pad pick prod radians rcbrt
+reciprocal relu repeat reshape reshape_like rint round rsqrt shape_array
+sigmoid sign sin sinh size_array slice slice_axis slice_like softmax
+softmin sort space_to_depth split split_v2 sqrt square squeeze sum
+swapaxes take tan tanh tile topk transpose trunc zeros_like
+""".split())
+
+
+def _module_getattr(name):
+    """Late-bound alias of this module's __getattr__ (the fluent-method
+    dispatch calls it per lookup)."""
+    return __getattr__(name)

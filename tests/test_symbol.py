@@ -216,3 +216,32 @@ def test_executor_surface_tail():
     with pytest.raises(ValueError):
         ex.copy_params_from({"zz": np.array([1.0])})
     ex.copy_params_from({"zz": np.array([1.0])}, allow_extra_params=True)
+
+
+def test_symbol_fluent_methods():
+    """Fluent op methods (reference symbol.py generates ~80 per-op
+    methods: s.abs().argmax() etc.) resolve through the shared table."""
+    import pytest
+
+    x = mx.sym.var("x")
+    out = x.abs().argmax(axis=0).eval(x=mx.np.array([-5.0, 1.0, 2.0]))[0]
+    assert int(out.asnumpy()) == 0
+    sq = x.square().sum()
+    assert float(sq.eval(x=mx.np.array([2.0, 3.0]))[0].asnumpy()) == 13.0
+    assert x.astype("float16").eval(x=mx.np.ones(2))[0].dtype == onp.float16
+    assert x.as_np_ndarray() is x
+    # detach blocks gradient flow (matches eager ndarray.detach)
+    loss = (x.detach() * x).sum()
+    g = loss.gradient("x").eval(x=mx.np.array([3.0]))[0]
+    assert float(g.asnumpy()[0]) == 3.0  # d/dx [c*x], not 2x
+    with pytest.raises(AttributeError, match="abstract"):
+        x.asnumpy()
+    with pytest.raises(AttributeError):
+        x.not_an_op()
+    # fluent and module spellings build identical graphs
+    a = x.exp().tojson()
+    b = mx.sym.exp(x).tojson()
+    import json as _json
+    na = _json.loads(a)["nodes"][-1]["op"]
+    nb = _json.loads(b)["nodes"][-1]["op"]
+    assert na == nb == "exp"
