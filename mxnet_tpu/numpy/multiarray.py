@@ -337,6 +337,18 @@ def _invoke_flat(prim, args, name, x64, amp_dt):
     return wrapped
 
 
+# the reference's generated fluent-method list for NDArray (the same op
+# tail Symbol carries), minus names implemented as real methods below
+_NDARRAY_FLUENT = frozenset("""
+arccos arccosh arcsin arcsinh arctan arctanh argmax_channel
+broadcast_axes broadcast_like cbrt ceil cos cosh degrees depth_to_space
+diag expm1 fix flip floor log10 log1p log2 log_sigmoid log_softmax mish
+nanprod nansum norm one_hot pad pick radians rcbrt reciprocal relu rint
+rsqrt shape_array sigmoid sign sin sinh size_array slice_axis slice_like
+softmax softmin space_to_depth split_v2 tan tanh tile topk trunc
+""".split())
+
+
 class ndarray:
     """N-dimensional array on a device (reference: numpy/multiarray.py:272)."""
 
@@ -819,6 +831,32 @@ class ndarray:
 
     def any(self, axis=None, keepdims=False):
         return self._reduce(jnp.any, axis, keepdims)
+
+    def __getattr__(self, name):
+        """Legacy fluent op methods (the reference generates ~80 per-op
+        NDArray methods: a.relu(), a.log_softmax(), a.slice_axis(...)).
+        Resolution is restricted to the fixed reference list so
+        duck-typing probes keep their AttributeError contract; the
+        methods call the same np/npx/legacy functions as module
+        spellings. __slots__ means every other miss is a genuine
+        AttributeError, so hot-path attribute access never lands here."""
+        if name in _NDARRAY_FLUENT:
+            from .. import numpy as _np_mod
+            from .. import numpy_extension as _npx_mod
+            from ..ndarray import register as _legacy
+            # npx/legacy FIRST: mx.np's module __getattr__ falls back to
+            # jnp/jax.nn for unknown names, which would shadow the
+            # reference-signature npx ops (softmax temperature=, one_hot
+            # on_value=, ...)
+            fn = _legacy.get(name) or getattr(_npx_mod, name, None) \
+                or getattr(_np_mod, name, None)
+            if callable(fn):
+                def method(*args, _fn=fn, **kwargs):
+                    return _fn(self, *args, **kwargs)
+                method.__name__ = name
+                return method
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute {name!r}")
 
     def argmax(self, axis=None):
         return _invoke(lambda x: jnp.argmax(x, axis), (self,))

@@ -1304,3 +1304,73 @@ def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, ctx=None):
 
 from . import random  # noqa: E402,F401 — npx.random submodule (must
 # import after the sampler defs above; reference exposes both spellings)
+
+
+def rsqrt(data):
+    """1/sqrt (reference: src/operator/tensor/elemwise_unary_op_pow.cc
+    rsqrt) — lax has the fused primitive."""
+    return _invoke(lax.rsqrt, (data,), name="rsqrt")
+
+
+def rcbrt(data):
+    """1/cbrt (reference: elemwise_unary_op_pow.cc rcbrt)."""
+    return _invoke(lambda x: 1.0 / jnp.cbrt(x), (data,), name="rcbrt")
+
+
+def shape_array(data):
+    """Shape as an int64 host-meaning array (reference:
+    src/operator/tensor/matrix_op.cc shape_array; shapes are static
+    under XLA so this is a constant)."""
+    return _invoke(
+        lambda x: jnp.asarray(jnp.shape(x), jnp.int64)
+        if jax.config.read("jax_enable_x64")
+        else jnp.asarray(jnp.shape(x), jnp.int32),
+        (data,), name="shape_array")
+
+
+def size_array(data):
+    """Total element count as a 1-element array (reference:
+    matrix_op.cc size_array)."""
+    return _invoke(lambda x: jnp.asarray([x.size], jnp.int32), (data,),
+                   name="size_array")
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    """np.split with the reference's squeeze_axis flag (reference:
+    matrix_op.cc _split_v2)."""
+    def fn(x):
+        parts = jnp.split(x, indices_or_sections, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return _invoke(fn, (data,), name="split_v2")
+
+
+def space_to_depth(data, block_size):
+    """NCHW (N,C,H,W) -> (N, C*b*b, H/b, W/b) (reference:
+    src/operator/tensor/matrix_op.cc space_to_depth, DCR mode)."""
+    b = int(block_size)
+
+    def fn(x):
+        n, c, h, w = x.shape
+        if h % b or w % b:
+            raise MXNetError(f"H/W must divide block_size {b}")
+        x = x.reshape(n, c, h // b, b, w // b, b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * b * b, h // b, w // b)
+    return _invoke(fn, (data,), name="space_to_depth")
+
+
+def depth_to_space(data, block_size):
+    """Inverse of space_to_depth (reference: matrix_op.cc
+    depth_to_space)."""
+    b = int(block_size)
+
+    def fn(x):
+        n, c, h, w = x.shape
+        if c % (b * b):
+            raise MXNetError(f"C must divide block_size^2 {b * b}")
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        return x.reshape(n, c // (b * b), h * b, w * b)
+    return _invoke(fn, (data,), name="depth_to_space")

@@ -86,7 +86,7 @@ class Symbol:
                     "abstract; bind/eval first (reference: "
                     "NotImplementedForSymbol)")
             raise AttributeError(f"Symbol has no attribute {name!r}")
-        fn = _module_getattr(name)
+        fn = __getattr__(name)  # the module-level op lookup (late-bound)
 
         def method(*args, **kwargs):
             return fn(self, *args, **kwargs)
@@ -455,7 +455,9 @@ def _resolve(op):
     fn = _legacy.get(op)
     if fn is not None:
         return fn
-    for mod in (np, npx):
+    # npx before np: mx.np's jnp/jax.nn fallback would shadow the
+    # reference-signature npx ops at eval time (same order as build time)
+    for mod in (npx, np):
         fn = getattr(mod, op, None)
         if fn is not None:
             return fn
@@ -585,8 +587,11 @@ def __getattr__(name):
     from .. import numpy as np
     from .. import numpy_extension as npx
     from ..ndarray import register as _legacy
-    target = _legacy.get(name) or getattr(np, name, None) \
-        or getattr(npx, name, None)
+    # npx before np: mx.np's __getattr__ falls back to jnp/jax.nn for
+    # unknown names, which would shadow reference-signature npx ops
+    # (softmax temperature=, one_hot on_value=, ...)
+    target = _legacy.get(name) or getattr(npx, name, None) \
+        or getattr(np, name, None)
     if target is None or not callable(target):
         raise AttributeError(name)
 
@@ -613,8 +618,3 @@ softmin sort space_to_depth split split_v2 sqrt square squeeze sum
 swapaxes take tan tanh tile topk transpose trunc zeros_like
 """.split())
 
-
-def _module_getattr(name):
-    """Late-bound alias of this module's __getattr__ (the fluent-method
-    dispatch calls it per lookup)."""
-    return __getattr__(name)

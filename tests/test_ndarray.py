@@ -274,3 +274,28 @@ def test_out_under_autograd():
         z = (y * y).sum()
     z.backward()
     onp.testing.assert_allclose(x.grad.asnumpy(), 2 * 4.0 * 4.0 * 1.0)
+
+
+def test_ndarray_fluent_method_tail():
+    """Legacy fluent methods (reference generates ~80 per-op NDArray
+    methods); fixed allowlist keeps hasattr contracts intact."""
+    a = mx.np.array([[1.0, 3.0], [2.0, 0.0]])
+    onp.testing.assert_allclose(a.log_softmax().asnumpy(),
+                                onp.log(onp.exp(a.asnumpy()) /
+                                        onp.exp(a.asnumpy()).sum(-1,
+                                                keepdims=True)),
+                                rtol=1e-5)
+    assert float(a.norm().asnumpy()) == pytest.approx(3.7416575)
+    assert a.slice_axis(axis=1, begin=0, end=1).shape == (2, 1)
+    onp.testing.assert_allclose(a.pick(mx.np.array([1, 0])).asnumpy(),
+                                [3.0, 2.0])
+    onp.testing.assert_allclose(a.flip(axis=1).asnumpy(),
+                                [[3, 1], [0, 2]])
+    assert not hasattr(a, "not_an_op")
+    assert not hasattr(a, "dtype_")  # only the fixed list resolves
+    # autograd flows through fluent calls
+    a.attach_grad()
+    with mx.autograd.record():
+        out = a.sigmoid().sum()
+    out.backward()
+    assert a.grad is not None and a.grad.shape == a.shape

@@ -198,3 +198,42 @@ def test_npx_random_submodule_and_savez_clash():
     assert npx.random.uniform(0, 1, size=(3,)).shape == (3,)  # fallthrough
     with pytest.raises(MXNetError, match="arr_0"):
         npx.savez("/tmp/clash.npz", mx.np.ones(2), arr_0=mx.np.zeros(2))
+
+
+def test_npx_tensor_tail_ops():
+    """rsqrt/rcbrt/shape_array/size_array/split_v2/space_to_depth/
+    depth_to_space (reference: elemwise_unary_op_pow.cc, matrix_op.cc)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import numpy_extension as npx
+
+    a = mx.np.array([4.0, 0.125])
+    onp.testing.assert_allclose(npx.rsqrt(a).asnumpy(),
+                                [0.5, 1 / onp.sqrt(0.125)], rtol=1e-6)
+    onp.testing.assert_allclose(npx.rcbrt(a).asnumpy(),
+                                [1 / onp.cbrt(4.0), 2.0], rtol=1e-6)
+    onp.testing.assert_array_equal(
+        npx.shape_array(mx.np.ones((2, 3))).asnumpy(), [2, 3])
+    onp.testing.assert_array_equal(
+        npx.size_array(mx.np.ones((2, 3))).asnumpy(), [6])
+    parts = npx.split_v2(mx.np.ones((4, 2)), 2, axis=0, squeeze_axis=False)
+    assert len(parts) == 2 and parts[0].shape == (2, 2)
+    sq = npx.split_v2(mx.np.ones((2, 3)), 2, axis=0, squeeze_axis=True)
+    assert sq[0].shape == (3,)
+    x = mx.np.array(onp.arange(32, dtype="float32").reshape(1, 2, 4, 4))
+    s = npx.space_to_depth(x, 2)
+    assert s.shape == (1, 8, 2, 2)
+    onp.testing.assert_array_equal(npx.depth_to_space(s, 2).asnumpy(),
+                                   x.asnumpy())
+    # fluent + reference-signature kwargs resolve to npx, not jax.nn
+    onp.testing.assert_allclose(
+        mx.np.array([[1.0, 3.0]]).softmax(temperature=0.5).asnumpy(),
+        onp.exp([[2.0, 6.0]]) / onp.exp([[2.0, 6.0]]).sum(), rtol=1e-5)
+    oh = mx.np.array([1]).one_hot(3, on_value=2.0)
+    onp.testing.assert_array_equal(oh.asnumpy(), [[0, 2, 0]])
+    sym_out = mx.sym.var("x").softmax(temperature=0.5).eval(
+        x=mx.np.array([[1.0, 3.0]]))[0]
+    onp.testing.assert_allclose(
+        sym_out.asnumpy(),
+        onp.exp([[2.0, 6.0]]) / onp.exp([[2.0, 6.0]]).sum(), rtol=1e-5)
