@@ -756,8 +756,9 @@ def _register_broadcast_aliases():
 
     def broadcast_axis(data, axis=None, size=None, **kw):
         _drop_name(kw)
-        axes = _tup(axis)
-        sizes = _tup(size)
+        # reference defaults axis=()/size=(): no axes -> identity
+        axes = _tup(axis) or ()
+        sizes = _tup(size) or ()
         target = list(data.shape)
         for a, s in zip(axes, sizes):
             target[a] = s

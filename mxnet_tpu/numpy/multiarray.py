@@ -347,6 +347,7 @@ nanprod nansum norm one_hot pad pick radians rcbrt reciprocal relu rint
 rsqrt shape_array sigmoid sign sin sinh size_array slice_axis slice_like
 softmax softmin space_to_depth split_v2 tan tanh tile topk trunc
 """.split())
+_FLUENT_CACHE: dict = {}  # name -> resolved op fn (name-only resolution)
 
 
 class ndarray:
@@ -841,15 +842,19 @@ class ndarray:
         spellings. __slots__ means every other miss is a genuine
         AttributeError, so hot-path attribute access never lands here."""
         if name in _NDARRAY_FLUENT:
-            from .. import numpy as _np_mod
-            from .. import numpy_extension as _npx_mod
-            from ..ndarray import register as _legacy
-            # npx/legacy FIRST: mx.np's module __getattr__ falls back to
-            # jnp/jax.nn for unknown names, which would shadow the
-            # reference-signature npx ops (softmax temperature=, one_hot
-            # on_value=, ...)
-            fn = _legacy.get(name) or getattr(_npx_mod, name, None) \
-                or getattr(_np_mod, name, None)
+            fn = _FLUENT_CACHE.get(name)
+            if fn is None:
+                from .. import numpy as _np_mod
+                from .. import numpy_extension as _npx_mod
+                from ..ndarray import register as _legacy
+                # npx/legacy FIRST: mx.np's module __getattr__ falls back
+                # to jnp/jax.nn for unknown names, which would shadow the
+                # reference-signature npx ops (softmax temperature=,
+                # one_hot on_value=, ...)
+                fn = _legacy.get(name) or getattr(_npx_mod, name, None) \
+                    or getattr(_np_mod, name, None)
+                if callable(fn):
+                    _FLUENT_CACHE[name] = fn  # name-only resolution
             if callable(fn):
                 def method(*args, _fn=fn, **kwargs):
                     return _fn(self, *args, **kwargs)

@@ -1354,7 +1354,9 @@ def space_to_depth(data, block_size):
     def fn(x):
         n, c, h, w = x.shape
         if h % b or w % b:
-            raise MXNetError(f"H/W must divide block_size {b}")
+            raise MXNetError(
+                f"H and W must be divisible by block_size {b}, "
+                f"got H={h} W={w}")
         x = x.reshape(n, c, h // b, b, w // b, b)
         x = x.transpose(0, 3, 5, 1, 2, 4)
         return x.reshape(n, c * b * b, h // b, w // b)
@@ -1369,7 +1371,8 @@ def depth_to_space(data, block_size):
     def fn(x):
         n, c, h, w = x.shape
         if c % (b * b):
-            raise MXNetError(f"C must divide block_size^2 {b * b}")
+            raise MXNetError(
+                f"C must be divisible by block_size^2 = {b * b}, got C={c}")
         x = x.reshape(n, b, b, c // (b * b), h, w)
         x = x.transpose(0, 3, 4, 1, 5, 2)
         return x.reshape(n, c // (b * b), h * b, w * b)

@@ -53,3 +53,25 @@ def _seed_everything(request):
     import mxnet_tpu as mx
     mx.random.seed(seed)
     yield
+
+
+def retry(n):
+    """Retry up to n times for stochastic/load-sensitive tests
+    (reference: tests/python/unittest/common.py:218)."""
+    import functools
+
+    assert n > 0
+
+    def deco(orig_test):
+        @functools.wraps(orig_test)
+        def wrapped(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return orig_test(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+                    import mxnet_tpu as mx
+                    mx.nd.waitall()
+        return wrapped
+    return deco

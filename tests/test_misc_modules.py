@@ -418,3 +418,34 @@ def test_initializer_load_and_initdesc(tmp_path):
     got = mx.np.zeros(2)
     mx.init.Load(f)("w", got)
     assert (got.asnumpy() == 7).all()
+
+
+def test_conftest_retry_decorator():
+    """retry(n) (reference tests common.py:218): flaky assertion passes
+    on a later attempt; non-assertion errors propagate immediately."""
+    from conftest import retry
+
+    calls = []
+
+    @retry(3)
+    def sometimes():
+        calls.append(1)
+        if len(calls) < 3:
+            raise AssertionError("flake")
+        return "ok"
+
+    assert sometimes() == "ok" and len(calls) == 3
+
+    @retry(2)
+    def always():
+        raise AssertionError("real failure")
+
+    with pytest.raises(AssertionError, match="real"):
+        always()
+
+    @retry(3)
+    def hard_error():
+        raise ValueError("not retried")
+
+    with pytest.raises(ValueError):
+        hard_error()
