@@ -76,3 +76,110 @@ def _xent_bwd(axis, res, g):
 
 
 sparse_softmax_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+# ---------------------------------------------------------------------------
+# chunked-vocab LM cross-entropy: the logits NEVER materialize
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_lm_xent(h, w, labels, chunk=8192):
+    """``-log softmax(h @ w.T)[labels]`` without the (N, V) logits.
+
+    The tied LM head's logits tensor is the long-context memory wall:
+    at seq 8192 x vocab 50257 it alone is ~823 MB bf16 and OOMs one v5e
+    even under whole-model remat (ROUND5_NOTES). This computes the loss
+    by streaming ``lax.scan`` over vocab chunks — per chunk one
+    (N, D) @ (D, chunk) matmul feeds a running online-logsumexp (the
+    flash-attention trick applied to the classifier axis) and the picked
+    label logits; the VJP re-streams the chunks, emitting dh and dw
+    per-chunk so peak extra memory is O(N*chunk + chunk*D).
+
+    h: (N, D); w: (V, D); labels: (N,) int. Returns f32 losses (N,).
+    Gradients flow to h and w.
+    """
+    loss, _ = _chunked_fwd_core(h, w, labels, chunk)
+    return loss
+
+
+def _chunk_w(w, chunk):
+    v, d = w.shape
+    pad = -v % chunk
+    if pad:
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    return w.reshape(-1, chunk, d), v
+
+
+def _chunked_fwd_core(h, w, labels, chunk):
+    n, d = h.shape
+    wc, v = _chunk_w(w, chunk)
+    # out-of-range labels clip to the last valid class, matching
+    # sparse_softmax_xent's _clip_labels parity contract
+    lab = jnp.clip(labels.astype(jnp.int32), 0, v - 1)
+    hf = h  # keep storage dtype on the MXU; accumulate f32 below
+
+    def body(carry, xs):
+        m, s, picked = carry
+        w_c, c0 = xs
+        logits = jax.lax.dot_general(
+            hf, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (N, chunk)
+        col = c0 + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < v, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, -1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), -1)
+        in_chunk = (lab >= c0) & (lab < c0 + chunk)
+        local = jnp.clip(lab - c0, 0, chunk - 1)
+        got = jnp.take_along_axis(logits, local[:, None], 1)[:, 0]
+        picked = jnp.where(in_chunk, got, picked)
+        return (m_new, s, picked), None
+
+    nc = wc.shape[0]
+    starts = jnp.arange(nc, dtype=jnp.int32) * chunk
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, picked), _ = jax.lax.scan(body, init, (wc, starts))
+    lse = m + jnp.log(s)
+    return lse - picked, lse
+
+
+def _chunked_lm_fwd(h, w, labels, chunk):
+    loss, lse = _chunked_fwd_core(h, w, labels, chunk)
+    return loss, (h, w, labels.astype(jnp.int32), lse)
+
+
+def _chunked_lm_bwd(chunk, res, g):
+    h, w, lab, lse = res
+    lab = jnp.clip(lab, 0, w.shape[0] - 1)  # same clip as forward
+    n, d = h.shape
+    wc, v = _chunk_w(w, chunk)
+    nc = wc.shape[0]
+    gf = g.astype(jnp.float32)
+
+    def body(dh, xs):
+        w_c, c0 = xs
+        logits = jax.lax.dot_general(
+            h, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col = c0 + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        p = jnp.where(col < v, jnp.exp(logits - lse[:, None]), 0.0)
+        onehot = (col == lab[:, None]).astype(jnp.float32)
+        dlogits = ((p - onehot) * gf[:, None]).astype(h.dtype)  # (N, chunk)
+        dh = dh + jax.lax.dot_general(
+            dlogits, w_c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw_c = jax.lax.dot_general(
+            dlogits, h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (chunk, D)
+        return dh, dw_c
+
+    starts = jnp.arange(nc, dtype=jnp.int32) * chunk
+    dh, dwc = jax.lax.scan(body, jnp.zeros((n, d), jnp.float32),
+                           (wc, starts))
+    dw = dwc.reshape(-1, d)[:v]
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+chunked_lm_xent.defvjp(_chunked_lm_fwd, _chunked_lm_bwd)

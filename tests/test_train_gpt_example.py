@@ -18,3 +18,18 @@ def test_train_gpt_dp_tp():
         capture_output=True, text=True, timeout=1200, env=env)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "checkpoint save/load ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_train_gpt_long_context_mode():
+    """--long-context: the chunked-vocab-xent path ships and learns."""
+    script = os.path.join(os.path.dirname(__file__), "..", "example",
+                          "train_gpt.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, script, "--long-context", "--cpu-devices", "1",
+         "--steps", "150", "--seq-len", "48", "--batch", "8",
+         "--vocab-chunk", "32"],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "logits never materialized" in r.stdout
