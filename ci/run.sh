@@ -9,6 +9,8 @@
 #                host example as a pure C process
 #   contracts  - __graft_entry__.py (jit entry + multichip dryrun), bench
 #                smoke on CPU
+#   chaos      - fault-injection suite + a small MXNET_FAULT_SPEC matrix
+#                (docs/FAULT_TOLERANCE.md)
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -17,7 +19,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -77,6 +79,23 @@ contracts() {
     JAX_PLATFORMS=cpu python bench.py
 }
 
+chaos() {
+    echo "== chaos: fault-injection suite (docs/FAULT_TOLERANCE.md) =="
+    python -m pytest tests/test_fault_injection.py -q
+    echo "== chaos: MXNET_FAULT_SPEC env matrix =="
+    # each spec arms one injection point through the env alias; the
+    # env_spec test runs a toy train loop under whatever is armed and
+    # asserts it still completes with correct metrics
+    for spec in \
+        "dataloader.worker_crash:at=2" \
+        "invoke.nan_output:at=25,times=1" \
+        "serialization.torn_write:at=1,times=1"; do
+        echo "-- MXNET_FAULT_SPEC=$spec"
+        MXNET_FAULT_SPEC="$spec" python -m pytest \
+            tests/test_fault_injection.py -q -k env_spec
+    done
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -101,8 +120,9 @@ case "$stage" in
     unit) unit ;;
     native) native ;;
     contracts) contracts ;;
+    chaos) chaos ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts ;;
+    all) sanity; unit; native; contracts; chaos ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -1,0 +1,40 @@
+"""Version-portability shims over the JAX API surface.
+
+The supported JAX range moves APIs around between releases; every such
+rename is absorbed here once so the rest of the codebase imports one
+stable spelling.  Robustness: an import-time failure in a shim would take
+the whole package down (every module transitively imports this), so each
+shim must resolve across the full supported range.
+
+- ``shard_map``: top-level ``jax.shard_map`` from 0.5; lived at
+  ``jax.experimental.shard_map.shard_map`` through 0.4.x.  Newer jax also
+  renamed the ``check_rep`` kwarg to ``check_vma``; callers use the new
+  spelling and the shim translates down when needed.
+- ``enable_x64``: top-level ``jax.enable_x64`` from 0.5; lived at
+  ``jax.experimental.enable_x64`` before.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.5
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+try:
+    from jax import enable_x64  # jax >= 0.5
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental import enable_x64
+
+__all__ = ["shard_map", "enable_x64"]

@@ -21,6 +21,7 @@ import threading
 import jax
 import jax.numpy as jnp
 
+from ._jax_compat import enable_x64 as _enable_x64
 from .base import MXNetError
 
 # ---------------------------------------------------------------------------
@@ -394,14 +395,14 @@ def _apply_vjp_create_graph(node, out_cots):
         return tuple(vjp(cstruct))
 
     h_args = tuple(node.raw_args) + tuple(raw_cots[i] for i in diff_idx)
-    x64_scope = jax.enable_x64(True) if node.x64 else contextlib.nullcontext()
+    x64_scope = _enable_x64(True) if node.x64 else contextlib.nullcontext()
     with x64_scope:
         in_cots, h_vjp = jax.vjp(h, *h_args)
     if node.x64:
         _inner = h_vjp
 
         def h_vjp(ct, _i=_inner):
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 return _i(ct)
 
     out_nds = [M._wrap(r) for r in in_cots]

@@ -149,6 +149,29 @@ declare("kvstore.async_timeout", float, 120.0,
 declare("home", str, os.path.join("~", ".mxnet"), "MXNET_HOME",
         "Cache root for datasets/pretrained weights (reference: base.py "
         "data_dir).")
+declare("fault.spec", str, "", "MXNET_FAULT_SPEC",
+        "Fault-injection spec, 'point:at=N[,prob=P,times=K,seed=S];...' "
+        "('' = all injection points disabled; see mx.fault.POINTS).")
+declare("dataloader.worker_mode", str, "auto", "MXNET_DATALOADER_WORKER_MODE",
+        "num_workers>0 execution mode: 'threads', 'processes', or 'auto' "
+        "(first-batch cost probe picks processes only for GIL-bound "
+        "python transforms — BENCH_r05 shows IPC makes processes 4x "
+        "slower for everything else).")
+declare("dataloader.mp_threshold_ms", float, 2.0,
+        "MXNET_DATALOADER_MP_THRESHOLD_MS",
+        "auto worker mode: per-sample python cost (ms) above which the "
+        "GIL dominates and process workers beat threads.")
+declare("dataloader.max_respawns", int, 2, "MXNET_DATALOADER_MAX_RESPAWNS",
+        "Crashed/hung worker-pool respawns tolerated per epoch before the "
+        "loader degrades to threaded workers.")
+declare("dataloader.respawn_backoff", float, 0.1,
+        "MXNET_DATALOADER_RESPAWN_BACKOFF",
+        "Base seconds slept before respawning a crashed worker pool "
+        "(doubles per retry).")
+declare("trainer.skip_nonfinite", bool, False, "MXNET_TRAINER_SKIP_NONFINITE",
+        "Trainer.step skips (and counts) updates whose global grad norm "
+        "is non-finite instead of poisoning the weights; automatic when "
+        "an AMP loss scaler is attached.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

@@ -157,28 +157,41 @@ class Block:
     def save_parameters(self, filename, deduplicate=False):
         """npz of structural-name -> value (reference: block.py:340 over
         src/serialization/cnpy.cc); ``.safetensors`` filenames write the
-        portable safetensors format (mxnet_tpu.serialization)."""
+        portable safetensors format (mxnet_tpu.serialization).
+
+        Writes are crash-atomic (same-dir temp + fsync + ``os.replace``,
+        stale temps from earlier crashes cleaned up): a crash mid-save
+        can never tear an existing checkpoint."""
+        import io
         import numpy as onp
+        from .. import serialization
         params = self.collect_params()
         arrays = {}
         for name, p in params.items():
             if p._data is not None:
                 arrays[name] = p.data().asnumpy()
         if filename.endswith(".safetensors"):
-            from .. import serialization
             serialization.save_safetensors(filename, arrays)
             return
-        onp.savez(filename, **arrays)
-        if not filename.endswith(".npz") and not os.path.exists(filename):
-            os.rename(filename + ".npz", filename)
+        buf = io.BytesIO()
+        onp.savez(buf, **arrays)
+        serialization.atomic_write_bytes(filename, buf.getvalue())
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
                         dtype_source="current", device=None):
-        """Reference: block.py:378."""
+        """Reference: block.py:378.
+
+        When a ``.sha256`` sidecar exists (CheckpointHandler writes one
+        per checkpoint), the file is validated against it first, so a
+        torn/corrupt checkpoint raises instead of silently loading
+        garbage weights."""
         import numpy as onp
         from ..numpy import array
         from .. import serialization
+        real = filename if os.path.exists(filename) else filename + ".npz"
+        if os.path.exists(real):
+            serialization.verify_checksum(real)
         if filename.endswith(".safetensors"):
             loaded = serialization.load_safetensors(filename)
         elif os.path.exists(filename) \

@@ -147,7 +147,18 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     """Periodic model+trainer checkpointing with best-metric tracking
-    (reference: event_handler.py:336)."""
+    (reference: event_handler.py:336).
+
+    Robustness beyond the reference: every file is written crash-atomically
+    (Block.save_parameters / Trainer.save_states) and gets a ``.sha256``
+    sidecar; ``resume_from_checkpoint=True`` restores the newest checkpoint
+    whose checksum validates at ``train_begin``, falling back to older ones
+    when a checkpoint is torn/corrupt (each rejection is counted in
+    ``mx.fault.stats()`` as ``checkpoint.rejected``)."""
+
+    #: every on-disk artifact a checkpoint prefix may own (data + sidecars)
+    _SUFFIXES = (".params", ".params.npz", ".states",
+                 ".params.sha256", ".params.npz.sha256", ".states.sha256")
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  verbose=0, save_best=False, mode="auto", epoch_period=1,
@@ -159,6 +170,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.epoch_period = epoch_period
         self.batch_period = batch_period
         self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
         self.current_epoch = 0
         self.current_batch = 0
         self.best = -onp.inf if mode == "max" else onp.inf
@@ -167,18 +179,66 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         os.makedirs(model_dir, exist_ok=True)
 
     def _save(self, estimator, tag):
+        from .... import serialization
         prefix = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
         estimator.net.save_parameters(prefix + ".params")
         if getattr(estimator, "trainer", None) is not None:
             estimator.trainer.save_states(prefix + ".states")
+        for suffix in (".params", ".params.npz", ".states"):
+            if os.path.exists(prefix + suffix):
+                serialization.write_checksum(prefix + suffix)
         self.saved.append(prefix)
         while len(self.saved) > self.max_checkpoints:
             old = self.saved.pop(0)
-            for suffix in (".params", ".params.npz", ".states"):
+            for suffix in self._SUFFIXES:
                 try:
                     os.remove(old + suffix)
                 except OSError:
                     pass
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if self.resume_from_checkpoint:
+            self._resume(estimator)
+
+    def _epoch_checkpoints(self):
+        """(epoch, prefix) for every epoch checkpoint on disk, newest
+        first."""
+        import re
+        pat = re.compile(re.escape(self.model_prefix) + r"-epoch(\d+)\.params$")
+        found = []
+        for fn in os.listdir(self.model_dir):
+            m = pat.match(fn)
+            if m:
+                found.append((int(m.group(1)),
+                              os.path.join(self.model_dir, fn[:-7])))
+        return sorted(found, reverse=True)
+
+    def _resume(self, estimator):
+        """Restore the newest checkpoint that validates; walk to older ones
+        past any torn/corrupt file instead of dying on it."""
+        from .... import fault as _fault
+        logger = logging.getLogger("estimator")
+        for epoch, prefix in self._epoch_checkpoints():
+            try:
+                estimator.net.load_parameters(prefix + ".params")
+                states = prefix + ".states"
+                if os.path.exists(states) and \
+                        getattr(estimator, "trainer", None) is not None:
+                    estimator.trainer.load_states(states)
+            except Exception as e:  # noqa: BLE001 - any torn/corrupt artifact
+                _fault.record("checkpoint.rejected")
+                logger.warning("checkpoint %s rejected (%s); trying older",
+                               prefix, e)
+                continue
+            self.current_epoch = epoch
+            # cleanup rotation continues from what survives on disk
+            self.saved = [p for _, p in
+                          sorted(self._epoch_checkpoints())][-self.max_checkpoints:]
+            _fault.record("checkpoint.resume")
+            logger.info("resumed from %s (epoch %d)", prefix, epoch)
+            return
+        logger.info("resume requested but no valid checkpoint in %s",
+                    self.model_dir)
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1

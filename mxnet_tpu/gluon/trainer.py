@@ -133,6 +133,9 @@ class Trainer:
         self._params_to_init = []
         self._contains_sparse_grad = False
         self._fused_update = None
+        self._finite_check = None
+        #: steps skipped by the non-finite grad guard (see step())
+        self.nonfinite_steps = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -212,12 +215,64 @@ class Trainer:
                 else:
                     self._kvstore.pushpull(i, grads, out=grads, priority=-i)
 
+    # -- non-finite grad guard (resilience layer; see docs/FAULT_TOLERANCE) --
+    def _guard_active(self):
+        """The guard runs when opted in (mx.config trainer.skip_nonfinite)
+        or automatically once an AMP loss scaler is attached (reference:
+        amp's skip-on-overflow contract, python/mxnet/amp/loss_scaler.py)."""
+        from .. import config
+        return (getattr(self, "_amp_loss_scaler", None) is not None
+                or bool(config.get("trainer.skip_nonfinite")))
+
+    def _grads_finite(self):
+        """One fused XLA reduction over every gradient -> scalar bool."""
+        raws = [p.grad()._data for p in self._params
+                if p.grad_req != "null" and p._data is not None]
+        if not raws:
+            return True
+        if self._finite_check is None:
+            self._finite_check = jax.jit(
+                lambda gs: jnp.all(jnp.asarray(
+                    [jnp.isfinite(g).all() for g in gs])))
+        return bool(self._finite_check(raws))
+
+    def _skip_step(self):
+        """Count and absorb a non-finite step: weights untouched, the AMP
+        scale backs off, accumulated ('add') grads are cleared so the
+        poison cannot leak into the next step."""
+        from .. import fault
+        self.nonfinite_steps += 1
+        fault.record("trainer.nonfinite_skip")
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            scaler.update_scale(True)
+        for p in self._params:
+            if p.grad_req == "add" and p._data is not None:
+                p.zero_grad()
+
     def step(self, batch_size, ignore_stale_grad=False):
-        """Reference: trainer.py:334."""
+        """Reference: trainer.py:334.
+
+        With the non-finite guard active, a step whose gradients contain
+        inf/NaN is skipped (counted in ``nonfinite_steps`` and
+        ``mx.fault.stats()``) instead of poisoning the weights.  The check
+        runs *after* the cross-worker reduce where possible so every rank
+        takes the same decision; with ``update_on_kvstore`` the optimizer
+        runs inside the push, so there the local gradient is checked
+        before pushing."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        guard = self._guard_active()
+        if guard and self._update_on_kvstore and not self._grads_finite():
+            self._skip_step()
+            return
         self._allreduce_grads()
+        if guard and not self._update_on_kvstore and not self._grads_finite():
+            self._skip_step()
+            return
+        if guard and getattr(self, "_amp_loss_scaler", None) is not None:
+            self._amp_loss_scaler.update_scale(False)
         self._update(ignore_stale_grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
@@ -263,17 +318,22 @@ class Trainer:
                 updater(i, p.grad(), p.data())
 
     def save_states(self, fname):
-        """Reference: trainer.py:482."""
+        """Reference: trainer.py:482.  Crash-atomic like
+        Block.save_parameters (temp + fsync + os.replace)."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as f:
-                f.write(self._updaters[0].get_states(dump_optimizer=True))
+            from .. import serialization
+            serialization.atomic_write_bytes(
+                fname, self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
-        """Reference: trainer.py:511."""
+        """Reference: trainer.py:511.  Validates a ``.sha256`` sidecar
+        when present (CheckpointHandler writes one)."""
+        from .. import serialization
+        serialization.verify_checksum(fname)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:

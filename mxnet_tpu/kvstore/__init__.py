@@ -1,7 +1,7 @@
 """mx.kvstore (reference: python/mxnet/kvstore/__init__.py)."""
 from .base import KVStoreBase, TestStore, create  # noqa: F401
 from .kvstore import KVStore  # noqa: F401
-from .dist import DistAsyncKVStore, DistKVStore  # noqa: F401
+from .dist import CollectiveTimeout, DistAsyncKVStore, DistKVStore  # noqa: F401
 from .horovod import Horovod, BytePS  # noqa: F401
 from .kvstore_server import KVStoreServer, init_server_module  # noqa: F401
 

@@ -36,16 +36,41 @@ JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID.
 from __future__ import annotations
 
 import os
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
 
+from .. import config as _config
+from .. import fault as _fault
 from ..base import MXNetError, get_env
 from ..numpy.multiarray import ndarray, _wrap
 from .kvstore import KVStore
 
 
 from .._dist_init import ensure_distributed as _ensure_distributed
+
+
+class CollectiveTimeout(MXNetError):
+    """A blocking cross-process collective missed its deadline.
+
+    Structured so supervisors/tests can dispatch on the fields instead of
+    parsing the message: ``op`` (collective kind), ``key`` (kvstore key, or
+    None), ``rank``/``nprocs``, ``elapsed`` (seconds waited).
+    """
+
+    def __init__(self, op, key, rank, nprocs, elapsed, hint=""):
+        self.op = op
+        self.key = key
+        self.rank = rank
+        self.nprocs = nprocs
+        self.elapsed = elapsed
+        msg = (f"collective '{op}' for key {key!r} timed out after "
+               f"{elapsed:.1f}s on rank {rank}/{nprocs}."
+               f"{(' ' + hint) if hint else ''} Raise mx.config "
+               "'kvstore.async_timeout' if the collective is merely slow.")
+        super().__init__(msg)
 
 
 class DistKVStore(KVStore):
@@ -72,20 +97,77 @@ class DistKVStore(KVStore):
         from .gradient_compression import GradientCompression
         self._gc = GradientCompression(**dict(compression_params or {}))
 
+    def _watchdog_engaged(self):
+        # multi-process always; single-process only when the chaos point is
+        # armed (so tests can exercise the timeout machinery without a
+        # second process, and production 1-proc runs pay nothing)
+        return self._nprocs > 1 or _fault.armed("kvstore.collective_timeout")
+
+    def _timed_wait(self, op, key, fn, hint=""):
+        """Run a blocking collective with a deadline.
+
+        Every cross-process wait in this store goes through here: the
+        collective runs on a helper thread, the caller joins with the
+        ``kvstore.async_timeout`` deadline, and a miss raises a structured
+        ``CollectiveTimeout`` naming the op/key/rank/elapsed — a mismatched
+        SPMD schedule becomes a debuggable error instead of a silent
+        freeze.  The helper thread is a daemon: if the collective later
+        completes it dies quietly; if it never does, it parks forever
+        without holding the process's exit hostage.
+        """
+        timeout = _config.get("kvstore.async_timeout")
+        result = {}
+
+        def wait():
+            try:
+                if _fault._active and \
+                        _fault.fire("kvstore.collective_timeout"):
+                    time.sleep(timeout + 3600)  # never completes
+                    return
+                result["value"] = fn()
+            except Exception as e:  # noqa: BLE001 - ferried to caller
+                result["error"] = e
+
+        start = time.monotonic()
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            _fault.record("kvstore.collective_timeout_raised")
+            raise CollectiveTimeout(op, key, self.rank, self.num_workers,
+                                    time.monotonic() - start, hint)
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
+
     def _allreduce(self, merged):
-        """Cross-process sum. Single process: identity. Multi-process: a
-        tiny pjit'd psum over a global 1-d process mesh (DCN axis)."""
+        """Cross-process sum (no deadline — see ``_timed_wait`` callers).
+        Single process: identity. Multi-process: a tiny pjit'd psum over a
+        global 1-d process mesh (DCN axis)."""
         if self._nprocs == 1:
             return merged
         from ..parallel.collectives import allreduce_across_processes
         return _wrap(allreduce_across_processes(merged._data))
 
+    def _waited_allreduce(self, value):
+        """Allreduce + completion wait, for use inside ``_timed_wait`` (the
+        deadline must cover the async DCN wait, not just dispatch)."""
+        out = self._allreduce(value)
+        raw = getattr(out, "_data", out)
+        if hasattr(raw, "block_until_ready"):
+            raw.block_until_ready()
+        return out
+
     def _merged(self, k, vs):
-        """Local device reduce, optional quantization, cross-process sum."""
+        """Local device reduce, optional quantization, cross-process sum
+        (under the collective watchdog when engaged)."""
         merged = self._reduce(vs)
         if self._gc is not None:
             merged = _wrap(self._gc.quantize(k, merged._data))
-        return self._allreduce(merged)
+        if not self._watchdog_engaged():
+            return self._allreduce(merged)
+        return self._timed_wait("allreduce", k,
+                                lambda: self._waited_allreduce(merged))
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
@@ -169,38 +251,20 @@ class DistAsyncKVStore(DistKVStore):
         naming the key and this process's reconcile sequence number so the
         mismatched schedule is debuggable instead of a silent freeze.
         """
-        if self._nprocs > 1:
-            import threading
-
-            from .. import config as _config
+        if self._watchdog_engaged():
             self._reconcile_seq = getattr(self, "_reconcile_seq", 0) + 1
-            timeout = _config.get("kvstore.async_timeout")
-            result = {}
 
-            def wait():
-                try:
-                    out = self._allreduce(self._store[k])._data
-                    out.block_until_ready()
-                    result["value"] = out
-                except Exception as e:  # noqa: BLE001 - ferried to caller
-                    result["error"] = e
+            def run():
+                out = self._waited_allreduce(self._store[k])
+                return getattr(out, "_data", out)
 
-            t = threading.Thread(target=wait, daemon=True)
-            t.start()
-            t.join(timeout)
-            if t.is_alive():
-                raise MXNetError(
-                    f"dist_async reconcile #{self._reconcile_seq} for key "
-                    f"'{k}' timed out after {timeout}s on rank "
-                    f"{self.rank}/{self.num_workers}. Every process must "
-                    "pull the same keys in the same order the same number "
-                    "of times (SPMD collective constraint); a "
-                    "data-dependent pull schedule deadlocks here. Align "
-                    "the pull schedule or raise mx.config "
-                    "'kvstore.async_timeout'.")
-            if "error" in result:
-                raise result["error"]
-            avg = result["value"] / self._nprocs
+            summed = self._timed_wait(
+                f"reconcile#{self._reconcile_seq}", k, run,
+                hint="Every process must pull the same keys in the same "
+                     "order the same number of times (SPMD collective "
+                     "constraint); a data-dependent pull schedule "
+                     "deadlocks here — align the pull schedule.")
+            avg = summed / self._nprocs
             self._store[k]._rebind(avg.astype(self._store[k].dtype))
         return self._store[k]
 

@@ -96,8 +96,9 @@ class KVStore(KVStoreBase):
             return [merged] * len(vals)
 
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        from jax import shard_map
         import functools
+
+        from .._jax_compat import shard_map
 
         n, shape = len(vals), tuple(vals[0].shape)
         mesh = Mesh(onp.array(devs), ("kv",))
@@ -244,8 +245,9 @@ class KVStore(KVStoreBase):
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no updater/optimizer set")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        from .. import serialization
+        serialization.atomic_write_bytes(
+            fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:

@@ -26,6 +26,8 @@ import numpy as onp
 
 from .. import autograd
 from .. import engine
+from .. import fault as _fault
+from .._jax_compat import enable_x64 as _enable_x64
 from ..base import MXNetError, np_dtype
 from ..context import Context, current_context
 
@@ -162,8 +164,32 @@ def _invoke(prim, args, kwargs=None, name=None, x64=False):
     if _profiler._state["running"] and _profiler._config["profile_imperative"]:
         with _profiler.span(name or getattr(prim, "__name__", "op"),
                             "operator"):
-            return _invoke_impl(prim, args, kwargs, name, x64)
-    return _invoke_impl(prim, args, kwargs, name, x64)
+            out = _invoke_impl(prim, args, kwargs, name, x64)
+    else:
+        out = _invoke_impl(prim, args, kwargs, name, x64)
+    # fault hook (disabled cost: one module-attr read + branch): every
+    # dispatch probes invoke.nan_output; a hit turns the op's result into
+    # all-NaN, emulating a kernel/overflow fault the trainer guard and
+    # AMP scaler must absorb (docs/FAULT_TOLERANCE.md)
+    if _fault._active and _fault.fire("invoke.nan_output"):
+        _nan_corrupt(out)
+    return out
+
+
+def _nan_corrupt(out):
+    """Rebind the first inexact, concrete (non-tracer) output leaf to
+    all-NaN.  Tracer leaves are left alone — corrupting a trace would
+    bake the NaN into a compiled executable and replay it forever, which
+    is not the transient fault being modeled."""
+    leaves = jax.tree_util.tree_leaves(
+        out, is_leaf=lambda x: isinstance(x, ndarray))
+    for leaf in leaves:
+        if isinstance(leaf, ndarray) and _is_inexact(leaf) \
+                and not isinstance(leaf._data, jax.core.Tracer):
+            leaf._rebind(jnp.full(leaf._data.shape, jnp.nan,
+                                  leaf._data.dtype))
+            return True
+    return False
 
 
 _64bit_cache: dict = {}
@@ -231,7 +257,7 @@ def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
     raws = [a._data for a in diff_arrays]
     recording = (autograd.is_recording()
                  and any(a._entry is not None for a in diff_arrays))
-    x64_scope = jax.enable_x64(True) if use_x64 else contextlib.nullcontext()
+    x64_scope = _enable_x64(True) if use_x64 else contextlib.nullcontext()
     with x64_scope:
         if recording:
             try:
@@ -245,7 +271,7 @@ def _invoke_impl(prim, args, kwargs=None, name=None, x64=False):
         _inner_vjp = vjp_fn
 
         def vjp_fn(ct, _inner=_inner_vjp):
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 return _inner(ct)
 
     wrapped = _wrap_out(out)
@@ -297,7 +323,7 @@ def _invoke_flat(prim, args, name, x64, amp_dt):
     raws = [a._data for a in diff_arrays]
     recording = (autograd.is_recording()
                  and any(a._entry is not None for a in diff_arrays))
-    x64_scope = jax.enable_x64(True) if use_x64 else contextlib.nullcontext()
+    x64_scope = _enable_x64(True) if use_x64 else contextlib.nullcontext()
     with x64_scope:
         if recording:
             try:
@@ -319,7 +345,7 @@ def _invoke_flat(prim, args, name, x64, amp_dt):
         _inner_vjp = vjp_fn
 
         def vjp_fn(ct, _inner=_inner_vjp):
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 return _inner(ct)
 
     wrapped = _wrap_out(out)
@@ -939,7 +965,7 @@ def _place(raw, ctx, device):
 
 def _x64_scope(dt):
     """Scoped x64 mode when a 64-bit dtype is explicitly requested."""
-    return jax.enable_x64(True) if _wants_x64(dt) else contextlib.nullcontext()
+    return _enable_x64(True) if _wants_x64(dt) else contextlib.nullcontext()
 
 
 def array(obj, dtype=None, ctx=None, device=None):

@@ -13,7 +13,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from .._jax_compat import shard_map
 
 
 def allreduce(x, mesh, axis="dp", op="sum"):

@@ -16,7 +16,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from .._jax_compat import shard_map
 
 
 def gpipe(stage_fn, stage_params, xs, mesh, axis="pp"):

@@ -18,7 +18,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from .._jax_compat import shard_map
 
 
 def _block_attn(q, k, v, m_prev, l_prev, acc, scale, mask=None):

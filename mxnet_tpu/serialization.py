@@ -17,7 +17,10 @@ in ``.safetensors``.
 """
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import json
+import os
 import struct
 
 import numpy as onp
@@ -25,7 +28,96 @@ import numpy as onp
 from .base import MXNetError
 
 __all__ = ["save_safetensors", "load_safetensors",
-           "save_legacy_params", "load_legacy_params", "is_legacy_params"]
+           "save_legacy_params", "load_legacy_params", "is_legacy_params",
+           "atomic_write_bytes", "write_checksum", "verify_checksum",
+           "CHECKSUM_SUFFIX"]
+
+CHECKSUM_SUFFIX = ".sha256"
+
+
+def _clean_stale_tmp(path):
+    """Drop temp files a crashed earlier save left next to ``path``
+    (``<name>.tmp-*``) so interrupted-then-retried saves don't accumulate
+    garbage in the checkpoint directory."""
+    d = os.path.dirname(os.path.abspath(path))
+    base = os.path.basename(path) + ".tmp-"
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for n in names:
+        if n.startswith(base):
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(d, n))
+
+
+def atomic_write_bytes(path, data):
+    """Crash-atomic file write: same-directory temp file + fsync +
+    ``os.replace``.  A reader (or a crash at any point) observes either
+    the old ``path`` or the complete new one, never a torn file — the
+    failure mode the reference's plain ``open(path, 'wb')`` checkpointing
+    is exposed to.
+
+    Injection: ``serialization.torn_write`` silently truncates the
+    persisted bytes — emulating disk/filesystem-level corruption that
+    atomic replace cannot prevent; checksum validation (``write_checksum``
+    / ``verify_checksum``) is the recovery that catches it on load.
+    """
+    from . import fault as _fault
+    data = data if isinstance(data, (bytes, bytearray, memoryview)) \
+        else bytes(data)
+    persisted = data
+    if _fault._active and _fault.fire("serialization.torn_write"):
+        persisted = data[:max(1, len(data) // 2)]
+    _clean_stale_tmp(path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(persisted)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+    return path
+
+
+def write_checksum(path):
+    """Write a ``path + '.sha256'`` sidecar holding the hex digest of the
+    file's current bytes.  Ordering guarantee: the sidecar is written
+    *after* the data file, so a crash between the two leaves a checkpoint
+    that fails validation (rejected, older one used) — never a corrupt
+    checkpoint that passes."""
+    with open(path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    atomic_write_bytes(path + CHECKSUM_SUFFIX, digest.encode())
+    return digest
+
+
+def verify_checksum(path, required=False):
+    """Validate ``path`` against its ``.sha256`` sidecar.
+
+    Returns True when the digest matches, None when no sidecar exists and
+    ``required`` is False.  Raises :class:`MXNetError` on mismatch (torn/
+    corrupt file) or on a missing sidecar with ``required=True``.
+    """
+    side = path + CHECKSUM_SUFFIX
+    if not os.path.exists(side):
+        if required:
+            raise MXNetError(f"{path}: checksum sidecar {side} missing")
+        return None
+    with open(side, "rb") as f:
+        want = f.read().decode().strip()
+    with open(path, "rb") as f:
+        have = hashlib.sha256(f.read()).hexdigest()
+    if have != want:
+        raise MXNetError(
+            f"{path}: checksum mismatch (file {have[:12]}.. vs recorded "
+            f"{want[:12]}..) — torn or corrupt checkpoint; falling back "
+            "to an older checkpoint is the intended recovery")
+    return True
 
 # safetensors dtype tags <-> numpy
 _DTYPES = {
@@ -77,12 +169,9 @@ def save_safetensors(path, tensors, metadata=None):
     blob = json.dumps(header, separators=(",", ":")).encode()
     pad = (8 - len(blob) % 8) % 8          # spec: align data to 8 bytes
     blob += b" " * pad
-    with open(path, "wb") as f:
-        f.write(struct.pack("<Q", len(blob)))
-        f.write(blob)
-        for name in sorted(arrays):
-            f.write(arrays[name].tobytes())
-    return path
+    payload = b"".join([struct.pack("<Q", len(blob)), blob]
+                       + [arrays[name].tobytes() for name in sorted(arrays)])
+    return atomic_write_bytes(path, payload)
 
 
 def load_safetensors(path, return_metadata=False):

@@ -104,8 +104,8 @@ def int64_tensor_size(active=True):
     default 32-bit truncation applies (a startup-time choice in the
     reference, a scope here).
     """
-    import jax
-    with jax.enable_x64(active):
+    from ._jax_compat import enable_x64
+    with enable_x64(active):
         yield
 
 
