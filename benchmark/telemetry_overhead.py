@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Disabled-telemetry fast-path overhead budget (CI `telemetry` stage).
+
+The contract (mxnet_tpu/telemetry.py, mirroring fault.py): with the
+registry off, every instrumentation hook in the stack is ONE module
+attribute read + branch.  This benchmark measures that cost against a
+tight eager-op loop and fails if the probes add more than the budget
+(default 2%) — the guard that keeps future instrumentation honest.
+
+Method: time a tight eager add loop (N ops, synced once) as the
+baseline, then the same loop with K extra disabled-telemetry probes per
+iteration, scale the measured per-probe cost down to the ~1 probe a real
+dispatch performs, and compare medians of R repeats (medians + many
+probes per iteration keep the number stable on noisy CI hosts).
+
+Usage: python benchmark/telemetry_overhead.py [--budget 0.02] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _loop(a, n, probes_per_op, telemetry):
+    """One timed run: n eager adds, probes_per_op gated probes each."""
+    t0 = time.perf_counter()
+    out = a
+    if probes_per_op == 0:
+        for _ in range(n):
+            out = out + a
+    else:
+        probe = range(probes_per_op)
+        for _ in range(n):
+            out = out + a
+            for _ in probe:
+                if telemetry._active:  # the hook pattern under test
+                    telemetry.inc("bench.never")
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    telemetry.disable()
+    assert not telemetry.active()
+    a = mx.np.ones((8, 8))
+    _loop(a, 200, 0, telemetry)          # warmup: compile + caches hot
+    base_s, probed_s = [], []
+    for _ in range(repeats):
+        base_s.append(_loop(a, n, 0, telemetry))
+        probed_s.append(_loop(a, n, probes_per_op, telemetry))
+    base = statistics.median(base_s)
+    probed = statistics.median(probed_s)
+    # cost of the K probes, scaled to the ~1 probe a real dispatch adds
+    per_probe_overhead = max(0.0, probed - base) / probes_per_op
+    ratio = per_probe_overhead / base
+    return {"ops": n, "probes_per_op": probes_per_op, "repeats": repeats,
+            "baseline_s": round(base, 6), "probed_s": round(probed, 6),
+            "per_op_probe_overhead_ns": round(per_probe_overhead / n * 1e9, 2),
+            "overhead_ratio": round(ratio, 6), "budget": budget,
+            "ok": ratio < budget}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=2000)
+    ap.add_argument("--probes-per-op", type=int, default=32)
+    ap.add_argument("--repeats", type=int, default=7)
+    ap.add_argument("--budget", type=float, default=0.02,
+                    help="max disabled-probe cost as a fraction of the "
+                         "eager loop (CI enforces the default 2%%)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    r = run(args.ops, args.probes_per_op, args.repeats, args.budget)
+    if args.json:
+        print(json.dumps(r))
+    else:
+        print(f"baseline eager loop   {r['baseline_s'] * 1e3:9.2f} ms "
+              f"({r['ops']} ops)")
+        print(f"with {r['probes_per_op']}x disabled probes/op "
+              f"{r['probed_s'] * 1e3:9.2f} ms")
+        print(f"per-op probe overhead {r['per_op_probe_overhead_ns']:9.2f} ns")
+        print(f"overhead ratio        {r['overhead_ratio'] * 100:9.4f} % "
+              f"(budget {r['budget'] * 100:g}%)")
+    if not r["ok"]:
+        print("FAIL: disabled telemetry fast path exceeds the overhead "
+              "budget", file=sys.stderr)
+        return 1
+    print("OK: disabled telemetry fast path within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,8 @@
 #                smoke on CPU
 #   chaos      - fault-injection suite + a small MXNET_FAULT_SPEC matrix
 #                (docs/FAULT_TOLERANCE.md)
+#   telemetry  - metrics/observability suite + the disabled-fast-path
+#                overhead budget (docs/OBSERVABILITY.md)
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -19,7 +21,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -96,6 +98,13 @@ chaos() {
     done
 }
 
+telemetry() {
+    echo "== telemetry: observability suite (docs/OBSERVABILITY.md) =="
+    python -m pytest tests/test_telemetry.py -q
+    echo "== telemetry: disabled fast-path overhead budget (<2%) =="
+    JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -121,8 +130,9 @@ case "$stage" in
     native) native ;;
     contracts) contracts ;;
     chaos) chaos ;;
+    telemetry) telemetry ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos ;;
+    all) sanity; unit; native; contracts; chaos; telemetry ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -28,6 +28,7 @@ _ensure_distributed()
 from . import base
 from .base import MXNetError
 from . import config
+from . import telemetry
 from . import fault
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, device, num_gpus, num_tpus

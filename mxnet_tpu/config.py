@@ -152,6 +152,20 @@ declare("home", str, os.path.join("~", ".mxnet"), "MXNET_HOME",
 declare("fault.spec", str, "", "MXNET_FAULT_SPEC",
         "Fault-injection spec, 'point:at=N[,prob=P,times=K,seed=S];...' "
         "('' = all injection points disabled; see mx.fault.POINTS).")
+declare("telemetry.enable", bool, False, "MXNET_TELEMETRY",
+        "Enable the mx.telemetry metrics registry (counters/gauges/"
+        "histograms wired through cached-graph compile, dataloader, "
+        "trainer, kvstore and fault paths); disabled, every hook costs "
+        "one module-attribute read.")
+declare("telemetry.recompile_limit", int, 8, "MXNET_TELEMETRY_RECOMPILE_LIMIT",
+        "Per-block XLA trace+compile count above which the recompilation "
+        "detector emits a structured RecompileWarning (the TPU shape-"
+        "polymorphism pitfall); fires once per block.")
+declare("telemetry.jsonl", str, "", "MXNET_TELEMETRY_JSONL",
+        "Default JSONL path for TrainingTelemetry step records and the "
+        "final run report ('' = keep records in memory only).")
+declare("telemetry.step_interval", int, 1, "MXNET_TELEMETRY_STEP_INTERVAL",
+        "TrainingTelemetry emits a JSONL step record every N step() calls.")
 declare("dataloader.worker_mode", str, "auto", "MXNET_DATALOADER_WORKER_MODE",
         "num_workers>0 execution mode: 'threads', 'processes', or 'auto' "
         "(first-batch cost probe picks processes only for GIL-bound "

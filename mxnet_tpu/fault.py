@@ -36,6 +36,7 @@ import random as _pyrandom
 import threading
 
 from . import config as _config
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["POINTS", "configure", "clear", "active", "armed", "fire",
@@ -183,9 +184,14 @@ def fire(point, step=None):
 
 def record(event, n=1):
     """Count a fault or recovery event (recovery code calls this even when
-    injection is off — real faults are counted identically)."""
+    injection is off — real faults are counted identically).  Every event
+    also mirrors into ``mx.telemetry`` (``fault.events_total{event=...}``)
+    when the metrics registry is enabled, so run reports and the
+    Prometheus exposition carry the resilience picture."""
     with _lock:
         _stats[event] = _stats.get(event, 0) + n
+    if _telemetry._active:
+        _telemetry.inc("fault.events_total", n, event=event)
 
 
 def stats():

@@ -23,15 +23,22 @@ import json
 import os
 import re
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..numpy.multiarray import ndarray, _wrap
 from .parameter import Parameter, DeferredInitializationError
 from .. import random as _random
+
+#: per-thread _CachedGraph call depth — telemetry records only the
+#: outermost hybridized call (children traced inside a parent are part
+#: of that one compile)
+_tele_tls = threading.local()
 
 
 def _is_nd(x):
@@ -429,6 +436,19 @@ class _CachedGraph:
         # exclusive (writer). Replays only read the param raws — shared.
         # _out_trees membership == "trace completed" (set at trace time).
         need_trace = is_new_sig or sig_key not in self._out_trees
+        # telemetry covers only the OUTERMOST hybridized call on this
+        # thread: children re-tracing inside a parent's trace are an
+        # implementation detail of that one user-visible compile, and
+        # per-child recompile warnings would be noise for one root cause
+        outermost = not getattr(_tele_tls, "depth", 0)
+        if _telemetry._active and outermost:
+            # per-signature compile/cache accounting + the recompilation
+            # detector (shape-polymorphism pitfall: every new signature
+            # costs a full XLA compile on TPU)
+            _telemetry.inc("cached_graph.cache_miss_total" if need_trace
+                           else "cached_graph.cache_hit_total",
+                           block=type(self.block).__name__)
+        _tele_tls.depth = getattr(_tele_tls, "depth", 0) + 1
         try:
             for _attempt in (0, 1):
                 acquired_write = need_trace
@@ -436,6 +456,10 @@ class _CachedGraph:
                     self._rw.acquire_write()
                 else:
                     self._rw.acquire_read()
+                _t_trace = (time.perf_counter()
+                            if acquired_write and outermost
+                            and _telemetry._active
+                            else None)
                 try:
                     trainable_raws = {n: self.params[n]._data._data
                                       for n in self.trainable}
@@ -463,6 +487,11 @@ class _CachedGraph:
                         # out-tree: force a clean re-trace
                         self._jit.clear_cache()
                         raise _SignatureEvicted(sig_key)
+                    if _t_trace is not None:
+                        _telemetry.note_compile(
+                            self.block, type(self.block).__name__,
+                            time.perf_counter() - _t_trace,
+                            signatures=len(self._signatures))
                     break
                 except _SignatureEvicted:
                     if _attempt:
@@ -478,6 +507,7 @@ class _CachedGraph:
                     else:
                         self._rw.release_read()
         finally:
+            _tele_tls.depth -= 1
             with self._sig_lock:
                 self._inflight[sig_key] -= 1
                 if not self._inflight[sig_key]:

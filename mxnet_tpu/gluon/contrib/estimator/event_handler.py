@@ -142,7 +142,65 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
     def epoch_end(self, estimator, *args, **kwargs):
         msg = " ".join(f"{n}={v:.4f}" for m in self.metrics
                        for n, v in m.get_name_value())
+        from .... import telemetry
+        if telemetry.active():
+            tele = telemetry.summary_line()
+            if tele:
+                msg = (msg + " | " if msg else "") + tele
         self.logger.info("[epoch end] %s", msg)
+
+
+class TelemetryHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
+    """Drives an ``mx.telemetry.TrainingTelemetry`` reporter over the fit
+    loop: per-batch JSONL step records (with the first loss value when the
+    fit loop passes one), an epoch marker per epoch, and the final run
+    report (kept on ``self.run_report`` after training).  Constructing the
+    reporter at ``train_begin`` enables the metrics registry, so adding
+    this one handler turns on the whole observability layer for a run.
+
+    priority inf: runs last within each event, after the optimizer step
+    and metric updates it is reporting on."""
+
+    def __init__(self, path=None, interval=None, run_id=None,
+                 priority=float("inf")):
+        self.path = path
+        self.interval = interval
+        self.run_id = run_id
+        self.priority = priority
+        self.reporter = None
+        self.run_report = None
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from .... import telemetry
+        self.current_epoch = 0
+        self.reporter = telemetry.TrainingTelemetry(
+            path=self.path, interval=self.interval, run_id=self.run_id)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self.reporter is None:
+            return
+        fields = {}
+        loss = kwargs.get("loss")
+        if loss is not None:
+            if isinstance(loss, (list, tuple)):
+                loss = loss[0] if loss else None
+            try:
+                fields["loss"] = float(
+                    loss.mean().item() if getattr(loss, "ndim", 0) else loss)
+            except (TypeError, ValueError):
+                pass
+        self.reporter.step(**fields)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.reporter is not None:
+            self.reporter.mark("epoch", epoch=self.current_epoch)
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.reporter is not None:
+            self.run_report = self.reporter.close()
+            self.reporter = None
 
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):

@@ -23,6 +23,7 @@ import numpy as onp
 from ... import config as _config
 from ... import fault as _fault
 from ... import numpy as _np
+from ... import telemetry as _telemetry
 from ...numpy.multiarray import ndarray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -296,7 +297,19 @@ class DataLoader:
                     pending.append(pool.submit(task, next(it)))
                 except StopIteration:
                     pass
-                yield unwrap(fut.result(timeout=self._timeout))
+                if _telemetry._active:
+                    # batch wait = how long the training loop starves on
+                    # input; queue depth = prefetch headroom at that moment
+                    _telemetry.set_gauge("dataloader.queue_depth",
+                                         len(pending) + 1)
+                    _t0 = time.perf_counter()
+                    result = fut.result(timeout=self._timeout)
+                    _telemetry.observe("dataloader.wait_seconds",
+                                       time.perf_counter() - _t0)
+                    _telemetry.inc("dataloader.batches_total")
+                    yield unwrap(result)
+                else:
+                    yield unwrap(fut.result(timeout=self._timeout))
         finally:
             # abandoned mid-epoch (break / islice / GC): in-flight batches
             # carry shm blocks only _from_shm would unlink — drain them
@@ -340,7 +353,16 @@ class DataLoader:
                             todo.appendleft(indices)
                             raise
                     fut, _ = inflight[0]
-                    spec = fut.result(timeout=self._timeout)
+                    if _telemetry._active:
+                        _telemetry.set_gauge("dataloader.queue_depth",
+                                             len(inflight))
+                        _t0 = time.perf_counter()
+                        spec = fut.result(timeout=self._timeout)
+                        _telemetry.observe("dataloader.wait_seconds",
+                                           time.perf_counter() - _t0)
+                        _telemetry.inc("dataloader.batches_total")
+                    else:
+                        spec = fut.result(timeout=self._timeout)
                     inflight.popleft()
                 except (BrokenProcessPool, cf.BrokenExecutor,
                         cf.TimeoutError, TimeoutError):
@@ -353,6 +375,8 @@ class DataLoader:
                         yield from self._threaded_remainder(todo)
                         return
                     _fault.record("dataloader.worker_respawn")
+                    if _telemetry._active:
+                        _telemetry.inc("dataloader.respawn_total")
                     time.sleep(backoff * (2 ** (crashes - 1)))
                     continue
                 yield _from_shm(spec)

@@ -11,12 +11,14 @@ python thread never blocks, matching the reference's engine overlap.
 """
 from __future__ import annotations
 
-import functools
+import math
+import time
 
 import jax
 import jax.numpy as jnp
 
 from .. import optimizer as opt
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..kvstore import create as create_kvstore, KVStoreBase
 from .parameter import Parameter
@@ -134,6 +136,7 @@ class Trainer:
         self._contains_sparse_grad = False
         self._fused_update = None
         self._finite_check = None
+        self._grad_norm_fn = None
         #: steps skipped by the non-finite grad guard (see step())
         self.nonfinite_steps = 0
 
@@ -236,6 +239,20 @@ class Trainer:
                     [jnp.isfinite(g).all() for g in gs])))
         return bool(self._finite_check(raws))
 
+    def _grad_norm(self):
+        """Global gradient L2 norm as ONE fused XLA reduction (telemetry:
+        the per-step health signal operators watch for divergence)."""
+        raws = [p.grad()._data for p in self._params
+                if p.grad_req != "null" and p._data is not None]
+        if not raws:
+            return 0.0
+        if self._grad_norm_fn is None:
+            self._grad_norm_fn = jax.jit(
+                lambda gs: jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in gs)))
+        return float(self._grad_norm_fn(raws))
+
     def _skip_step(self):
         """Count and absorb a non-finite step: weights untouched, the AMP
         scale backs off, accumulated ('add') grads are cleared so the
@@ -243,6 +260,8 @@ class Trainer:
         from .. import fault
         self.nonfinite_steps += 1
         fault.record("trainer.nonfinite_skip")
+        if _telemetry._active:
+            _telemetry.inc("trainer.nonfinite_total")
         scaler = getattr(self, "_amp_loss_scaler", None)
         if scaler is not None:
             scaler.update_scale(True)
@@ -260,6 +279,22 @@ class Trainer:
         takes the same decision; with ``update_on_kvstore`` the optimizer
         runs inside the push, so there the local gradient is checked
         before pushing."""
+        if not _telemetry._active:
+            return self._step_impl(batch_size, ignore_stale_grad)
+        # metrics wrapper: wall time, step count, and the global grad norm
+        # (observed pre-update so a skipped step still reports what blew up)
+        t0 = time.perf_counter()
+        norm = self._grad_norm()
+        if math.isfinite(norm):
+            _telemetry.observe("trainer.grad_norm", norm)
+        try:
+            return self._step_impl(batch_size, ignore_stale_grad)
+        finally:
+            _telemetry.inc("trainer.steps_total")
+            _telemetry.observe("trainer.step_seconds",
+                               time.perf_counter() - t0)
+
+    def _step_impl(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size

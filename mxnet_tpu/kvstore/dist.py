@@ -44,6 +44,7 @@ import jax.numpy as jnp
 
 from .. import config as _config
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 from ..base import MXNetError, get_env
 from ..numpy.multiarray import ndarray, _wrap
 from .kvstore import KVStore
@@ -160,14 +161,29 @@ class DistKVStore(KVStore):
 
     def _merged(self, k, vs):
         """Local device reduce, optional quantization, cross-process sum
-        (under the collective watchdog when engaged)."""
+        (under the collective watchdog when engaged).  Telemetry times the
+        cross-process phase and counts the payload actually shipped (the
+        post-quantization bytes, so compression shows up in the metric)."""
         merged = self._reduce(vs)
         if self._gc is not None:
             merged = _wrap(self._gc.quantize(k, merged._data))
-        if not self._watchdog_engaged():
-            return self._allreduce(merged)
-        return self._timed_wait("allreduce", k,
-                                lambda: self._waited_allreduce(merged))
+        if not _telemetry._active:
+            if not self._watchdog_engaged():
+                return self._allreduce(merged)
+            return self._timed_wait("allreduce", k,
+                                    lambda: self._waited_allreduce(merged))
+        t0 = time.perf_counter()
+        try:
+            if not self._watchdog_engaged():
+                return self._allreduce(merged)
+            return self._timed_wait("allreduce", k,
+                                    lambda: self._waited_allreduce(merged))
+        finally:
+            _telemetry.observe("kvstore.collective_seconds",
+                               time.perf_counter() - t0, op="allreduce")
+            _telemetry.inc("kvstore.collective_total", op="allreduce")
+            _telemetry.inc("kvstore.payload_bytes_total",
+                           int(getattr(merged._data, "nbytes", 0)))
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
@@ -258,12 +274,19 @@ class DistAsyncKVStore(DistKVStore):
                 out = self._waited_allreduce(self._store[k])
                 return getattr(out, "_data", out)
 
+            t0 = time.perf_counter()
             summed = self._timed_wait(
                 f"reconcile#{self._reconcile_seq}", k, run,
                 hint="Every process must pull the same keys in the same "
                      "order the same number of times (SPMD collective "
                      "constraint); a data-dependent pull schedule "
                      "deadlocks here — align the pull schedule.")
+            if _telemetry._active:
+                _telemetry.observe("kvstore.collective_seconds",
+                                   time.perf_counter() - t0, op="reconcile")
+                _telemetry.inc("kvstore.collective_total", op="reconcile")
+                _telemetry.inc("kvstore.payload_bytes_total",
+                               int(getattr(summed, "nbytes", 0)))
             avg = summed / self._nprocs
             self._store[k]._rebind(avg.astype(self._store[k].dtype))
         return self._store[k]

@@ -27,6 +27,7 @@ import numpy as onp
 from .. import autograd
 from .. import engine
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 from .._jax_compat import enable_x64 as _enable_x64
 from ..base import MXNetError, np_dtype
 from ..context import Context, current_context
@@ -173,6 +174,10 @@ def _invoke(prim, args, kwargs=None, name=None, x64=False):
     # AMP scaler must absorb (docs/FAULT_TOLERANCE.md)
     if _fault._active and _fault.fire("invoke.nan_output"):
         _nan_corrupt(out)
+    # telemetry hook, same disabled cost contract as the fault hook (the
+    # CI telemetry stage bounds it at <2% of a tight eager loop)
+    if _telemetry._active:
+        _telemetry.inc("invoke.ops_total")
     return out
 
 

@@ -37,9 +37,18 @@ def set_config(**kwargs):
 
 
 def set_state(state="stop", profile_process="worker"):
-    """'run' | 'stop' (reference: profiler.py set_state)."""
+    """'run' | 'stop' (reference: profiler.py set_state).
+
+    'run' also arms ``mx.telemetry`` when it is off, so one call captures
+    host spans, the device trace AND the metrics registry; 'stop' disarms
+    telemetry only if this bridge armed it (an explicit
+    ``telemetry.enable()`` survives profiler stop/start cycles)."""
+    from . import telemetry as _telemetry
     if state == "run":
         _state["running"] = True
+        if not _telemetry.active():
+            _telemetry.enable()
+            _state["telemetry_autostart"] = True
         tracedir = _config.get("tensorboard_dir")
         if tracedir:
             jax.profiler.start_trace(tracedir)
@@ -49,6 +58,8 @@ def set_state(state="stop", profile_process="worker"):
             jax.profiler.stop_trace()
             _state["device_trace_dir"] = None
         _state["running"] = False
+        if _state.pop("telemetry_autostart", False):
+            _telemetry.disable()
     else:
         raise MXNetError(f"unknown profiler state {state!r}")
 
@@ -64,6 +75,10 @@ def record_event(name, category, start_us, dur_us, args=None):
     (the reference's objects no-op the same way when unconfigured)."""
     if not _state["running"]:
         return
+    enclosing = current_scope()
+    if enclosing:
+        args = dict(args or {})
+        args.setdefault("scope", enclosing)
     with _lock:
         _events.append({"name": name, "cat": category, "ph": "X",
                         "ts": start_us, "dur": dur_us, "pid": os.getpid(),
@@ -104,8 +119,23 @@ def dump(finished=True, profile_process="worker"):
     return _config["filename"]
 
 
+#: dumps() sort keys -> aggregate-row field
+_SORT_KEYS = {"total": "total_ms", "avg": "avg_ms", "max": "max_ms",
+              "calls": "calls", "name": "name"}
+
+
 def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noqa: A002
-    """Aggregate text stats (reference: profiler.py dumps)."""
+    """Aggregate stats (reference: profiler.py dumps, which honored the
+    same format/sort_by/ascending knobs).  ``format='table'`` renders the
+    human-readable text; ``format='json'`` returns machine-readable
+    aggregate rows (name/calls/total_ms/avg_ms/max_ms) so dashboards and
+    tests stop re-parsing the table."""
+    if sort_by not in _SORT_KEYS:
+        raise MXNetError(f"dumps(sort_by={sort_by!r}): expected one of "
+                         f"{sorted(_SORT_KEYS)}")
+    if format not in ("table", "json"):
+        raise MXNetError(f"dumps(format={format!r}): expected 'table' "
+                         "or 'json'")
     with _lock:
         events = list(_events)
         if reset:
@@ -116,9 +146,20 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False):  # noq
         a[0] += 1
         a[1] += e["dur"] / 1000.0
         a[2] = max(a[2], e["dur"] / 1000.0)
-    lines = [f"{'Name':40s} {'Calls':>8s} {'Total(ms)':>12s} {'Max(ms)':>10s}"]
-    for name, (calls, total, mx) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
-        lines.append(f"{name:40.40s} {calls:8d} {total:12.3f} {mx:10.3f}")
+    rows = [{"name": name, "calls": calls,
+             "total_ms": round(total, 6),
+             "avg_ms": round(total / calls, 6) if calls else 0.0,
+             "max_ms": round(mx, 6)}
+            for name, (calls, total, mx) in agg.items()]
+    rows.sort(key=lambda r: r[_SORT_KEYS[sort_by]], reverse=not ascending)
+    if format == "json":
+        return json.dumps({"aggregates": rows})
+    lines = [f"{'Name':40s} {'Calls':>8s} {'Total(ms)':>12s} "
+             f"{'Avg(ms)':>10s} {'Max(ms)':>10s}"]
+    for r in rows:
+        lines.append(f"{r['name']:40.40s} {r['calls']:8d} "
+                     f"{r['total_ms']:12.3f} {r['avg_ms']:10.3f} "
+                     f"{r['max_ms']:10.3f}")
     return "\n".join(lines)
 
 
@@ -199,13 +240,35 @@ class Event:
             self._t0 = None
 
 
+_scope_tls = threading.local()
+
+
+def current_scope():
+    """Innermost active ``scope()`` name on this thread ('' outside any)."""
+    stack = getattr(_scope_tls, "stack", None)
+    return stack[-1] if stack else ""
+
+
 @contextlib.contextmanager
-def scope(name="<unk>:", append_mode=False):  # noqa: ARG001
+def scope(name="<unk>:", append_mode=False):
     """Profiler scope naming everything recorded inside it (reference
     profiler.py scope — the GPU memory profiler used it to tag
-    allocations; here spans carry the scope as a category suffix)."""
-    with span(name.rstrip(":"), "scope"):
-        yield
+    allocations).  Events recorded inside carry the scope in their args;
+    ``append_mode=True`` nests under the enclosing scope
+    (``outer:inner``) instead of replacing it, matching the reference's
+    append semantics."""
+    base = name.rstrip(":")
+    stack = getattr(_scope_tls, "stack", None)
+    if stack is None:
+        stack = _scope_tls.stack = []
+    if append_mode and stack:
+        base = stack[-1] + ":" + base
+    stack.append(base)
+    try:
+        with span(base, "scope"):
+            yield
+    finally:
+        stack.pop()
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):

@@ -1,0 +1,513 @@
+"""mx.telemetry — framework-wide always-on metrics + training run reports.
+
+Reference parity: the reference's engine-integrated profiler
+(src/profiler/profiler.h) answers "where did the time go?" per op; it has
+no always-on layer answering "why is this RUN slow or flaky?".  On a
+compiler-backed TPU stack the dominant production pathologies are
+invisible to a span profiler: XLA recompilation storms from
+shape-polymorphic hybridized blocks, dataloader stalls, collective
+latency, and steps silently skipped by the resilience layer
+(docs/FAULT_TOLERANCE.md).  This module is the metrics plane for those:
+
+- **Registry**: process-wide counters, gauges and bucketed histograms,
+  lock-protected, optionally labelled (low-cardinality labels only —
+  block names, collective ops, fault event names).
+- **Near-zero disabled cost**: mirroring ``fault.py``, every
+  instrumentation site in the stack gates on one module-attribute read
+  (``_active``); with telemetry off (the default) a hook is a single
+  ``if`` on a False attribute.  The CI ``telemetry`` stage enforces the
+  <2% overhead budget on a tight eager-op loop
+  (benchmark/telemetry_overhead.py).
+- **Wired subsystems**: cached-graph compile/cache-hit accounting +
+  recompilation detector (gluon/block.py), dataloader batch wait / queue
+  depth / respawns (gluon/data/dataloader.py), trainer step time /
+  grad-norm / non-finite skips (gluon/trainer.py), per-collective latency
+  and payload bytes (kvstore/dist.py), and every ``mx.fault`` event
+  (injections and recoveries mirror into ``fault.events_total``).
+- **Recompilation detector**: one hybridized block re-tracing more than
+  ``telemetry.recompile_limit`` times is the classic TPU
+  shape-polymorphism pitfall (a new XLA compile per input signature); the
+  detector emits one structured :class:`RecompileWarning` per block,
+  carrying the block name and compile count.
+- **Reporters**: ``exposition()`` renders a Prometheus-style text dump;
+  :class:`TrainingTelemetry` emits periodic JSONL step records and a
+  final structured run report, and bridges emitted records into
+  ``mx.profiler`` events when the profiler runs.  ``profiler.set_state
+  ("run")`` auto-enables telemetry, so one switch captures everything.
+
+Enable via ``mx.telemetry.enable()`` or the ``MXNET_TELEMETRY`` env alias
+of the ``telemetry.enable`` config knob (read at import, like
+``MXNET_FAULT_SPEC``).
+"""
+from __future__ import annotations
+
+import bisect
+import contextlib
+import json
+import os
+import re
+import threading
+import time
+
+from . import config as _config
+from .base import MXNetError
+
+__all__ = ["enable", "disable", "configure", "active", "inc", "set_gauge",
+           "observe", "timed", "declare_metric", "note_compile", "counters",
+           "summary_line", "snapshot", "exposition", "reset",
+           "RecompileWarning", "TrainingTelemetry", "CATALOG"]
+
+_lock = threading.Lock()
+#: hot-path gate — instrumentation sites read this one attribute; False
+#: keeps every hook a single no-op branch (same design as fault._active)
+_active = False
+
+_counters: dict[tuple[str, tuple], float] = {}
+_gauges: dict[tuple[str, tuple], float] = {}
+_hists: dict[tuple[str, tuple], "_Hist"] = {}
+
+# -- metric catalog ---------------------------------------------------------
+
+#: seconds-scale latencies (compile, step, batch wait, collectives)
+TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                float("inf"))
+#: wide-dynamic-range magnitudes (gradient norms)
+MAGNITUDE_BUCKETS = tuple(10.0 ** e for e in range(-4, 7)) + (float("inf"),)
+
+_Kind = str  # "counter" | "gauge" | "histogram"
+CATALOG: dict[str, tuple[_Kind, str, tuple | None]] = {}
+
+
+def declare_metric(name, kind, doc, buckets=None):
+    """Register a metric in the catalog (drives exposition() HELP/TYPE
+    lines and docs/OBSERVABILITY.md's table).  Undeclared names are
+    auto-registered on first use with a generic doc."""
+    if kind not in ("counter", "gauge", "histogram"):
+        raise MXNetError(f"unknown metric kind {kind!r}")
+    with _lock:
+        CATALOG.setdefault(name, (kind, doc,
+                                  tuple(buckets) if buckets else None))
+    return name
+
+
+declare_metric("invoke.ops_total", "counter",
+               "eager ops dispatched through _invoke")
+declare_metric("cached_graph.compile_total", "counter",
+               "XLA trace+compiles of hybridized blocks, by block class")
+declare_metric("cached_graph.compile_seconds", "histogram",
+               "wall time of one hybridized trace+compile",
+               buckets=TIME_BUCKETS)
+declare_metric("cached_graph.cache_hit_total", "counter",
+               "compiled-forward replays served from the signature cache")
+declare_metric("cached_graph.cache_miss_total", "counter",
+               "calls whose signature required a fresh trace")
+declare_metric("cached_graph.signatures", "gauge",
+               "live signatures in a block's executable cache")
+declare_metric("cached_graph.recompile_warnings_total", "counter",
+               "blocks flagged by the recompilation detector")
+declare_metric("dataloader.wait_seconds", "histogram",
+               "time the training loop blocked waiting for the next batch",
+               buckets=TIME_BUCKETS)
+declare_metric("dataloader.queue_depth", "gauge",
+               "in-flight prefetch tasks when the loop asked for a batch")
+declare_metric("dataloader.batches_total", "counter",
+               "batches produced by worker-backed loaders")
+declare_metric("dataloader.respawn_total", "counter",
+               "worker-pool respawns after a crash or missed heartbeat")
+declare_metric("trainer.step_seconds", "histogram",
+               "wall time of Trainer.step (allreduce + update)",
+               buckets=TIME_BUCKETS)
+declare_metric("trainer.steps_total", "counter",
+               "optimizer steps applied")
+declare_metric("trainer.grad_norm", "histogram",
+               "global gradient L2 norm per step (finite steps only)",
+               buckets=MAGNITUDE_BUCKETS)
+declare_metric("trainer.nonfinite_total", "counter",
+               "steps skipped by the non-finite gradient guard")
+declare_metric("kvstore.collective_seconds", "histogram",
+               "latency of one cross-process collective, by op",
+               buckets=TIME_BUCKETS)
+declare_metric("kvstore.collective_total", "counter",
+               "cross-process collectives issued, by op")
+declare_metric("kvstore.payload_bytes_total", "counter",
+               "bytes moved through cross-process collectives, by op")
+declare_metric("fault.events_total", "counter",
+               "mx.fault injections and recovery events, by event")
+declare_metric("train.iter_seconds", "histogram",
+               "full training-loop iteration time (TrainingTelemetry.step)",
+               buckets=TIME_BUCKETS)
+declare_metric("telemetry.records_total", "counter",
+               "JSONL records emitted by TrainingTelemetry")
+
+
+# -- switches ---------------------------------------------------------------
+
+def enable(on=True):
+    """Turn the registry on/off.  Off (the default) every instrumentation
+    hook in the stack is one module-attribute read."""
+    global _active
+    _active = bool(on)
+    return _active
+
+
+def disable():
+    enable(False)
+
+
+def configure():
+    """Re-read the ``telemetry.enable`` config knob / ``MXNET_TELEMETRY``
+    env alias."""
+    return enable(_config.get("telemetry.enable"))
+
+
+def active():
+    return _active
+
+
+# -- recording --------------------------------------------------------------
+
+def _labels_key(labels):
+    return tuple(sorted(labels.items()))
+
+
+def _auto_register(name, kind):
+    existing = CATALOG.get(name)
+    if existing is None:
+        CATALOG[name] = (kind, "(auto-registered)", None)
+    elif existing[0] != kind:
+        raise MXNetError(
+            f"metric {name!r} is a {existing[0]}, not a {kind}")
+    return CATALOG[name]
+
+
+def inc(name, n=1, **labels):
+    """Add ``n`` to a counter (no-op while disabled)."""
+    if not _active:
+        return
+    key = (name, _labels_key(labels))
+    with _lock:
+        _auto_register(name, "counter")
+        _counters[key] = _counters.get(key, 0) + n
+
+
+def set_gauge(name, value, **labels):
+    """Set a gauge to ``value`` (no-op while disabled)."""
+    if not _active:
+        return
+    key = (name, _labels_key(labels))
+    with _lock:
+        _auto_register(name, "gauge")
+        _gauges[key] = value
+
+
+class _Hist:
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.sum += value
+        self.count += 1
+
+
+def observe(name, value, **labels):
+    """Record one sample into a bucketed histogram (no-op while
+    disabled).  Buckets come from the catalog declaration; undeclared
+    histograms get TIME_BUCKETS."""
+    if not _active:
+        return
+    key = (name, _labels_key(labels))
+    with _lock:
+        spec = _auto_register(name, "histogram")
+        h = _hists.get(key)
+        if h is None:
+            h = _hists[key] = _Hist(spec[2] or TIME_BUCKETS)
+        h.observe(value)
+
+
+@contextlib.contextmanager
+def timed(name, **labels):
+    """Context manager observing its wall time into histogram ``name``;
+    free when disabled."""
+    if not _active:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe(name, time.perf_counter() - t0, **labels)
+
+
+def reset():
+    """Drop every recorded value (the catalog and enabled state stay)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+
+
+# -- recompilation detector -------------------------------------------------
+
+class RecompileWarning(UserWarning):
+    """One hybridized block keeps re-tracing: the TPU shape-polymorphism
+    pitfall (every new input shape/dtype signature costs a full XLA
+    compile).  Structured: ``block`` (class name), ``compiles`` (count so
+    far), ``limit`` (the tripped threshold)."""
+
+    def __init__(self, block, compiles, limit):
+        self.block = block
+        self.compiles = compiles
+        self.limit = limit
+        super().__init__(
+            f"hybridized block {block!r} recompiled {compiles} times "
+            f"(telemetry.recompile_limit={limit}): each distinct input "
+            "shape/dtype signature triggers a fresh XLA trace+compile. "
+            "Pad or bucket input shapes (drop_last/fixed seq-len), or "
+            "raise the limit if the signature set is genuinely bounded.")
+
+
+def note_compile(owner, label, seconds, signatures=None):
+    """Account one XLA trace+compile of a hybridized block.
+
+    ``owner`` is the Block instance — the per-block compile count and the
+    warn-once latch live on it, so the detector fires exactly once per
+    block no matter how many _CachedGraphs (train/eval) it owns.
+    """
+    if not _active:
+        return
+    inc("cached_graph.compile_total", block=label)
+    observe("cached_graph.compile_seconds", seconds, block=label)
+    if signatures is not None:
+        set_gauge("cached_graph.signatures", signatures, block=label)
+    limit = _config.get("telemetry.recompile_limit")
+    with _lock:
+        n = owner.__dict__.get("_telemetry_compiles", 0) + 1
+        owner.__dict__["_telemetry_compiles"] = n
+        fire = (n > limit
+                and not owner.__dict__.get("_telemetry_recompile_warned"))
+        if fire:
+            owner.__dict__["_telemetry_recompile_warned"] = True
+    if fire:
+        inc("cached_graph.recompile_warnings_total")
+        import warnings
+        from . import log as _log
+        w = RecompileWarning(label, n, limit)
+        warnings.warn(w, stacklevel=2)
+        _log.get_logger("mxnet_tpu.telemetry").warning("%s", w)
+
+
+# -- readers ----------------------------------------------------------------
+
+def _render(name, labels, extra=()):
+    items = list(labels) + list(extra)
+    if not items:
+        return name
+    return name + "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
+def _le(bound):
+    return "+Inf" if bound == float("inf") else repr(float(bound))
+
+
+def counters(prefix=None, aggregate=False):
+    """Flat dict of counters.  ``aggregate=True`` sums away labels (one
+    value per metric name) — what LoggingHandler's epoch summary pulls."""
+    out = {}
+    with _lock:
+        for (name, labels), v in _counters.items():
+            if prefix and not name.startswith(prefix):
+                continue
+            if aggregate:
+                out[name] = out.get(name, 0) + v
+            else:
+                out[_render(name, labels)] = v
+    return dict(sorted(out.items()))
+
+
+def summary_line():
+    """One-line 'k=v k=v' digest of every counter (labels aggregated) for
+    log lines; '' when nothing was recorded."""
+    snap = counters(aggregate=True)
+    if not snap:
+        return ""
+    return " ".join(f"{k}={v:g}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in snap.items())
+
+
+def snapshot():
+    """JSON-safe snapshot of every metric: counters/gauges as rendered
+    name -> value, histograms as {buckets(le->cumulative), sum, count}."""
+    with _lock:
+        counter_snap = {_render(n, ls): v for (n, ls), v in _counters.items()}
+        gauge_snap = {_render(n, ls): v for (n, ls), v in _gauges.items()}
+        hist_snap = {}
+        for (n, ls), h in _hists.items():
+            cum, acc = {}, 0
+            for bound, c in zip(h.buckets, h.counts):
+                acc += c
+                cum[_le(bound)] = acc
+            hist_snap[_render(n, ls)] = {
+                "buckets": cum, "sum": h.sum, "count": h.count}
+    return {"counters": dict(sorted(counter_snap.items())),
+            "gauges": dict(sorted(gauge_snap.items())),
+            "histograms": dict(sorted(hist_snap.items()))}
+
+
+def _sanitize(name):
+    return "mxnet_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def exposition():
+    """Prometheus-style text exposition of every recorded metric (HELP/
+    TYPE from the catalog)."""
+    with _lock:
+        by_name: dict[str, list] = {}
+        for (n, ls), v in _counters.items():
+            by_name.setdefault(n, []).append((ls, v))
+        for (n, ls), v in _gauges.items():
+            by_name.setdefault(n, []).append((ls, v))
+        for (n, ls), h in _hists.items():
+            by_name.setdefault(n, []).append((ls, h))
+        catalog = dict(CATALOG)
+    lines = []
+    order = [n for n in catalog if n in by_name] + \
+        sorted(n for n in by_name if n not in catalog)
+    for name in order:
+        kind, doc, _ = catalog.get(name, ("counter", "(auto)", None))
+        full = _sanitize(name)
+        lines.append(f"# HELP {full} {doc}")
+        lines.append(f"# TYPE {full} {kind}")
+        for labels, v in sorted(by_name[name]):
+            if isinstance(v, _Hist):
+                acc = 0
+                for bound, c in zip(v.buckets, v.counts):
+                    acc += c
+                    le = _render("", labels, (("le", _le(bound)),))
+                    lines.append(f"{full}_bucket{le} {acc}")
+                lines.append(f"{full}_sum{_render('', labels)} {v.sum:g}")
+                lines.append(f"{full}_count{_render('', labels)} {v.count}")
+            else:
+                vv = f"{v:g}" if isinstance(v, float) else str(v)
+                lines.append(f"{full}{_render('', labels)} {vv}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- structured training run reports ---------------------------------------
+
+class TrainingTelemetry:
+    """Structured training-run reporter over the registry.
+
+    - ``step()`` once per training iteration: observes iteration time and
+      every ``interval`` steps emits one JSONL record (cumulative counters
+      + caller fields).  When ``mx.profiler`` is running each emitted
+      record also lands as a profiler event, so one trace holds spans AND
+      run metrics.
+    - ``mark()`` emits an ad-hoc record (epoch boundaries etc.).
+    - ``close()`` emits and returns the final run report: step count,
+      wall time, and the full metric snapshot (histograms included) —
+      the machine-readable answer to "what did this run do?".
+
+    ``path=None`` keeps records in memory only (``.records``); a path
+    appends JSONL lines (one json object per line; ``read()`` parses them
+    back).  Constructing a reporter enables the registry; ``close()``
+    restores the previous enabled state.
+    """
+
+    def __init__(self, path=None, interval=None, run_id=None):
+        self._path = path if path is not None \
+            else (_config.get("telemetry.jsonl") or None)
+        self._interval = max(1, int(
+            interval if interval is not None
+            else _config.get("telemetry.step_interval")))
+        self.run_id = run_id or f"run-{os.getpid()}"
+        self.records = []
+        self._file = None
+        self._steps = 0
+        self._t0 = time.time()
+        self._last = time.perf_counter()
+        self._closed = False
+        self._was_active = _active
+        enable()
+        self._emit({"type": "run_begin", "run_id": self.run_id,
+                    "time": self._t0, "pid": os.getpid()})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _emit(self, record):
+        inc("telemetry.records_total")
+        self.records.append(record)
+        if self._path:
+            if self._file is None:
+                self._file = open(self._path, "a")
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        from . import profiler as _profiler
+        if _profiler.is_running():
+            _profiler.record_event(
+                f"telemetry.{record['type']}", "telemetry",
+                time.perf_counter_ns() // 1000, 0,
+                {k: v for k, v in record.items()
+                 if isinstance(v, (int, float, str))})
+
+    def step(self, step=None, **fields):
+        """Record one training iteration; emit a JSONL step record every
+        ``interval`` calls.  ``fields`` (loss, lr, ...) ride along."""
+        self._steps += 1
+        now = time.perf_counter()
+        iter_s = now - self._last
+        self._last = now
+        observe("train.iter_seconds", iter_s)
+        n = self._steps if step is None else step
+        if self._steps % self._interval == 0:
+            self._emit({"type": "step", "run_id": self.run_id, "step": n,
+                        "time": time.time(), "iter_seconds": iter_s,
+                        **fields, "counters": counters()})
+
+    def mark(self, kind, **fields):
+        """Emit an ad-hoc record (e.g. ``mark("epoch", epoch=3)``)."""
+        self._emit({"type": kind, "run_id": self.run_id,
+                    "time": time.time(), **fields})
+
+    def report(self):
+        """The final run report dict (also what ``close()`` emits)."""
+        return {"type": "run_report", "run_id": self.run_id,
+                "steps": self._steps,
+                "wall_seconds": time.time() - self._t0,
+                "metrics": snapshot()}
+
+    def close(self):
+        """Emit the run report, close the JSONL file, restore the
+        registry's previous enabled state; returns the report."""
+        if self._closed:
+            return self._report
+        self._report = self.report()
+        self._emit(self._report)
+        self._closed = True
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        enable(self._was_active)
+        return self._report
+
+    @staticmethod
+    def read(path):
+        """Parse a JSONL file written by a reporter -> list of records."""
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+# arm from the environment at import (MXNET_TELEMETRY=1), mirroring
+# fault.py, so spawned workers and plain scripts inherit the switch
+if _config.get("telemetry.enable"):
+    _active = True

@@ -130,3 +130,65 @@ def test_gpu_memory_info():
     else:
         free, total = mx.context.gpu_memory_info(0)
         assert 0 < free <= total
+
+
+def test_scope_append_mode_and_event_tagging():
+    _reset()
+    profiler.set_state("run")
+    try:
+        with profiler.scope("outer:"):
+            assert profiler.current_scope() == "outer"
+            with profiler.scope("inner:", append_mode=True):
+                assert profiler.current_scope() == "outer:inner"
+                _ = mx.np.ones((4, 4)) + 1
+            with profiler.scope("replaced:"):  # append_mode=False replaces
+                assert profiler.current_scope() == "replaced"
+        assert profiler.current_scope() == ""
+    finally:
+        profiler.set_state("stop")
+    names = [e["name"] for e in profiler._events]
+    assert "outer:inner" in names and "replaced" in names
+    # op events recorded inside a scope carry it in their args
+    tagged = [e for e in profiler._events
+              if e["args"].get("scope") == "outer:inner"
+              and e["cat"] == "operator"]
+    assert tagged, profiler._events
+
+
+def test_dumps_json_and_sort_by():
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+
+    _reset()
+    profiler.set_state("run")
+    a = mx.np.ones((8, 8))
+    for _ in range(3):
+        _ = mx.np.matmul(a, a)
+    _ = a + a
+    profiler.set_state("stop")
+
+    out = json.loads(profiler.dumps(format="json"))
+    rows = {r["name"]: r for r in out["aggregates"]}
+    assert rows["matmul"]["calls"] == 3
+    assert rows["matmul"]["total_ms"] >= rows["matmul"]["max_ms"]
+    # total/avg/max are rounded independently: compare with abs slack
+    assert rows["matmul"]["avg_ms"] == pytest.approx(
+        rows["matmul"]["total_ms"] / 3, abs=1e-5)
+
+    by_name = json.loads(profiler.dumps(format="json", sort_by="name",
+                                        ascending=True))["aggregates"]
+    names = [r["name"] for r in by_name]
+    assert names == sorted(names)
+    by_calls = json.loads(profiler.dumps(format="json",
+                                         sort_by="calls"))["aggregates"]
+    assert by_calls[0]["name"] == "matmul"
+
+    table = profiler.dumps()  # default stays the text table
+    assert "Avg(ms)" in table and "matmul" in table
+
+    with pytest.raises(MXNetError, match="sort_by"):
+        profiler.dumps(sort_by="bogus")
+    with pytest.raises(MXNetError, match="format"):
+        profiler.dumps(format="yaml")
+    profiler._events.clear()

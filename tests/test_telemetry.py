@@ -1,0 +1,465 @@
+"""mx.telemetry — metrics registry, recompilation detector, run reports
+(docs/OBSERVABILITY.md).
+
+The contract under test: disabled hooks are strict no-ops (the CI
+`telemetry` stage additionally bounds their cost at <2% of a tight eager
+loop, benchmark/telemetry_overhead.py); enabled, every wired subsystem
+lands live values in counters()/exposition() and the TrainingTelemetry
+JSONL run report.
+"""
+import json
+import threading
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import DataLoader
+
+
+class _SynthDataset:
+    """Picklable (spawn workers) linearly-separable classification set."""
+
+    def __init__(self, n=128, dim=16, classes=3):
+        rs = onp.random.RandomState(0)
+        self.x = rs.rand(n, dim).astype(onp.float32)
+        w = rs.rand(dim, classes).astype(onp.float32)
+        self.y = (self.x @ w).argmax(axis=1).astype(onp.int32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    mx.config.reset()
+
+
+def _mlp(classes=3):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+# ---------------------------------------------------------------------------
+# registry basics
+# ---------------------------------------------------------------------------
+
+def test_disabled_hooks_are_noops():
+    assert not telemetry.active()
+    telemetry.inc("trainer.steps_total")
+    telemetry.set_gauge("dataloader.queue_depth", 3)
+    telemetry.observe("trainer.step_seconds", 0.1)
+    with telemetry.timed("trainer.step_seconds"):
+        pass
+    # instrumented subsystems run without recording anything
+    out = (mx.np.ones((2, 2)) * 3).asnumpy()
+    assert onp.isfinite(out).all()
+    assert telemetry.counters() == {}
+    assert telemetry.summary_line() == ""
+    assert telemetry.snapshot() == {
+        "counters": {}, "gauges": {}, "histograms": {}}
+    assert telemetry.exposition() == ""
+
+
+def test_counter_gauge_histogram_and_labels():
+    telemetry.enable()
+    telemetry.inc("kvstore.collective_total", op="allreduce")
+    telemetry.inc("kvstore.collective_total", 2, op="reconcile")
+    telemetry.set_gauge("dataloader.queue_depth", 4)
+    for v in (0.0002, 0.003, 2.0):
+        telemetry.observe("trainer.step_seconds", v)
+
+    flat = telemetry.counters()
+    assert flat['kvstore.collective_total{op="allreduce"}'] == 1
+    assert flat['kvstore.collective_total{op="reconcile"}'] == 2
+    agg = telemetry.counters(aggregate=True)
+    assert agg["kvstore.collective_total"] == 3
+    assert telemetry.counters(prefix="dataloader") == {}
+    assert "kvstore.collective_total=3" in telemetry.summary_line()
+
+    snap = telemetry.snapshot()
+    hist = snap["histograms"]["trainer.step_seconds"]
+    assert hist["count"] == 3
+    assert hist["sum"] == pytest.approx(2.0032)
+    # cumulative buckets, json-safe "+Inf" key
+    assert hist["buckets"]["+Inf"] == 3
+    assert hist["buckets"]["0.00025"] == 1
+    json.dumps(snap)  # JSON-safe end to end
+
+
+def test_exposition_prometheus_format():
+    telemetry.enable()
+    telemetry.inc("trainer.steps_total", 5)
+    telemetry.observe("trainer.step_seconds", 0.002)
+    telemetry.set_gauge("dataloader.queue_depth", 2)
+    text = telemetry.exposition()
+    assert "# HELP mxnet_trainer_steps_total" in text
+    assert "# TYPE mxnet_trainer_steps_total counter" in text
+    assert "mxnet_trainer_steps_total 5" in text
+    assert "# TYPE mxnet_dataloader_queue_depth gauge" in text
+    assert "# TYPE mxnet_trainer_step_seconds histogram" in text
+    assert 'mxnet_trainer_step_seconds_bucket{le="+Inf"} 1' in text
+    assert "mxnet_trainer_step_seconds_sum 0.002" in text
+    assert "mxnet_trainer_step_seconds_count 1" in text
+    # cumulative: every later bucket >= earlier
+    counts = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+              if line.startswith("mxnet_trainer_step_seconds_bucket")]
+    assert counts == sorted(counts)
+
+
+def test_metric_kind_mismatch_raises():
+    telemetry.enable()
+    telemetry.inc("trainer.steps_total")
+    with pytest.raises(MXNetError, match="is a counter"):
+        telemetry.observe("trainer.steps_total", 1.0)
+    with pytest.raises(MXNetError, match="unknown metric kind"):
+        telemetry.declare_metric("x.y", "summary", "nope")
+
+
+def test_threaded_recording_is_exact():
+    telemetry.enable()
+    n_threads, per = 8, 500
+
+    def work():
+        for _ in range(per):
+            telemetry.inc("trainer.steps_total")
+            telemetry.observe("trainer.step_seconds", 0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert telemetry.counters()["trainer.steps_total"] == n_threads * per
+    hist = telemetry.snapshot()["histograms"]["trainer.step_seconds"]
+    assert hist["count"] == n_threads * per
+    assert hist["sum"] == pytest.approx(n_threads * per * 0.001)
+
+
+def test_timed_records_wall_time():
+    telemetry.enable()
+    with telemetry.timed("kvstore.collective_seconds", op="allreduce"):
+        pass
+    hist = telemetry.snapshot()["histograms"][
+        'kvstore.collective_seconds{op="allreduce"}']
+    assert hist["count"] == 1 and hist["sum"] >= 0
+
+
+def test_config_knob_and_configure():
+    mx.config.set("telemetry.enable", True)
+    assert telemetry.configure() is True
+    assert telemetry.active()
+    mx.config.set("telemetry.enable", False)
+    assert telemetry.configure() is False
+
+
+# ---------------------------------------------------------------------------
+# wired subsystems
+# ---------------------------------------------------------------------------
+
+def test_cached_graph_hit_miss_and_compile_metrics():
+    telemetry.enable()
+    net = _mlp()
+    net.hybridize()
+    x = mx.np.ones((4, 16))
+    net(x)  # eager deferred-init pass
+    net(x)  # first compiled call: traces the root block
+    net(x)  # replay from the signature cache
+    agg = telemetry.counters(aggregate=True)
+    assert agg.get("cached_graph.cache_hit_total", 0) >= 1
+    assert agg.get("cached_graph.cache_miss_total", 0) >= 1
+    assert agg.get("cached_graph.compile_total", 0) >= 1
+    hist = telemetry.snapshot()["histograms"][
+        'cached_graph.compile_seconds{block="HybridSequential"}']
+    assert hist["count"] >= 1 and hist["sum"] > 0
+
+
+def test_recompile_detector_fires_exactly_once():
+    mx.config.set("telemetry.recompile_limit", 2)
+    telemetry.enable()
+    net = _mlp()
+    net.hybridize()
+    net(mx.np.ones((2, 16)))  # eager deferred-init pass
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        # shape-polymorphic batch dim: every size is a fresh signature
+        for bs in (1, 2, 3, 4, 5, 6):
+            net(mx.np.ones((bs, 16)))
+    recompiles = [w for w in caught
+                  if issubclass(w.category, telemetry.RecompileWarning)]
+    assert len(recompiles) == 1, \
+        f"detector must warn exactly once, got {len(recompiles)}"
+    w = recompiles[0].message
+    assert w.block == "HybridSequential"
+    assert w.limit == 2 and w.compiles > 2
+    assert "recompile_limit" in str(w)
+    agg = telemetry.counters(aggregate=True)
+    assert agg["cached_graph.recompile_warnings_total"] == 1
+    assert agg["cached_graph.compile_total"] > 2
+
+
+def test_recompile_detector_quiet_under_limit():
+    telemetry.enable()  # default limit 8
+    net = _mlp()
+    net.hybridize()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for _ in range(4):
+            net(mx.np.ones((4, 16)))
+    assert not [w for w in caught
+                if issubclass(w.category, telemetry.RecompileWarning)]
+
+
+def test_dataloader_metrics():
+    telemetry.enable()
+    ds = _SynthDataset(64)
+    loader = DataLoader(ds, batch_size=8, num_workers=2)
+    batches = sum(1 for _ in loader)
+    assert batches == 8
+    agg = telemetry.counters(aggregate=True)
+    assert agg["dataloader.batches_total"] == batches
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["dataloader.wait_seconds"]["count"] == batches
+    assert "dataloader.queue_depth" in snap["gauges"]
+
+
+def test_trainer_step_metrics_and_nonfinite_guard():
+    mx.config.set("trainer.skip_nonfinite", True)
+    telemetry.enable()
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1e-2})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.np.ones((8, 16))
+    y = mx.np.zeros((8,))
+    for i in range(3):
+        if i == 1:
+            mx.fault.configure("invoke.nan_output:at=1,times=1")
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        mx.fault.clear()
+        loss.backward()
+        trainer.step(8)
+    agg = telemetry.counters(aggregate=True)
+    assert agg["trainer.steps_total"] == 3
+    assert agg["trainer.nonfinite_total"] >= 1
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["trainer.step_seconds"]["count"] == 3
+    # finite steps observed their global grad norm
+    assert snap["histograms"]["trainer.grad_norm"]["count"] >= 1
+    # the fault mirror carries the recovery event too
+    assert agg["fault.events_total"] >= 1
+
+
+def test_kvstore_collective_metrics():
+    telemetry.enable()
+    kv = mx.kv.create("dist_sync")
+    kv.init("a", mx.np.zeros((32,)))
+    out = mx.np.empty((32,))
+    kv.pushpull("a", mx.np.full((32,), 2.0), out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.full((32,), 2.0))
+    flat = telemetry.counters()
+    assert flat['kvstore.collective_total{op="allreduce"}'] >= 1
+    assert flat["kvstore.payload_bytes_total"] >= 32 * 4
+    hist = telemetry.snapshot()["histograms"][
+        'kvstore.collective_seconds{op="allreduce"}']
+    assert hist["count"] >= 1
+
+
+def test_fault_events_mirror_into_telemetry():
+    telemetry.enable()
+    mx.fault.record("trainer.nonfinite_skip")
+    mx.fault.record("checkpoint.rejected", 2)
+    flat = telemetry.counters()
+    assert flat['fault.events_total{event="trainer.nonfinite_skip"}'] == 1
+    assert flat['fault.events_total{event="checkpoint.rejected"}'] == 2
+
+
+# ---------------------------------------------------------------------------
+# TrainingTelemetry reporter
+# ---------------------------------------------------------------------------
+
+def test_training_telemetry_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with telemetry.TrainingTelemetry(path=path, interval=2,
+                                     run_id="t1") as rep:
+        assert telemetry.active()  # constructing the reporter enables
+        for i in range(4):
+            rep.step(loss=0.5 - 0.1 * i)
+        rep.mark("epoch", epoch=1)
+    records = telemetry.TrainingTelemetry.read(path)
+    kinds = [r["type"] for r in records]
+    assert kinds == ["run_begin", "step", "step", "epoch", "run_report"]
+    assert all(r["run_id"] == "t1" for r in records)
+    steps = [r for r in records if r["type"] == "step"]
+    assert steps[0]["step"] == 2 and steps[1]["step"] == 4
+    assert steps[0]["loss"] == pytest.approx(0.4)
+    assert "counters" in steps[0]
+    report = records[-1]
+    assert report["steps"] == 4
+    assert report["wall_seconds"] >= 0
+    assert report["metrics"]["histograms"]["train.iter_seconds"]["count"] == 4
+    # close() restored the registry's prior (disabled) state
+    assert not telemetry.active()
+
+
+def test_training_telemetry_restores_enabled_state():
+    telemetry.enable()
+    rep = telemetry.TrainingTelemetry(run_id="t2")
+    rep.step()
+    rep.close()
+    assert telemetry.active()  # was on before: stays on
+    assert rep.close() is rep.close()  # idempotent
+
+
+def test_telemetry_handler_drives_reporter(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import TelemetryHandler
+    path = str(tmp_path / "est.jsonl")
+    h = TelemetryHandler(path=path, run_id="est")
+    h.train_begin(None)
+    for _ in range(3):
+        h.batch_end(None, loss=mx.np.ones((4,)))
+    h.epoch_end(None)
+    h.train_end(None)
+    assert h.run_report["steps"] == 3
+    kinds = [r["type"] for r in telemetry.TrainingTelemetry.read(path)]
+    assert kinds == ["run_begin", "step", "step", "step", "epoch",
+                     "run_report"]
+    steps = [r for r in telemetry.TrainingTelemetry.read(path)
+             if r["type"] == "step"]
+    assert steps[0]["loss"] == pytest.approx(1.0)
+
+
+def test_logging_handler_appends_telemetry_summary(caplog):
+    import logging
+    from mxnet_tpu.gluon.contrib.estimator import LoggingHandler
+    telemetry.enable()
+    telemetry.inc("trainer.steps_total", 7)
+    h = LoggingHandler()
+    with caplog.at_level(logging.INFO, logger="estimator"):
+        h.epoch_end(None)
+    assert "trainer.steps_total=7" in caplog.text
+
+
+def test_profiler_run_auto_enables_telemetry():
+    from mxnet_tpu import profiler
+    assert not telemetry.active()
+    profiler.set_state("run")
+    try:
+        assert telemetry.active()
+    finally:
+        profiler.set_state("stop")
+    assert not telemetry.active()  # bridge-armed: stop disarms
+    # an explicit enable survives a profiler cycle
+    telemetry.enable()
+    profiler.set_state("run")
+    profiler.set_state("stop")
+    assert telemetry.active()
+
+
+def test_reporter_records_land_in_profiler(tmp_path):
+    from mxnet_tpu import profiler
+    profiler.set_state("run")
+    try:
+        rep = telemetry.TrainingTelemetry(run_id="prof", interval=1)
+        rep.step(loss=1.0)
+        rep.close()
+        rows = json.loads(profiler.dumps(format="json", reset=True))
+        names = {r["name"] for r in rows["aggregates"]}
+        assert "telemetry.step" in names
+        assert "telemetry.run_report" in names
+    finally:
+        profiler.set_state("stop")
+
+
+# ---------------------------------------------------------------------------
+# end to end: one training run covers every wired subsystem
+# ---------------------------------------------------------------------------
+
+def test_e2e_training_run_covers_all_subsystems(tmp_path):
+    mx.config.set("trainer.skip_nonfinite", True)
+    mx.config.set("telemetry.recompile_limit", 2)
+    mx.random.seed(0)
+
+    ds = _SynthDataset(128)
+    loader = DataLoader(ds, batch_size=16, num_workers=2)
+    net = _mlp()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-2}, kvstore="dist_sync")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    path = str(tmp_path / "e2e.jsonl")
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with telemetry.TrainingTelemetry(path=path, run_id="e2e") as rep:
+            for epoch in range(2):
+                for i, (data, label) in enumerate(loader):
+                    if epoch == 0 and i == 2:
+                        # poison this batch: the multiply is the injection's
+                        # first probed eager op, so its output becomes
+                        # all-NaN and taints the gradients of the compiled
+                        # forward (the net itself replays inside XLA, where
+                        # transient-fault injection does not reach)
+                        mx.fault.configure("invoke.nan_output:at=1,times=1")
+                        data = data * 1.0
+                        mx.fault.clear()
+                    with autograd.record():
+                        loss = loss_fn(net(data), label)
+                    loss.backward()
+                    trainer.step(data.shape[0])
+                    rep.step(loss=float(loss.mean().item()))
+                rep.mark("epoch", epoch=epoch)
+            # deliberately shape-polymorphic tail: trips the detector
+            for bs in (1, 3, 5, 7):
+                net(mx.np.ones((bs, 16)))
+            report = rep.close()
+
+    recompiles = [w for w in caught
+                  if issubclass(w.category, telemetry.RecompileWarning)]
+    assert len(recompiles) == 1
+
+    # the exposition carries live metrics from all five subsystems
+    text = telemetry.exposition()
+    for marker in ("mxnet_cached_graph_compile_total",
+                   "mxnet_cached_graph_cache_hit_total",
+                   "mxnet_dataloader_batches_total",
+                   "mxnet_trainer_steps_total",
+                   "mxnet_trainer_grad_norm",
+                   "mxnet_kvstore_collective_total",
+                   "mxnet_fault_events_total",
+                   "mxnet_cached_graph_recompile_warnings_total"):
+        assert marker in text, f"exposition missing {marker}"
+
+    # ... and so does the run report
+    agg = report["metrics"]["counters"]
+
+    def total(prefix):
+        return sum(v for k, v in agg.items() if k.startswith(prefix))
+
+    assert report["steps"] == 2 * len(loader)
+    assert total("cached_graph.compile_total") > 2
+    assert total("dataloader.batches_total") >= 2 * len(loader)
+    assert total("trainer.steps_total") == 2 * len(loader)
+    assert total("trainer.nonfinite_total") >= 1
+    assert total("kvstore.collective_total") >= 1
+    assert total("fault.events_total") >= 1
+    records = telemetry.TrainingTelemetry.read(path)
+    assert records[0]["type"] == "run_begin"
+    assert records[-1]["type"] == "run_report"
