@@ -13,6 +13,9 @@
 #                (docs/FAULT_TOLERANCE.md)
 #   telemetry  - metrics/observability suite + the disabled-fast-path
 #                overhead budget (docs/OBSERVABILITY.md)
+#   resilience - elastic-training suite + an e2e preempt -> exit 75 ->
+#                restore -> finish chaos run (docs/FAULT_TOLERANCE.md
+#                "Preemption & elastic resume")
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -21,7 +24,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -105,6 +108,67 @@ telemetry() {
     JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
 }
 
+resilience() {
+    echo "== resilience: elastic-training suite (docs/FAULT_TOLERANCE.md) =="
+    python -m pytest tests/test_resilience.py -q
+    echo "== resilience: e2e preempt -> exit 75 -> restore -> finish =="
+    tmp=$(mktemp -d)
+    cat > "$tmp/train.py" <<'PY'
+import sys
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import estimator as est
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+from mxnet_tpu.gluon.data.sampler import RandomSampler
+
+bundle = sys.argv[1]
+mx.random.seed(11)
+rng = onp.random.RandomState(0)
+x = rng.randn(32, 4).astype("f")
+y = (rng.randn(32) > 0).astype("f")
+loader = DataLoader(ArrayDataset(x, y), batch_size=8,
+                    sampler=RandomSampler(32, seed=3), num_workers=0)
+net = nn.Sequential()
+net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+net.initialize()
+trainer = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.05})
+e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                  trainer=trainer)
+rh = est.ResilienceHandler(bundle, loader=loader)
+
+def train():
+    e.fit(loader, epochs=2, event_handlers=[rh])
+
+mx.resilience.run(train, exit_on_preempt=True)
+assert rh.state.step >= 8, rh.state.step
+print("E2E_DONE resumed=%s step=%d" % (rh.resumed, rh.state.step))
+PY
+    # phase 1: injected preemption at step 3 must stop with the resume
+    # sentinel (75) and leave a valid bundle behind
+    if MXNET_FAULT_SPEC="resilience.preempt:at=3" JAX_PLATFORMS=cpu \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$tmp/train.py" "$tmp/run.bundle"; then
+        echo "expected resume-sentinel exit, got success"
+        rm -rf "$tmp"; return 1
+    else
+        code=$?
+        if [ "$code" -ne 75 ]; then
+            echo "expected exit 75 (EX_TEMPFAIL), got $code"
+            rm -rf "$tmp"; return 1
+        fi
+    fi
+    test -f "$tmp/run.bundle" && test -f "$tmp/run.bundle.sha256"
+    # phase 2: the restarted "job" auto-restores and finishes
+    JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$tmp/train.py" "$tmp/run.bundle" \
+        | grep "E2E_DONE resumed=True"
+    rm -rf "$tmp"
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -131,8 +195,9 @@ case "$stage" in
     contracts) contracts ;;
     chaos) chaos ;;
     telemetry) telemetry ;;
+    resilience) resilience ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -62,6 +62,7 @@ from . import io
 from . import image
 from . import contrib
 from . import serialization
+from . import resilience
 from . import storage
 from . import callback
 from . import model

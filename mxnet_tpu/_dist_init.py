@@ -32,6 +32,17 @@ def ensure_distributed():
     from jax._src import distributed
     if distributed.global_state.client is not None:
         return  # already connected
+    if pid is None:
+        # `process_id=pid or 0` would silently make EVERY worker rank 0 —
+        # N processes each claiming rank 0 corrupts the reduce instead of
+        # failing the launch.
+        from .base import MXNetError
+        raise MXNetError(
+            f"distributed launch env is incomplete: coordinator={coord!r} "
+            f"and num_processes={nproc} are set but this process has no "
+            "rank. Set DMLC_WORKER_ID (DMLC-style) or JAX_PROCESS_ID "
+            "(native) to this worker's 0-based index — tools/launch.py "
+            "does this automatically.")
     if os.environ.get("MXTPU_DIST_DEVICE", "") == "cpu":
         # local-launcher mode (tools/launch.py --launcher local): force the
         # CPU platform (the axon/TPU plugin pins JAX_PLATFORMS otherwise)
@@ -42,4 +53,4 @@ def ensure_distributed():
     addr = coord if ":" in coord else f"{coord}:{port}"
     jax.distributed.initialize(coordinator_address=addr,
                                num_processes=nproc,
-                               process_id=pid or 0)
+                               process_id=pid)

@@ -31,3 +31,16 @@ class LossScaler:
             if self._unskipped == self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+
+    # -- elastic resume: the scale and its backoff window are training
+    # state — losing them on preemption replays the warmup ----------------
+    def state_dict(self):
+        return {"loss_scale": self.loss_scale, "unskipped": self._unskipped,
+                "scale_factor": self._scale_factor,
+                "scale_window": self._scale_window}
+
+    def load_state_dict(self, state):
+        self.loss_scale = state["loss_scale"]
+        self._unskipped = int(state.get("unskipped", 0))
+        self._scale_factor = state.get("scale_factor", self._scale_factor)
+        self._scale_window = state.get("scale_window", self._scale_window)

@@ -186,6 +186,24 @@ declare("trainer.skip_nonfinite", bool, False, "MXNET_TRAINER_SKIP_NONFINITE",
         "Trainer.step skips (and counts) updates whose global grad norm "
         "is non-finite instead of poisoning the weights; automatic when "
         "an AMP loss scaler is attached.")
+declare("kvstore.retry_max", int, 2, "MXNET_KVSTORE_RETRY_MAX",
+        "Transient-failure retries per blocking dist collective "
+        "(CollectiveTimeout / coordination-service hiccups): each retry "
+        "re-barriers via jax.distributed and re-issues the collective; "
+        "0 disables retry (a timeout raises immediately); exhausting the "
+        "budget escalates a structured resilience.WorkerLost.")
+declare("kvstore.retry_backoff", float, 0.5, "MXNET_KVSTORE_RETRY_BACKOFF",
+        "Base seconds slept before a collective retry (doubles per "
+        "attempt, +25% jitter so rejoining workers don't stampede the "
+        "coordination service).")
+declare("kvstore.rejoin_timeout", float, 10.0, "MXNET_KVSTORE_REJOIN_TIMEOUT",
+        "Seconds a retrying worker waits at the jax.distributed rejoin "
+        "barrier for its peers before retrying the collective anyway "
+        "(best-effort alignment; a missed barrier is counted, not fatal).")
+declare("resilience.max_restarts", int, 3, "MXNET_RESILIENCE_MAX_RESTARTS",
+        "In-process training restarts mx.resilience.run() performs after "
+        "a WorkerLost escalation (each restart restores the last "
+        "TrainState bundle) before re-raising to the caller.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

@@ -60,6 +60,10 @@ POINTS = {
     "serialization.torn_write":
         "a checkpoint's bytes are silently truncated on disk: checksum "
         "validation rejects it and auto-resume picks the previous one",
+    "resilience.preempt":
+        "the cluster preempts this worker (SIGTERM analog, probed once "
+        "per step): the in-flight step finishes, the TrainState bundle "
+        "is written, and training stops with the resume sentinel",
 }
 
 _lock = threading.Lock()

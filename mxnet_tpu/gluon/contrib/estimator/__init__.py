@@ -11,5 +11,5 @@ from .event_handler import (  # noqa: F401
     EventHandler, TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
     BatchEnd, StoppingHandler, MetricHandler, ValidationHandler,
     LoggingHandler, CheckpointHandler, EarlyStoppingHandler,
-    GradientUpdateHandler, TelemetryHandler,
+    GradientUpdateHandler, TelemetryHandler, ResilienceHandler,
 )

@@ -347,6 +347,76 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd):
                 self.stop_training = True
 
 
+class ResilienceHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
+    """Preemption-safe elastic training for the fit loop (no reference
+    analog — the reference's CheckpointHandler is epoch-granular and knows
+    nothing about signals).
+
+    - ``train_begin``: installs SIGTERM/SIGINT graceful-shutdown handlers,
+      builds a ``mx.resilience.TrainState`` over ``estimator.net`` /
+      ``estimator.trainer`` / the given ``loader``, and (with
+      ``auto_restore``) restores an existing valid bundle so the run
+      continues at the exact next batch; a torn bundle is rejected by its
+      checksum and counted (``checkpoint.rejected``), never half-loaded.
+    - ``batch_end`` (priority -1500: after GradientUpdateHandler's
+      optimizer step at -2000, before metric/logging handlers): counts the
+      completed step, then — when a preemption signal arrived or the
+      ``resilience.preempt`` injection fires — saves the bundle and raises
+      ``Preempted``.  The in-flight step has fully finished by then, so
+      the bundle resumes with bitwise-identical remaining losses.
+    - ``epoch_end``/``train_end``: epoch counter; signal-handler teardown.
+    """
+
+    def __init__(self, bundle_path, loader=None, auto_restore=True,
+                 priority=-1500):
+        self.bundle_path = bundle_path
+        self.loader = loader
+        self.auto_restore = auto_restore
+        self.priority = priority
+        self.state = None
+        self.resumed = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from .... import fault as _fault
+        from .... import resilience
+        resilience.clear_preempt()
+        resilience.install_signal_handlers()
+        self.state = resilience.TrainState(
+            net=estimator.net,
+            trainer=getattr(estimator, "trainer", None),
+            loader=self.loader, path=self.bundle_path)
+        self.resumed = False
+        if self.auto_restore and self.state.exists():
+            try:
+                self.state.load()
+                self.resumed = True
+                logging.getLogger("estimator").info(
+                    "resumed TrainState bundle %s (step %d)",
+                    self.bundle_path, self.state.step)
+            except Exception as e:  # noqa: BLE001 - torn/corrupt bundle
+                _fault.record("checkpoint.rejected")
+                logging.getLogger("estimator").warning(
+                    "TrainState bundle %s rejected (%s); starting fresh",
+                    self.bundle_path, e)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        from .... import resilience
+        self.state.step += 1
+        if resilience.preempt_requested(step=self.state.step):
+            path = self.state.save()
+            resilience.uninstall_signal_handlers()
+            raise resilience.Preempted(path=path, step=self.state.step,
+                                       origin="preempt")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.state is not None:
+            self.state.epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        from .... import resilience
+        resilience.uninstall_signal_handlers()
+
+
 class GradientUpdateHandler(BatchEnd):
     """Applies the optimizer step at batch end (reference
     event_handler.py:722; priority -2000 so it runs before metric and

@@ -189,6 +189,7 @@ class DataLoader:
         self._worker_mode_cache = None
         self._force_threads = False   # set after repeated worker crashes
         self._task_seq = 0            # global task counter (fault at=N)
+        self._served = 0              # batches handed to the training loop
 
     def _batchify(self, mp_mode):
         if self._user_batchify is not None:
@@ -269,6 +270,18 @@ class DataLoader:
         pool.shutdown(wait=False, cancel_futures=True)
 
     def __iter__(self):
+        # the served-batch cursor is what TrainState bundles record: with
+        # prefetching workers, batches *generated* run ahead of batches the
+        # training loop has actually consumed, and resume must continue at
+        # the consumed position
+        self._served = (self._batch_sampler.resume_cursor()
+                        if hasattr(self._batch_sampler, "resume_cursor")
+                        else 0)
+        for batch in self._iter_impl():
+            self._served += 1
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
@@ -281,6 +294,27 @@ class DataLoader:
                                       iter(self._batch_sampler))
             return
         yield from self._mp_pump()
+
+    # -- elastic resume (docs/FAULT_TOLERANCE.md "Preemption & elastic
+    # resume"): the loader's position is {epoch replay state, batches
+    # served}; restoring it makes the next iteration continue at the exact
+    # next batch of the interrupted epoch ------------------------------------
+    def state_dict(self):
+        from ...base import MXNetError
+        if not hasattr(self._batch_sampler, "state_dict"):
+            raise MXNetError(
+                f"batch_sampler {type(self._batch_sampler).__name__} has no "
+                "state_dict; implement state_dict/load_state_dict to make "
+                "this DataLoader resumable")
+        return self._batch_sampler.state_dict(cursor=self._served)
+
+    def load_state_dict(self, state):
+        from ...base import MXNetError
+        if not hasattr(self._batch_sampler, "load_state_dict"):
+            raise MXNetError(
+                f"batch_sampler {type(self._batch_sampler).__name__} has no "
+                "load_state_dict; cannot resume this DataLoader")
+        self._batch_sampler.load_state_dict(state)
 
     def _pump(self, pool, task, unwrap, batches, dispose=None):
         pending = []

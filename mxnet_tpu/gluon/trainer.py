@@ -352,6 +352,48 @@ class Trainer:
             for i, p in work:
                 updater(i, p.grad(), p.data())
 
+    # -- elastic resume (docs/FAULT_TOLERANCE.md "Preemption & elastic
+    # resume"): everything save_states misses — the AMP loss scale and its
+    # backoff window, the non-finite skip counter — plus the optimizer/
+    # updater states as bytes, so a TrainState bundle restores the trainer
+    # to the exact step it was preempted at -------------------------------
+    def state_dict(self):
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            opt_blob = self._kvstore._updater.get_states(dump_optimizer=True)
+        else:
+            opt_blob = self._updaters[0].get_states(dump_optimizer=True)
+        return {"optimizer": opt_blob,
+                "nonfinite_steps": self.nonfinite_steps,
+                "loss_scaler": None if scaler is None
+                else scaler.state_dict()}
+
+    def load_state_dict(self, state):
+        self.nonfinite_steps = int(state.get("nonfinite_steps", 0))
+        scaler_state = state.get("loss_scaler")
+        if scaler_state is not None:
+            if getattr(self, "_amp_loss_scaler", None) is None:
+                from ..amp.loss_scaler import LossScaler
+                self._amp_loss_scaler = LossScaler()
+            self._amp_loss_scaler.load_state_dict(scaler_state)
+        blob = state.get("optimizer")
+        if blob is None:
+            return
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore._updater.set_states(blob)
+            self._optimizer = (self._kvstore._updater.optimizer
+                               or self._optimizer)
+        else:
+            self._updaters[0].set_states(blob)
+            self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {i: p
+                                      for i, p in enumerate(self._params)}
+        self._fused_update = None  # rebuilt against the restored optimizer
+
     def save_states(self, fname):
         """Reference: trainer.py:482.  Crash-atomic like
         Block.save_parameters (temp + fsync + os.replace)."""

@@ -36,6 +36,7 @@ JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID.
 from __future__ import annotations
 
 import os
+import random as _pyrandom
 import threading
 import time
 
@@ -141,6 +142,100 @@ class DistKVStore(KVStore):
             raise result["error"]
         return result["value"]
 
+    @staticmethod
+    def _is_transient(e):
+        """Errors worth retrying: the watchdog's structured timeout, plus
+        coordination-service/fabric blips whose message marks them as
+        transient (a preempted peer shows up as one of these, not as a
+        clean exception type)."""
+        if isinstance(e, CollectiveTimeout):
+            return True
+        msg = str(e).lower()
+        return any(tok in msg for tok in (
+            "deadline exceeded", "unavailable", "connection reset",
+            "connection refused", "broken pipe", "barrier timed out"))
+
+    def _rejoin(self, op, attempt):
+        """Best-effort re-barrier through the jax.distributed coordination
+        service so surviving workers re-align on the retry boundary instead
+        of racing into the retried collective skewed.  Failures are counted
+        (``resilience.rejoin_failed``), never fatal: with a peer truly gone
+        the retried collective itself is the authoritative probe, and a
+        single-process chaos run has nobody to wait for."""
+        if self._nprocs <= 1:
+            return True
+        try:
+            from jax._src import distributed as _jd
+            client = getattr(_jd.global_state, "client", None)
+            if client is None:
+                return False
+            timeout_ms = int(
+                float(_config.get("kvstore.rejoin_timeout")) * 1000)
+            name = "".join(c if c.isalnum() else "_" for c in str(op))
+            client.wait_at_barrier(f"mxtpu_rejoin_{name}_a{attempt}",
+                                   timeout_ms)
+        except Exception:  # noqa: BLE001 - best-effort by design
+            _fault.record("resilience.rejoin_failed")
+            if _telemetry._active:
+                _telemetry.inc("resilience.rejoin_failed_total", op=op)
+            return False
+        _fault.record("resilience.rejoin")
+        if _telemetry._active:
+            _telemetry.inc("resilience.rejoin_total", op=op)
+        return True
+
+    def _collective(self, op, key, fn, hint=""):
+        """Watchdogged collective with bounded retry-with-rejoin.
+
+        A ``CollectiveTimeout`` (or transient coordination-service error)
+        is retried up to ``kvstore.retry_max`` times: exponential backoff
+        from ``kvstore.retry_backoff`` with up-to-25% jitter (so respawned
+        peers don't stampede the coordinator in lockstep), then a
+        best-effort re-barrier (``_rejoin``) before re-entering the
+        collective.  An exhausted budget escalates a structured
+        ``resilience.WorkerLost`` for the ``mx.resilience.run`` supervisor
+        to catch.  ``kvstore.retry_max=0`` restores the raw raise-on-first-
+        timeout contract (what a mismatched pull *schedule* needs — a
+        deterministic deadlock only gets slower when retried).
+        """
+        retry_max = int(_config.get("kvstore.retry_max"))
+        backoff = float(_config.get("kvstore.retry_backoff"))
+        attempt = 0
+        while True:
+            try:
+                return self._timed_wait(op, key, fn, hint)
+            except Exception as e:  # noqa: BLE001 - filtered just below
+                if retry_max <= 0 or not self._is_transient(e):
+                    raise
+                attempt += 1
+                if attempt > retry_max:
+                    from ..resilience import WorkerLost, _event
+                    _event("worker_lost_raised", op=op.partition("#")[0])
+                    raise WorkerLost(op, key, self.rank, self.num_workers,
+                                     attempt, e) from e
+                _fault.record("resilience.collective_retry")
+                if _telemetry._active:
+                    _telemetry.inc("resilience.collective_retry_total",
+                                   op=op)
+                delay = backoff * (2 ** (attempt - 1))
+                if delay > 0:
+                    time.sleep(delay * (1.0 + 0.25 * _pyrandom.random()))
+                self._rejoin(op, attempt)
+
+    def _count_collective(self, op, t0, payload):
+        """Success-path telemetry for one completed collective (errors are
+        counted separately in ``kvstore.collective_errors_total`` — a
+        timed-out allreduce shipped nothing and must not inflate the
+        throughput counters)."""
+        if not _telemetry._active:
+            return
+        _telemetry.observe("kvstore.collective_seconds",
+                           time.perf_counter() - t0, op=op)
+        _telemetry.inc("kvstore.collective_total", op=op)
+        raw = getattr(payload, "_data", payload)
+        _telemetry.inc("kvstore.payload_bytes_total",
+                       int(getattr(raw, "nbytes", 0)))
+
     def _allreduce(self, merged):
         """Cross-process sum (no deadline — see ``_timed_wait`` callers).
         Single process: identity. Multi-process: a tiny pjit'd psum over a
@@ -167,23 +262,21 @@ class DistKVStore(KVStore):
         merged = self._reduce(vs)
         if self._gc is not None:
             merged = _wrap(self._gc.quantize(k, merged._data))
-        if not _telemetry._active:
-            if not self._watchdog_engaged():
-                return self._allreduce(merged)
-            return self._timed_wait("allreduce", k,
-                                    lambda: self._waited_allreduce(merged))
         t0 = time.perf_counter()
         try:
             if not self._watchdog_engaged():
-                return self._allreduce(merged)
-            return self._timed_wait("allreduce", k,
-                                    lambda: self._waited_allreduce(merged))
-        finally:
-            _telemetry.observe("kvstore.collective_seconds",
-                               time.perf_counter() - t0, op="allreduce")
-            _telemetry.inc("kvstore.collective_total", op="allreduce")
-            _telemetry.inc("kvstore.payload_bytes_total",
-                           int(getattr(merged._data, "nbytes", 0)))
+                out = self._allreduce(merged)
+            else:
+                out = self._collective(
+                    "allreduce", k,
+                    lambda: self._waited_allreduce(merged))
+        except Exception:
+            if _telemetry._active:
+                _telemetry.inc("kvstore.collective_errors_total",
+                               op="allreduce")
+            raise
+        self._count_collective("allreduce", t0, merged)
+        return out
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
@@ -275,18 +368,20 @@ class DistAsyncKVStore(DistKVStore):
                 return getattr(out, "_data", out)
 
             t0 = time.perf_counter()
-            summed = self._timed_wait(
-                f"reconcile#{self._reconcile_seq}", k, run,
-                hint="Every process must pull the same keys in the same "
-                     "order the same number of times (SPMD collective "
-                     "constraint); a data-dependent pull schedule "
-                     "deadlocks here — align the pull schedule.")
-            if _telemetry._active:
-                _telemetry.observe("kvstore.collective_seconds",
-                                   time.perf_counter() - t0, op="reconcile")
-                _telemetry.inc("kvstore.collective_total", op="reconcile")
-                _telemetry.inc("kvstore.payload_bytes_total",
-                               int(getattr(summed, "nbytes", 0)))
+            try:
+                summed = self._collective(
+                    f"reconcile#{self._reconcile_seq}", k, run,
+                    hint="Every process must pull the same keys in the "
+                         "same order the same number of times (SPMD "
+                         "collective constraint); a data-dependent pull "
+                         "schedule deadlocks here — align the pull "
+                         "schedule.")
+            except Exception:
+                if _telemetry._active:
+                    _telemetry.inc("kvstore.collective_errors_total",
+                                   op="reconcile")
+                raise
+            self._count_collective("reconcile", t0, summed)
             avg = summed / self._nprocs
             self._store[k]._rebind(avg.astype(self._store[k].dtype))
         return self._store[k]

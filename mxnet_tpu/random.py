@@ -37,6 +37,32 @@ def seed(seed_state, ctx="all"):
         _fallback_n = 0
 
 
+def get_state():
+    """Snapshot of the global RNG streams — the jax key, the tracer-
+    fallback counter, and numpy's global generator (which seeds samplers
+    and dataset shuffles).  Everything is plain numpy/python so it
+    pickles into a TrainState bundle; ``set_state`` restores it bitwise."""
+    import numpy as onp
+    with _lock:
+        key = None if _key is None else onp.asarray(_key)
+    return {"key": key, "fallback_n": _fallback_n,
+            "numpy": onp.random.get_state()}
+
+
+def set_state(state):
+    """Restore a snapshot from :func:`get_state` (elastic resume)."""
+    global _key, _fallback_n
+    import numpy as onp
+    k = state.get("key")
+    with _lock:
+        _key = None if k is None else jax.numpy.asarray(
+            onp.asarray(k, dtype=onp.uint32))
+        _fallback_n = int(state.get("fallback_n", 0))
+    np_state = state.get("numpy")
+    if np_state is not None:
+        onp.random.set_state(np_state)
+
+
 _fallback_n = 0
 
 

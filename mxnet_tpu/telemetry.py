@@ -132,6 +132,28 @@ declare_metric("kvstore.collective_total", "counter",
                "cross-process collectives issued, by op")
 declare_metric("kvstore.payload_bytes_total", "counter",
                "bytes moved through cross-process collectives, by op")
+declare_metric("kvstore.collective_errors_total", "counter",
+               "cross-process collectives that failed (timeout or fabric "
+               "error), by op — disjoint from collective_total, which "
+               "counts successes only")
+declare_metric("resilience.collective_retry_total", "counter",
+               "collective attempts retried after a transient failure, "
+               "by op")
+declare_metric("resilience.rejoin_total", "counter",
+               "successful pre-retry coordination-service re-barriers")
+declare_metric("resilience.rejoin_failed_total", "counter",
+               "best-effort re-barriers that timed out (peer gone or "
+               "still inside the collective)")
+declare_metric("resilience.worker_lost_raised_total", "counter",
+               "collective retry budgets exhausted -> WorkerLost raised")
+declare_metric("resilience.bundle_save_total", "counter",
+               "TrainState bundles written")
+declare_metric("resilience.bundle_restore_total", "counter",
+               "TrainState bundles restored")
+declare_metric("resilience.preempt_signal_total", "counter",
+               "preemption signals observed, by signal")
+declare_metric("resilience.restart_total", "counter",
+               "supervised train-fn restarts after WorkerLost")
 declare_metric("fault.events_total", "counter",
                "mx.fault injections and recovery events, by event")
 declare_metric("train.iter_seconds", "histogram",

@@ -4,7 +4,14 @@ Mirrors the reference's tests/nightly/dist_sync_kvstore.py:40-50 check_diff:
 every worker pushes known rank-dependent values and asserts the EXACT
 reduced result, plus a gradient-compression case and an
 optimizer-on-kvstore case. Prints DIST_OK <rank> on success.
+
+With MXTPU_DIST_RETRY_CASE=1 the worker instead runs the elastic-retry
+case: rank 0 arms the ``kvstore.collective_timeout`` chaos point so its
+first collective "hangs" past a short watchdog deadline, the retry layer
+backs off, re-barriers through the coordination service, and the retried
+collective must complete with the exact sum.  Prints RETRY_OK <rank>.
 """
+import os
 import sys
 
 import numpy as onp
@@ -19,7 +26,40 @@ def check_eq(arr, expect, what):
         f"{what}: expected {expect}, got {got.ravel()[:4]}"
 
 
+def retry_main():
+    """One injected timeout on rank 0 -> retry-with-rejoin -> exact sum."""
+    kv = kvstore.create("dist_sync")
+    n, rank = kv.num_workers, kv.rank
+    assert n > 1, "launcher did not create a multi-process world"
+    shape = (4, 3)
+    if rank == 0:
+        # rank 0's first collective times out fast and is retried; the
+        # peers keep a long deadline so they simply wait out rank 0's
+        # backoff+rejoin inside their own (single) collective attempt.
+        mx.config.set("kvstore.async_timeout", 4.0)
+        mx.config.set("kvstore.retry_backoff", 0.2)
+        # the peer is already parked inside the collective, not at the
+        # barrier — keep the best-effort rejoin wait short
+        mx.config.set("kvstore.rejoin_timeout", 2.0)
+        mx.fault.configure("kvstore.collective_timeout:at=1")
+    else:
+        mx.config.set("kvstore.async_timeout", 120.0)
+    kv.init("r0", mx.np.zeros(shape))
+    kv.push("r0", mx.np.full(shape, float(rank + 1)))
+    out = mx.np.empty(shape)
+    kv.pull("r0", out=out)
+    check_eq(out, sum(range(1, n + 1)), "retried push/pull sum")
+    if rank == 0:
+        stats = mx.fault.stats()
+        assert stats.get("resilience.collective_retry", 0) >= 1, stats
+        assert stats.get("kvstore.collective_timeout_raised", 0) >= 1, stats
+    print(f"RETRY_OK {rank}", flush=True)
+
+
 def main():
+    if os.environ.get("MXTPU_DIST_RETRY_CASE") == "1":
+        retry_main()
+        return
     kv = kvstore.create("dist_sync")
     n, rank = kv.num_workers, kv.rank
     assert n > 1, "launcher did not create a multi-process world"

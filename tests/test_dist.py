@@ -124,6 +124,9 @@ def test_dist_async_watchdog_times_out():
     kv._allreduce = hang
     old = mx.config.get("kvstore.async_timeout")
     mx.config.set("kvstore.async_timeout", 0.5)
+    # a deterministic schedule mismatch must fail fast, not be retried:
+    # pin the elastic retry layer off for the raw-diagnostic assertion
+    mx.config.set("kvstore.retry_max", 0)
     try:
         t0 = time.time()
         with pytest.raises(mx.base.MXNetError, match="pull schedule"):
@@ -131,6 +134,7 @@ def test_dist_async_watchdog_times_out():
         assert time.time() - t0 < 5
     finally:
         mx.config.set("kvstore.async_timeout", old)
+        mx.config.reset("kvstore.retry_max")
 
 
 @pytest.mark.slow

@@ -330,6 +330,9 @@ def test_collective_watchdog_structured_timeout():
     kv = DistKVStore()
     kv.init("weight", mx.np.array([1.0, 2.0]))
     mx.config.set("kvstore.async_timeout", 0.3)
+    # this test asserts the RAW watchdog contract; disable the elastic
+    # retry layer (tests/test_resilience.py covers it)
+    mx.config.set("kvstore.retry_max", 0)
     mx.fault.configure("kvstore.collective_timeout:at=1")
     with pytest.raises(CollectiveTimeout) as ei:
         kv.push("weight", mx.np.array([0.5, 0.5]))
@@ -339,6 +342,7 @@ def test_collective_watchdog_structured_timeout():
     assert "kvstore.async_timeout" in str(e)
     assert mx.fault.stats()["kvstore.collective_timeout_raised"] == 1
     mx.fault.clear()
+    mx.config.reset("kvstore.retry_max")
     # disarmed single-process store goes back to the wait-free fast path
     kv.push("weight", mx.np.array([0.5, 0.5]))
 
@@ -348,6 +352,7 @@ def test_dist_async_watchdog_diagnostic_names_key_rank_and_knob():
     kv = DistAsyncKVStore()
     kv.init("emb", mx.np.array([3.0]))
     mx.config.set("kvstore.async_timeout", 0.3)
+    mx.config.set("kvstore.retry_max", 0)  # raw watchdog contract
     mx.fault.configure("kvstore.collective_timeout:at=1")
     out = mx.np.zeros(1)
     with pytest.raises(CollectiveTimeout) as ei:
@@ -359,6 +364,7 @@ def test_dist_async_watchdog_diagnostic_names_key_rank_and_knob():
     assert "pull schedule" in msg              # reconcile-specific hint
     assert ei.value.op.startswith("reconcile#")
     mx.fault.clear()
+    mx.config.reset("kvstore.retry_max")
     # the reconciling pull works once disarmed (nprocs=1: identity)
     kv.pull("emb", out=out)
     assert out.asnumpy()[0] == 3.0

@@ -1,0 +1,514 @@
+"""Elastic training (mx.resilience): preemption-safe TrainState bundles,
+deterministic mid-epoch resume, collective retry-with-rejoin.
+
+The acceptance oracle is BITWISE resume: a run preempted at step K and
+restored from its bundle must produce the identical loss sequence for
+steps K+1..N as the uninterrupted run — not "close", identical floats.
+"""
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import estimator as est
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.dataset import ArrayDataset
+from mxnet_tpu.gluon.data.sampler import BatchSampler, RandomSampler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    mx.resilience.clear_preempt()
+    yield
+    mx.fault.clear()
+    mx.resilience.clear_preempt()
+    mx.resilience.uninstall_signal_handlers()
+    for knob in ("kvstore.retry_max", "kvstore.retry_backoff",
+                 "kvstore.async_timeout", "resilience.max_restarts"):
+        mx.config.reset(knob)
+
+
+# ---------------------------------------------------------------------------
+# sampler / loader cursor state
+# ---------------------------------------------------------------------------
+
+def test_random_sampler_epoch_replay():
+    """An epoch's permutation is a replayable pure function of its
+    recorded seed — for fixed AND stochastic (seed=None) samplers."""
+    for seed in (11, None):
+        rs = RandomSampler(32, seed=seed)
+        epoch1 = list(rs)
+        state = rs.state_dict()
+        rs2 = RandomSampler(32, seed=seed)
+        rs2.load_state_dict(state)
+        assert list(rs2) == epoch1
+        # and the NEXT epoch continues the same sequence for seeded mode
+        if seed is not None:
+            assert list(rs2) == list(rs)
+
+
+def test_batch_sampler_mid_epoch_resume():
+    bs = BatchSampler(RandomSampler(20, seed=3), 6, "discard")
+    it = iter(bs)
+    consumed = [next(it), next(it)]
+    state = bs.state_dict()
+    remaining_truth = list(it)
+
+    bs2 = BatchSampler(RandomSampler(20, seed=3), 6, "discard")
+    bs2.load_state_dict(state)
+    assert list(iter(bs2)) == remaining_truth
+    # the epoch after the resumed one matches the uninterrupted epoch too
+    assert list(iter(bs2)) == list(iter(bs))
+    assert consumed  # sanity: we really were mid-epoch
+
+
+def test_batch_sampler_rollover_carry_survives_resume():
+    """Mid-epoch state must include the rollover carry the epoch started
+    with, or the resumed epoch regenerates different batch boundaries."""
+    bs = BatchSampler(RandomSampler(10, seed=5), 4, "rollover")
+    list(iter(bs))          # epoch 0 leaves a 2-sample carry
+    it = iter(bs)           # epoch 1 starts with the carry
+    first = next(it)
+    state = bs.state_dict()
+    rest_truth = list(it)
+
+    bs2 = BatchSampler(RandomSampler(10, seed=5), 4, "rollover")
+    bs2.load_state_dict(state)
+    assert list(iter(bs2)) == rest_truth
+    assert len(first) == 4
+
+
+def test_dataloader_served_cursor_is_authoritative(tmp_path):
+    """The loader records batches SERVED to the loop, not generated into
+    a prefetch queue; resume continues at the consumed position."""
+    x = onp.arange(40, dtype="float32").reshape(20, 2)
+    ds = ArrayDataset(x)
+    loader = DataLoader(ds, batch_size=4,
+                        sampler=RandomSampler(20, seed=9), num_workers=0)
+    it = iter(loader)
+    seen = [next(it).asnumpy() for _ in range(2)]
+    state = loader.state_dict()
+    assert state["cursor"] == 2
+    rest_truth = [b.asnumpy() for b in it]
+
+    loader2 = DataLoader(ds, batch_size=4,
+                         sampler=RandomSampler(20, seed=9), num_workers=0)
+    loader2.load_state_dict(state)
+    rest = [b.asnumpy() for b in loader2]
+    assert len(rest) == len(rest_truth)
+    for a, b in zip(rest, rest_truth):
+        onp.testing.assert_array_equal(a, b)
+    assert seen  # consumed prefix existed
+
+
+def test_dataloader_without_stateful_sampler_raises():
+    ds = ArrayDataset(onp.zeros((4, 1), dtype="float32"))
+
+    class Dumb:
+        def __iter__(self):
+            yield [0, 1]
+
+        def __len__(self):
+            return 1
+
+    loader = DataLoader(ds, batch_sampler=Dumb())
+    with pytest.raises(mx.base.MXNetError, match="state_dict"):
+        loader.state_dict()
+
+
+# ---------------------------------------------------------------------------
+# trainer / scaler state
+# ---------------------------------------------------------------------------
+
+def _toy_net(lr=0.1, opt="adam"):
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), opt,
+                            {"learning_rate": lr})
+    return net, trainer
+
+
+def _step(net, trainer, x, y):
+    loss_fn = gluon.loss.L2Loss()
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(x.shape[0])
+    return float(loss.mean().asnumpy())
+
+
+def test_trainer_state_roundtrip_bitwise():
+    """Optimizer state (adam moments + step count) restored via
+    state_dict must continue the EXACT update trajectory."""
+    mx.random.seed(100)
+    x = mx.np.array(onp.random.RandomState(0).randn(8, 4).astype("f"))
+    y = mx.np.array(onp.random.RandomState(1).randn(8, 2).astype("f"))
+
+    net_a, tr_a = _toy_net()
+    for _ in range(3):
+        _step(net_a, tr_a, x, y)
+    state = tr_a.state_dict()
+    params = {k: p.data().asnumpy()
+              for k, p in net_a.collect_params().items()}
+    truth = [_step(net_a, tr_a, x, y) for _ in range(3)]
+
+    mx.random.seed(100)
+    net_b, tr_b = _toy_net()
+    net_b(x)  # materialize deferred shapes
+    for k, p in net_b.collect_params().items():
+        p.set_data(mx.np.array(params[k]))
+    tr_b.load_state_dict(state)
+    got = [_step(net_b, tr_b, x, y) for _ in range(3)]
+    assert got == truth
+    assert tr_b.nonfinite_steps == tr_a.nonfinite_steps
+
+
+def test_loss_scaler_state_roundtrip():
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    s = LossScaler()
+    s.loss_scale = 1024.0
+    s._unskipped = 7
+    s2 = LossScaler()
+    s2.load_state_dict(s.state_dict())
+    assert s2.loss_scale == 1024.0 and s2._unskipped == 7
+
+
+# ---------------------------------------------------------------------------
+# TrainState bundles: the bitwise mid-epoch resume oracle
+# ---------------------------------------------------------------------------
+
+def _make_run(bundle_path):
+    """Deterministic toy run: seeded init, seeded shuffle, adam."""
+    mx.random.seed(1234)
+    onp.random.seed(1234)
+    rng = onp.random.RandomState(7)
+    x = rng.randn(24, 4).astype("f")
+    y = rng.randn(24, 2).astype("f")
+    ds = ArrayDataset(x, y)
+    loader = DataLoader(ds, batch_size=4,
+                        sampler=RandomSampler(24, seed=5), num_workers=0)
+    net, trainer = _toy_net(lr=0.05)
+    net(mx.np.array(x[:1]))  # materialize shapes
+    state = mx.resilience.TrainState(net=net, trainer=trainer,
+                                     loader=loader, path=bundle_path)
+    return net, trainer, loader, state
+
+
+def _train(net, trainer, loader, state, epochs=2, preempt_at=None):
+    """Flat training loop; returns [(step, loss)].  ``preempt_at`` saves
+    the bundle after that step and stops (the cooperative-preempt path)."""
+    losses = []
+    for _ in range(state.epoch, epochs):
+        for bx, by in loader:
+            loss = _step(net, trainer, bx, by)
+            state.step += 1
+            losses.append((state.step, loss))
+            if preempt_at is not None and state.step == preempt_at:
+                state.save()
+                return losses
+        state.epoch += 1
+    return losses
+
+
+def test_bitwise_identical_resume_mid_epoch(tmp_path):
+    """THE tentpole oracle: preempt at step 4 of 12 (mid-epoch-0), restore
+    in a fresh world, finish — the remaining 8 losses are float-identical
+    to the uninterrupted run's."""
+    bundle = str(tmp_path / "run.bundle")
+
+    truth = _train(*_make_run(bundle), epochs=2)
+    assert len(truth) == 12
+
+    first = _train(*_make_run(bundle), epochs=2, preempt_at=4)
+    assert [l for _, l in first] == [l for _, l in truth[:4]]
+    assert os.path.exists(bundle) and os.path.exists(bundle + ".sha256")
+
+    # "new process": fresh net/trainer/loader, different transient RNG use
+    # before restore must not matter
+    net, trainer, loader, state = _make_run(bundle)
+    mx.np.random.uniform(size=(3,))  # perturb RNG pre-restore
+    state.load()
+    assert state.step == 4
+    resumed = _train(net, trainer, loader, state, epochs=2)
+    assert [s for s, _ in resumed] == [s for s, _ in truth[4:]]
+    assert [l for _, l in resumed] == [l for _, l in truth[4:]], \
+        "resumed losses diverged from the uninterrupted run"
+
+
+def test_trainstate_rejects_torn_bundle(tmp_path):
+    bundle = str(tmp_path / "t.bundle")
+    net, trainer, loader, state = _make_run(bundle)
+    state.step = 3
+    state.save()
+    blob = open(bundle, "rb").read()
+    with open(bundle, "wb") as f:
+        f.write(blob[:len(blob) // 2])  # torn write
+    with pytest.raises(mx.base.MXNetError, match="checksum|corrupt"):
+        mx.resilience.TrainState(net=net, trainer=trainer,
+                                 loader=loader, path=bundle).load()
+
+
+def test_trainstate_rejects_newer_format(tmp_path):
+    bundle = str(tmp_path / "v.bundle")
+    from mxnet_tpu import serialization
+    serialization.atomic_write_bytes(
+        bundle, pickle.dumps({"version": 99, "step": 1}))
+    serialization.write_checksum(bundle)
+    with pytest.raises(mx.base.MXNetError, match="newer"):
+        mx.resilience.TrainState(path=bundle).load()
+
+
+def test_trainstate_refuses_partial_param_restore(tmp_path):
+    bundle = str(tmp_path / "p.bundle")
+    net, trainer, loader, state = _make_run(bundle)
+    d = state.state_dict()
+    d["params"].popitem()
+    from mxnet_tpu import serialization
+    serialization.atomic_write_bytes(bundle, pickle.dumps(d))
+    serialization.write_checksum(bundle)
+    with pytest.raises(mx.base.MXNetError, match="missing parameter"):
+        state.load()
+
+
+# ---------------------------------------------------------------------------
+# preemption: signals + injection + estimator handler
+# ---------------------------------------------------------------------------
+
+def test_signal_sets_preempt_flag():
+    hooked = mx.resilience.install_signal_handlers()
+    assert signal.SIGTERM in hooked
+    assert not mx.resilience.preempt_requested()
+    signal.raise_signal(signal.SIGTERM)
+    assert mx.resilience.preempt_requested()
+    mx.resilience.uninstall_signal_handlers()
+    mx.resilience.clear_preempt()
+    assert mx.fault.stats().get("resilience.preempt_signal") == 1
+
+
+def test_preempt_injection_point_is_deterministic():
+    mx.fault.configure("resilience.preempt:at=3")
+    hits = [mx.resilience.preempt_requested(step=s) for s in (1, 2, 3)]
+    assert hits == [False, False, True]
+
+
+def test_estimator_resilience_handler_preempt_then_resume(tmp_path):
+    """e2e through the fit loop: injection preempts at step 3, the bundle
+    lands on disk, a fresh estimator auto-restores and finishes."""
+    bundle = str(tmp_path / "est.bundle")
+    rng = onp.random.RandomState(0)
+    x = rng.randn(32, 4).astype("f")
+    y = (rng.randn(32) > 0).astype("f")
+
+    def make():
+        mx.random.seed(7)
+        ds = ArrayDataset(x, y)
+        loader = DataLoader(ds, batch_size=8,
+                            sampler=RandomSampler(32, seed=2),
+                            num_workers=0)
+        net = nn.Sequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 0.05})
+        e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          trainer=trainer)
+        rh = est.ResilienceHandler(bundle, loader=loader)
+        return e, loader, rh
+
+    e, loader, rh = make()
+    mx.fault.configure("resilience.preempt:at=3")
+    with pytest.raises(mx.resilience.Preempted) as ei:
+        e.fit(loader, epochs=2, event_handlers=[rh])
+    mx.fault.clear()
+    assert ei.value.step == 3 and ei.value.path == bundle
+    assert os.path.exists(bundle)
+
+    e2, loader2, rh2 = make()
+    e2.fit(loader2, epochs=2, event_handlers=[rh2])
+    assert rh2.resumed
+    assert rh2.state.step >= 8  # 2 epochs x 4 batches
+    stats = mx.fault.stats()
+    assert stats.get("resilience.bundle_save", 0) >= 1
+    assert stats.get("resilience.bundle_restore", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# collective retry-with-rejoin (single process; the 2-proc case is below)
+# ---------------------------------------------------------------------------
+
+def _solo_kv():
+    from mxnet_tpu.kvstore.dist import DistKVStore
+    kv = DistKVStore.__new__(DistKVStore)
+    kv._nprocs, kv._rank, kv._gc = 1, 0, None
+    kv._store, kv._updater = {}, None
+    return kv
+
+
+def test_collective_retry_recovers_one_timeout():
+    kv = _solo_kv()
+    mx.config.set("kvstore.async_timeout", 0.4)
+    mx.config.set("kvstore.retry_backoff", 0.05)
+    mx.fault.configure("kvstore.collective_timeout:at=1")
+    kv.init("w", mx.np.zeros((3,)))
+    out = mx.np.zeros((3,))
+    kv.pushpull("w", mx.np.ones((3,)), out=out)
+    onp.testing.assert_array_equal(out.asnumpy(), onp.ones(3, "f"))
+    st = mx.fault.stats()
+    assert st["resilience.collective_retry"] == 1
+    assert st["kvstore.collective_timeout_raised"] == 1
+
+
+def test_retry_max_zero_restores_raw_watchdog():
+    from mxnet_tpu.kvstore.dist import CollectiveTimeout
+    kv = _solo_kv()
+    mx.config.set("kvstore.async_timeout", 0.3)
+    mx.config.set("kvstore.retry_max", 0)
+    mx.fault.configure("kvstore.collective_timeout:at=1")
+    kv.init("w", mx.np.zeros((2,)))
+    with pytest.raises(CollectiveTimeout):
+        kv.push("w", mx.np.ones((2,)))
+    assert "resilience.collective_retry" not in mx.fault.stats()
+
+
+def test_exhausted_retries_escalate_worker_lost():
+    kv = _solo_kv()
+    mx.config.set("kvstore.async_timeout", 0.3)
+    mx.config.set("kvstore.retry_backoff", 0.02)
+    mx.config.set("kvstore.retry_max", 2)
+    mx.fault.configure("kvstore.collective_timeout:prob=1.0")
+    kv.init("w", mx.np.zeros((2,)))
+    with pytest.raises(mx.resilience.WorkerLost) as ei:
+        kv.push("w", mx.np.ones((2,)))
+    e = ei.value
+    assert (e.op, e.key, e.rank, e.nprocs) == ("allreduce", "w", 0, 1)
+    assert e.attempts == 3  # initial + 2 retries
+    assert isinstance(e.last, mx.base.MXNetError)
+    assert mx.fault.stats()["resilience.collective_retry"] == 2
+
+
+def test_collective_telemetry_counts_success_only():
+    """Satellite fix: a failed allreduce must NOT inflate
+    collective_total/payload_bytes; it lands in collective_errors."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.kvstore.dist import CollectiveTimeout
+    kv = _solo_kv()
+    mx.config.set("kvstore.async_timeout", 0.3)
+    mx.config.set("kvstore.retry_max", 0)
+    kv.init("w", mx.np.zeros((2,)))
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        mx.fault.configure("kvstore.collective_timeout:at=1")
+        with pytest.raises(CollectiveTimeout):
+            kv.push("w", mx.np.ones((2,)))
+        mx.fault.clear()
+        flat = telemetry.counters(aggregate=True)
+        assert flat.get("kvstore.collective_total", 0) == 0
+        assert flat.get("kvstore.payload_bytes_total", 0) == 0
+        assert flat["kvstore.collective_errors_total"] == 1
+        # armed-but-successful collective counts normally again
+        mx.fault.configure("kvstore.collective_timeout:at=999")
+        kv.push("w", mx.np.ones((2,)))
+        flat = telemetry.counters(aggregate=True)
+        assert flat["kvstore.collective_total"] == 1
+        assert flat["kvstore.payload_bytes_total"] > 0
+        assert flat["kvstore.collective_errors_total"] == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def test_run_restarts_on_worker_lost_within_budget(tmp_path):
+    bundle = str(tmp_path / "s.bundle")
+    state = mx.resilience.TrainState(path=bundle)
+    state.step = 5
+    state.save()
+    state.step = 99  # drift that the restore must undo
+
+    calls = []
+
+    def train_fn():
+        calls.append(state.step)
+        if len(calls) < 3:
+            raise mx.resilience.WorkerLost("allreduce", "w", 0, 2,
+                                           3, RuntimeError("gone"))
+        return "done"
+
+    assert mx.resilience.run(train_fn, state=state,
+                             max_restarts=3) == "done"
+    # first call saw the drifted step; each restart restored step=5
+    assert calls == [99, 5, 5]
+    st = mx.fault.stats()
+    assert st["resilience.restart"] == 2
+
+
+def test_run_reraises_past_budget():
+    def always_lost():
+        raise mx.resilience.WorkerLost("allreduce", "w", 0, 2,
+                                       3, RuntimeError("gone"))
+
+    with pytest.raises(mx.resilience.WorkerLost):
+        mx.resilience.run(always_lost, max_restarts=1)
+    assert mx.fault.stats()["resilience.restart_budget_exhausted"] == 1
+
+
+def test_run_exit_on_preempt_uses_resume_sentinel():
+    def preempted():
+        raise mx.resilience.Preempted(path="x", step=1)
+
+    with pytest.raises(SystemExit) as ei:
+        mx.resilience.run(preempted, exit_on_preempt=True)
+    assert ei.value.code == mx.resilience.RESUME_EXIT_CODE == 75
+    # and without the flag the exception propagates for the caller
+    with pytest.raises(mx.resilience.Preempted):
+        mx.resilience.run(preempted)
+
+
+# ---------------------------------------------------------------------------
+# satellites: dist bring-up diagnostics; 2-process retry
+# ---------------------------------------------------------------------------
+
+def test_ensure_distributed_missing_rank_raises(monkeypatch):
+    """`process_id=pid or 0` made every rank silently 0; now the missing
+    env var is named instead."""
+    from mxnet_tpu._dist_init import ensure_distributed
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.delenv("DMLC_WORKER_ID", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(mx.base.MXNetError,
+                       match="DMLC_WORKER_ID.*JAX_PROCESS_ID"):
+        ensure_distributed()
+
+
+@pytest.mark.slow
+def test_launch_two_process_collective_retry():
+    """Real 2-process gloo world: rank 0's first collective is injected to
+    time out; the retry layer re-barriers and the retried collective must
+    complete with the exact sum on BOTH ranks."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"), "-n", "2",
+         "--env", "MXTPU_DIST_RETRY_CASE=1",
+         sys.executable, os.path.join(REPO, "tests", "dist_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "RETRY_OK 0" in r.stdout and "RETRY_OK 1" in r.stdout, r.stdout
