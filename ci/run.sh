@@ -16,6 +16,11 @@
 #   resilience - elastic-training suite + an e2e preempt -> exit 75 ->
 #                restore -> finish chaos run (docs/FAULT_TOLERANCE.md
 #                "Preemption & elastic resume")
+#   pipeline   - async host<->device overlap suite + the overlap
+#                benchmark: prefetch-on must beat the synchronous loop
+#                >=1.2x with input-stall below the serial producer wait,
+#                and the disabled path must stay <2% on a tight eager
+#                loop (docs/PERFORMANCE.md)
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -24,7 +29,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -169,6 +174,13 @@ PY
     rm -rf "$tmp"
 }
 
+pipeline() {
+    echo "== pipeline: overlap-engine suite (docs/PERFORMANCE.md) =="
+    python -m pytest tests/test_pipeline.py tests/test_dataloader_mp.py -q
+    echo "== pipeline: overlap benchmark (>=1.2x, stall < serial wait, off-path <2%) =="
+    JAX_PLATFORMS=cpu python benchmark/pipeline_overlap.py
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -196,8 +208,9 @@ case "$stage" in
     chaos) chaos ;;
     telemetry) telemetry ;;
     resilience) resilience ;;
+    pipeline) pipeline ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

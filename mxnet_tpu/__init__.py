@@ -33,6 +33,8 @@ from . import fault
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, device, num_gpus, num_tpus
 from . import engine
+from . import pipeline
+from . import _compile_cache
 from . import numpy as np  # noqa: F401
 from . import numpy_extension as npx  # noqa: F401
 from . import ndarray
@@ -93,6 +95,9 @@ kv = kvstore
 
 if config.get("profiler.autostart"):
     profiler.set_state("run")
+
+if config.get("compilation_cache_dir"):
+    _compile_cache.configure()
 
 
 def waitall():

@@ -1,0 +1,97 @@
+"""Opt-in JAX persistent compilation cache (mx.config.compilation_cache_dir).
+
+Reference parity: the reference ships compiled-op caches keyed on op
+signatures in-process; on a compiler-backed stack the expensive artifact
+is the XLA executable, and JAX can persist those to disk so *repeated
+runs* — the CI re-run, the resumed preemptible job, the hyperparameter
+sweep over one model — skip compilation entirely.  This module arms that
+cache from the ``compilation_cache_dir`` knob (env alias
+``MXNET_COMPILE_CACHE``) and mirrors JAX's cache activity into
+``mx.telemetry``'s ``compile.*`` metrics, next to the in-process
+recompile detector (telemetry.note_compile).
+
+Threshold note: JAX by default only persists programs that took >1s to
+compile and are >minimal size; we zero both thresholds — an opted-in
+cache directory should cache everything, tiny test programs included,
+or the knob looks broken on small models.
+"""
+from __future__ import annotations
+
+import os
+
+from . import config as _config
+from . import telemetry as _telemetry
+
+__all__ = ["configure"]
+
+_telemetry.declare_metric(
+    "compile.persistent_cache_requests_total", "counter",
+    "XLA compilations that consulted the persistent cache")
+_telemetry.declare_metric(
+    "compile.persistent_cache_hits_total", "counter",
+    "XLA compilations served from the persistent cache (miss count = "
+    "requests - hits)")
+_telemetry.declare_metric(
+    "compile.persistent_cache_retrieval_seconds", "histogram",
+    "time to load one cached executable from disk",
+    buckets=_telemetry.TIME_BUCKETS)
+
+_listener_installed = False
+
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits":
+        "compile.persistent_cache_hits_total",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "compile.persistent_cache_requests_total",
+}
+
+
+def _install_listeners():
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax import monitoring
+    except ImportError:
+        return
+
+    def on_event(event, *args, **kwargs):
+        if not _telemetry._active:
+            return
+        name = _EVENT_COUNTERS.get(event)
+        if name is not None:
+            _telemetry.inc(name)
+
+    def on_duration(event, duration, *args, **kwargs):
+        if not _telemetry._active:
+            return
+        if event == "/jax/compilation_cache/cache_retrieval_time_sec":
+            _telemetry.observe("compile.persistent_cache_retrieval_seconds",
+                               duration)
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+    _listener_installed = True
+
+
+def configure(path=None):
+    """Point JAX's persistent compilation cache at ``path`` (default: the
+    ``compilation_cache_dir`` knob).  Returns the armed directory, or
+    None when the knob is empty.  Idempotent; safe to call after arrays
+    exist (only future compilations consult the cache)."""
+    if path is None:
+        path = _config.get("compilation_cache_dir")
+    if not path:
+        return None
+    path = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(path, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, value in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                        ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):  # older/newer jax: keep defaults
+            pass
+    _install_listeners()
+    return path

@@ -182,6 +182,32 @@ declare("dataloader.respawn_backoff", float, 0.1,
         "MXNET_DATALOADER_RESPAWN_BACKOFF",
         "Base seconds slept before respawning a crashed worker pool "
         "(doubles per retry).")
+declare("dataloader.shm_ring", bool, True, "MXNET_DATALOADER_SHM_RING",
+        "Process-worker loaders reuse a pool of SharedMemory segments "
+        "across batches instead of create/unlink per leaf (BENCH_r05: the "
+        "churn made process workers 0.25x thread throughput); off restores "
+        "the historical one-shot segments.")
+declare("dataloader.shm_ring_max", int, 32, "MXNET_DATALOADER_SHM_RING_MAX",
+        "Max idle SharedMemory segments the reuse pool keeps per loader; "
+        "overflow segments are unlinked oldest-first.")
+declare("pipeline.prefetch_depth", int, 2, "MXNET_PIPELINE_PREFETCH_DEPTH",
+        "In-flight batch window of a mx.pipeline.DevicePrefetcher (2 = "
+        "double buffering, 3 = triple); bounds host+device memory pinned "
+        "by prefetched batches.")
+declare("pipeline.stall_timeout", float, 30.0, "MXNET_PIPELINE_STALL_TIMEOUT",
+        "Seconds a DevicePrefetcher consumer waits on an empty queue "
+        "before declaring the background thread stalled and handing its "
+        "source iterator to a replacement thread (counted in "
+        "mx.fault.stats()).")
+declare("pipeline.deferred_window", int, 32, "MXNET_PIPELINE_DEFERRED_WINDOW",
+        "Default mx.pipeline.DeferredWindow capacity: device scalars "
+        "(grad norms, metric accumulators) pending host fetch; overflow "
+        "drains oldest-first and counts as a host sync.")
+declare("compilation_cache_dir", str, "", "MXNET_COMPILE_CACHE",
+        "Directory for JAX's persistent XLA compilation cache ('' = off); "
+        "repeated runs reuse compiled executables instead of recompiling. "
+        "Armed at import when set; mx._compile_cache.configure() applies "
+        "a runtime change.")
 declare("trainer.skip_nonfinite", bool, False, "MXNET_TRAINER_SKIP_NONFINITE",
         "Trainer.step skips (and counts) updates whose global grad norm "
         "is non-finite instead of poisoning the weights; automatic when "

@@ -34,6 +34,9 @@ def _track(arr):
 
 def wait_all():
     """Engine::WaitForAll analog: block on every live dispatched array."""
+    from . import pipeline as _pipeline  # engine imports before pipeline
+    if _pipeline._guard_depth:
+        _pipeline.note_host_sync("engine.wait_all")
     with _lock:
         arrs = list(_pending)
         _pending.clear()

@@ -51,6 +51,12 @@ POINTS = {
     "dataloader.worker_hang":
         "a worker stops producing (sleeps past the loader timeout): the "
         "heartbeat deadline treats it as dead and the respawn path runs",
+    "pipeline.prefetch_stall":
+        "a DevicePrefetcher's background thread wedges between batches "
+        "(probed at the top of its loop, holding neither the source nor a "
+        "batch): the consumer's stall deadline fires, a replacement "
+        "thread takes over the same source iterator, and batch order is "
+        "preserved",
     "invoke.nan_output":
         "an eager op returns all-NaN: the Trainer non-finite guard "
         "(trainer.skip_nonfinite) skips the step and counts it",

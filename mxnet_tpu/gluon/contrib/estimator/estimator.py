@@ -87,7 +87,8 @@ class Estimator:
             _, label, pred, loss = self.batch_processor.evaluate_batch(
                 self, batch, batch_axis)
             for m in metrics:
-                if isinstance(m, LossMetric):
+                # dispatch on the wrapped type for deferred metrics
+                if isinstance(getattr(m, "_base", m), LossMetric):
                     m.update(None, loss)
                 else:
                     m.update(label, pred)

@@ -86,7 +86,9 @@ class MetricHandler(EpochBegin, BatchEnd):
         loss = kwargs.get("loss")
         for m in self.metrics:
             from ...metric import Loss as LossMetric
-            if isinstance(m, LossMetric):
+            # deferred wrappers (EvalMetric.defer) proxy a base metric;
+            # dispatch on the wrapped type
+            if isinstance(getattr(m, "_base", m), LossMetric):
                 m.update(None, loss)
             else:
                 m.update(label, pred)
@@ -192,12 +194,23 @@ class TelemetryHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
                 pass
         self.reporter.step(**fields)
 
+    @staticmethod
+    def _drain(estimator):
+        # flush device-side accumulators (deferred grad norms) into the
+        # registry before the numbers are read — the epoch boundary is
+        # exactly where the sync-free step loop pays its host syncs
+        trainer = getattr(estimator, "trainer", None)
+        if trainer is not None and hasattr(trainer, "drain_telemetry"):
+            trainer.drain_telemetry()
+
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
+        self._drain(estimator)
         if self.reporter is not None:
             self.reporter.mark("epoch", epoch=self.current_epoch)
 
     def train_end(self, estimator, *args, **kwargs):
+        self._drain(estimator)
         if self.reporter is not None:
             self.run_report = self.reporter.close()
             self.reporter = None

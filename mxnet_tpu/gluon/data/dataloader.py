@@ -23,6 +23,7 @@ import numpy as onp
 from ... import config as _config
 from ... import fault as _fault
 from ... import numpy as _np
+from ... import pipeline as _pipeline
 from ... import telemetry as _telemetry
 from ...numpy.multiarray import ndarray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
@@ -78,8 +79,12 @@ def default_mp_batchify_fn(data):
 # ConnectionWrapper + shared-memory NDArray rebuild over
 # src/storage/cpu_shared_storage_manager.h). Transport here is
 # multiprocessing.shared_memory: the worker writes each batch leaf into a
-# fresh shm block and ships (name, shape, dtype); the main process copies
-# it into a device array and unlinks.
+# shm block and ships (name, shape, dtype, alloc, created); the main
+# process copies it into a device array.  With the dataloader.shm_ring
+# knob (default on) segments are pooled and reused across batches — the
+# per-leaf create/unlink churn made process workers 0.25x thread
+# throughput in BENCH_r05 — otherwise each block is unlinked after its
+# one batch (the historical protocol).
 # ---------------------------------------------------------------------------
 
 _worker_state = {}
@@ -88,21 +93,62 @@ _worker_state = {}
 def _mp_worker_init(dataset, batchify):
     _worker_state["dataset"] = dataset
     _worker_state["batchify"] = batchify
+    _worker_state["segs"] = {}  # name -> SharedMemory (attached handles)
 
 
-def _to_shm(batch):
+def _grant_segment(nbytes, grants):
+    """Pick a segment for one leaf: best-fit from the parent's grant list
+    (mutated: used grants are popped), else create a fresh power-of-2
+    sized block — round sizes recur, so the parent's pool converges on a
+    small set of reusable segments.  Attached handles are cached in
+    ``_worker_state['segs']`` (LRU, bounded) so reuse costs zero
+    open/mmap."""
+    from multiprocessing import shared_memory
+    segs = _worker_state.setdefault("segs", {})
+    best = None
+    for i, (name, size) in enumerate(grants):
+        if size >= nbytes and (best is None or size < grants[best][1]):
+            best = i
+    if best is not None:
+        name, size = grants.pop(best)
+        shm = segs.get(name)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=name)
+                segs[name] = shm
+            except FileNotFoundError:  # parent retired it meanwhile
+                shm = None
+        if shm is not None:
+            segs[name] = segs.pop(name)  # LRU touch
+            return shm, name, size, False
+    size = 1 << (max(nbytes, 1) - 1).bit_length()
+    shm = shared_memory.SharedMemory(create=True, size=size)
+    segs[shm.name] = shm
+    while len(segs) > 64:  # stale handles accumulate only via retires
+        segs.pop(next(iter(segs))).close()
+    return shm, shm.name, size, True
+
+
+def _to_shm(batch, grants=None):
+    """Serialize a batch into shm blocks.  ``grants`` is the mutable list
+    of (name, size) segments the parent loaned this task (ring mode);
+    None means one-shot segments the parent will unlink after copying."""
     from multiprocessing import shared_memory
     if isinstance(batch, (tuple, list)):
-        return (type(batch).__name__, [_to_shm(b) for b in batch])
+        return (type(batch).__name__, [_to_shm(b, grants) for b in batch])
     a = onp.ascontiguousarray(onp.asarray(batch))
-    shm = shared_memory.SharedMemory(create=True, size=max(a.nbytes, 1))
+    if grants is None:
+        shm = shared_memory.SharedMemory(create=True, size=max(a.nbytes, 1))
+        onp.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+        name = shm.name
+        shm.close()
+        return ("arr", name, a.shape, str(a.dtype), max(a.nbytes, 1), True)
+    shm, name, size, created = _grant_segment(a.nbytes, grants)
     onp.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
-    name = shm.name
-    shm.close()
-    return ("arr", name, a.shape, str(a.dtype))
+    return ("arr", name, a.shape, str(a.dtype), size, created)
 
 
-def _mp_worker_task(indices, fault_step=0):
+def _mp_worker_task(indices, fault_step=0, grants=None):
     # fault hooks (armed via MXNET_FAULT_SPEC, inherited by the spawned
     # worker's environment): crash = hard death with no cleanup, the
     # failure a preempted/OOM-killed worker produces; hang = the worker
@@ -115,43 +161,131 @@ def _mp_worker_task(indices, fault_step=0):
         if _fault.fire("dataloader.worker_hang", step=fault_step):
             time.sleep(3600)
     ds, bf = _worker_state["dataset"], _worker_state["batchify"]
-    return _to_shm(bf([ds[i] for i in indices]))
+    grants = list(grants) if grants is not None else None
+    spec = _to_shm(bf([ds[i] for i in indices]), grants)
+    # leftover grants ride back so the parent can return them to the pool
+    return (grants or [], spec)
 
 
-def _free_shm(spec):
-    """Unlink a batch's shm blocks without copying (abandoned iterator)."""
+class _ShmRing:
+    """Parent-side pool of reusable SharedMemory segments.
+
+    Ownership protocol (overwrite-safe by construction): a segment name
+    lives in exactly one place at any time — the free pool, the grant
+    list of one in-flight task, or one unconsumed result spec.
+    ``grant()`` moves names out best-fit against the previous batch's
+    leaf sizes; ``give_back()`` returns them after the device copy;
+    pool overflow unlinks oldest-first (``dataloader.shm_ring_max``).
+    Attached parent mappings are cached so a reused segment costs zero
+    open/mmap on the copy side too.
+    """
+
+    def __init__(self, max_segments):
+        self._free = []       # [(size, name)] insertion order
+        self._attached = {}   # name -> SharedMemory
+        self._max = max(1, int(max_segments))
+        self.last_sizes = []  # leaf nbytes of the most recent batch
+
+    def grant(self):
+        grants = []
+        for want in self.last_sizes:
+            best = None
+            for i, (size, _name) in enumerate(self._free):
+                if size >= want and (best is None
+                                     or size < self._free[best][0]):
+                    best = i
+            if best is not None:
+                size, name = self._free.pop(best)
+                grants.append((name, size))
+        return grants
+
+    def attach(self, name):
+        shm = self._attached.get(name)
+        if shm is None:
+            from multiprocessing import shared_memory
+            shm = shared_memory.SharedMemory(name=name)
+            self._attached[name] = shm
+        return shm
+
+    def give_back(self, name, size):
+        self._free.append((size, name))
+        while len(self._free) > self._max:
+            self._retire(self._free.pop(0)[1])
+
+    def _retire(self, name):
+        from multiprocessing import shared_memory
+        shm = self._attached.pop(name, None)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=name)
+            except FileNotFoundError:
+                return
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    def close(self):
+        """Unlink every pooled segment (DataLoader.close / __del__)."""
+        while self._free:
+            self._retire(self._free.pop()[1])
+        for name in list(self._attached):
+            self._retire(name)
+
+
+def _free_shm(spec, ring=None):
+    """Return a batch's shm blocks without copying (abandoned iterator):
+    back into the ring, or unlinked in one-shot mode."""
     from multiprocessing import shared_memory
     if spec[0] == "arr":
+        _, name, _shape, _dtype, alloc, _created = spec
+        if ring is not None:
+            ring.give_back(name, alloc)
+            return
         try:
-            shm = shared_memory.SharedMemory(name=spec[1])
+            shm = shared_memory.SharedMemory(name=name)
             shm.close()
             shm.unlink()
         except FileNotFoundError:
             pass
         return
     for p in spec[1]:
-        _free_shm(p)
+        _free_shm(p, ring)
 
 
-def _from_shm(spec):
+def _from_shm(spec, ring=None, sizes=None):
     from multiprocessing import shared_memory
     if spec[0] == "arr":
-        _, name, shape, dtype = spec
-        shm = shared_memory.SharedMemory(name=name)
-        try:
-            import jax.numpy as jnp
-            from ...numpy.multiarray import _wrap
+        _, name, shape, dtype, alloc, created = spec
+        import jax.numpy as jnp
+        from ...numpy.multiarray import _wrap
+        if ring is not None:
+            shm = ring.attach(name)
             view = onp.ndarray(shape, dtype, buffer=shm.buf)
             # copy=True is load-bearing: a CPU backend would otherwise
-            # zero-copy the shm mapping, which is unmapped two lines down
+            # zero-copy the mapping, which the ring reuses underneath
             out = _wrap(jnp.array(view, copy=True))
-            out._data.block_until_ready()  # transfer done before unmap
-        finally:
-            shm.close()
-            shm.unlink()
+            out._data.block_until_ready()  # transfer done before reuse
+            if sizes is not None:
+                sizes.append(view.nbytes)
+            ring.give_back(name, alloc)
+            if _telemetry._active:
+                _telemetry.inc("dataloader.shm_created_total" if created
+                               else "dataloader.shm_reused_total")
+        else:
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                view = onp.ndarray(shape, dtype, buffer=shm.buf)
+                # ... which here is unmapped two lines down
+                out = _wrap(jnp.array(view, copy=True))
+                out._data.block_until_ready()
+            finally:
+                shm.close()
+                shm.unlink()
         return out
     kind, parts = spec
-    seq = [_from_shm(p) for p in parts]
+    seq = [_from_shm(p, ring, sizes) for p in parts]
     return tuple(seq) if kind == "tuple" else seq
 
 
@@ -162,7 +296,13 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=None, timeout=120,
-                 try_nopython=None):
+                 try_nopython=None, prefetch_to_device=None,
+                 device_prefetch_depth=None):
+        # prefetch_to_device: None/False = off (the historical behavior);
+        # True = overlap host->device transfer with compute via
+        # mx.pipeline.DevicePrefetcher against the default device; a
+        # jax Device / Sharding (or per-leaf sequence) targets that
+        # placement (sharded training passes the step's batch shardings).
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._num_workers = max(0, num_workers)
@@ -190,6 +330,9 @@ class DataLoader:
         self._force_threads = False   # set after repeated worker crashes
         self._task_seq = 0            # global task counter (fault at=N)
         self._served = 0              # batches handed to the training loop
+        self._prefetch_to_device = prefetch_to_device
+        self._device_prefetch_depth = device_prefetch_depth
+        self._ring = None             # _ShmRing, built lazily by _mp_pump
 
     def _batchify(self, mp_mode):
         if self._user_batchify is not None:
@@ -277,9 +420,23 @@ class DataLoader:
         self._served = (self._batch_sampler.resume_cursor()
                         if hasattr(self._batch_sampler, "resume_cursor")
                         else 0)
-        for batch in self._iter_impl():
-            self._served += 1
-            yield batch
+        src = self._iter_impl()
+        pf = None
+        if self._prefetch_to_device not in (None, False):
+            # the served counter stays on the *consumer* side of the
+            # prefetcher: batches it has buffered but not yet handed out
+            # are replayed after a resume, not skipped
+            target = self._prefetch_to_device
+            pf = src = _pipeline.DevicePrefetcher(
+                src, shardings=None if target is True else target,
+                depth=self._device_prefetch_depth)
+        try:
+            for batch in src:
+                self._served += 1
+                yield batch
+        finally:
+            if pf is not None:
+                pf.close()
 
     def _iter_impl(self):
         if self._num_workers == 0:
@@ -369,8 +526,11 @@ class DataLoader:
         max_respawns = _config.get("dataloader.max_respawns")
         backoff = _config.get("dataloader.respawn_backoff")
         depth = max(1, self._prefetch or self._num_workers)
+        if self._ring is None and _config.get("dataloader.shm_ring"):
+            self._ring = _ShmRing(_config.get("dataloader.shm_ring_max"))
+        ring = self._ring
         todo = collections.deque(self._batch_sampler)
-        inflight = collections.deque()   # (future, indices), oldest first
+        inflight = collections.deque()  # (future, indices, grants), oldest 1st
         crashes = 0
         try:
             while todo or inflight:
@@ -379,30 +539,38 @@ class DataLoader:
                     while todo and len(inflight) < depth:
                         indices = todo.popleft()
                         self._task_seq += 1
+                        grants = ring.grant() if ring is not None else None
                         try:
                             inflight.append(
                                 (pool.submit(_mp_worker_task, indices,
-                                             self._task_seq), indices))
+                                             self._task_seq, grants),
+                                 indices, grants))
                         except BaseException:
                             todo.appendleft(indices)
+                            if ring is not None:
+                                for name, size in grants:
+                                    ring.give_back(name, size)
                             raise
-                    fut, _ = inflight[0]
+                    fut, _, _ = inflight[0]
                     if _telemetry._active:
                         _telemetry.set_gauge("dataloader.queue_depth",
                                              len(inflight))
                         _t0 = time.perf_counter()
-                        spec = fut.result(timeout=self._timeout)
+                        leftover, spec = fut.result(timeout=self._timeout)
                         _telemetry.observe("dataloader.wait_seconds",
                                            time.perf_counter() - _t0)
                         _telemetry.inc("dataloader.batches_total")
                     else:
-                        spec = fut.result(timeout=self._timeout)
+                        leftover, spec = fut.result(timeout=self._timeout)
                     inflight.popleft()
                 except (BrokenProcessPool, cf.BrokenExecutor,
                         cf.TimeoutError, TimeoutError):
                     crashes += 1
-                    self._requeue(todo, inflight)
+                    # kill BEFORE reclaiming grants: a hung-but-alive
+                    # worker could otherwise write into a segment the
+                    # ring has already re-granted to a new task
                     self._kill_pool()
+                    self._requeue(todo, inflight, ring)
                     if crashes > max_respawns:
                         _fault.record("dataloader.fallback_threaded")
                         self._force_threads = True
@@ -413,27 +581,51 @@ class DataLoader:
                         _telemetry.inc("dataloader.respawn_total")
                     time.sleep(backoff * (2 ** (crashes - 1)))
                     continue
-                yield _from_shm(spec)
+                if ring is not None:
+                    for name, size in leftover:
+                        ring.give_back(name, size)
+                    sizes = []
+                    batch = _from_shm(spec, ring, sizes)
+                    ring.last_sizes = sizes
+                else:
+                    batch = _from_shm(spec)
+                yield batch
         finally:
-            for fut, _ in inflight:
+            for fut, _, grants in inflight:
                 try:
-                    _free_shm(fut.result(timeout=self._timeout))
+                    leftover, spec = fut.result(timeout=self._timeout)
+                    if ring is not None:
+                        for name, size in leftover:
+                            ring.give_back(name, size)
+                    _free_shm(spec, ring)
                 except Exception:  # noqa: BLE001 - best-effort cleanup
-                    pass
+                    if ring is not None and grants:
+                        for name, size in grants:
+                            ring.give_back(name, size)
 
     @staticmethod
-    def _requeue(todo, inflight):
+    def _requeue(todo, inflight, ring=None):
         """Move every in-flight batch back onto the queue in order; shm
-        blocks of tasks that did complete are unlinked first (their
-        results are recomputed — a failure-path-only cost)."""
-        for fut, _ in inflight:
+        blocks of tasks that did complete go back to the ring / are
+        unlinked (their results are recomputed — a failure-path-only
+        cost), and unused grants of tasks that didn't are reclaimed.
+        Caller must have torn the pool down first (see _mp_pump)."""
+        for fut, _, grants in inflight:
             if fut.done() and not fut.cancelled() and \
                     fut.exception() is None:
                 try:
-                    _free_shm(fut.result())
+                    leftover, spec = fut.result()
+                    if ring is not None:
+                        for name, size in leftover:
+                            ring.give_back(name, size)
+                    _free_shm(spec, ring)
+                    continue
                 except Exception:  # noqa: BLE001 - best-effort cleanup
                     pass
-        todo.extendleft(indices for _, indices in reversed(inflight))
+            if ring is not None and grants:
+                for name, size in grants:
+                    ring.give_back(name, size)
+        todo.extendleft(indices for _, indices, _ in reversed(inflight))
         inflight.clear()
 
     def _threaded_remainder(self, todo):
@@ -443,9 +635,21 @@ class DataLoader:
             yield from self._pump(pool, self._make_batch, lambda r: r,
                                   todo)
 
+    def close(self):
+        """Release worker pool and pooled shm segments.  Idempotent; also
+        run from __del__, but deterministic teardown (tests, epoch-bounded
+        scripts) should call it explicitly — unlinking pooled segments at
+        GC time races interpreter shutdown."""
+        self._kill_pool()
+        ring, self._ring = self._ring, None
+        if ring is not None:
+            ring.close()
+
     def __del__(self):
-        if getattr(self, "_proc_pool", None) is not None:
-            self._proc_pool.shutdown(wait=False, cancel_futures=True)
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter-shutdown races
+            pass
 
     def __len__(self):
         return len(self._batch_sampler)

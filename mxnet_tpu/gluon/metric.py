@@ -18,6 +18,15 @@ def _as_np(x):
     return x.asnumpy() if isinstance(x, ndarray) else onp.asarray(x)
 
 
+def _raw_dev(x):
+    """Batch leaf as a jax array with NO host fetch (shape/dtype are
+    host-side metadata; values stay device futures)."""
+    import jax.numpy as jnp
+    if isinstance(x, ndarray):
+        return x._data
+    return jnp.asarray(x)
+
+
 def check_label_shapes(labels, preds, shape=False):
     if not shape:
         if len(labels) != len(preds):
@@ -58,6 +67,78 @@ class EvalMetric:
     def update_dict(self, label, pred):
         self.update(list(label.values()), list(pred.values()))
 
+    def defer(self, window=None):
+        """Sync-free view of this metric for the hot step loop.
+
+        Metrics that define ``_device_stats`` (Accuracy, Loss, MSE/RMSE,
+        MAE) accumulate per-batch (sum, count) as device scalars pushed
+        through a bounded ``mx.pipeline.DeferredWindow``; the host
+        ``float()`` happens only when ``get()``/``drain()`` runs (epoch
+        boundaries) or the window overflows.  Metrics without device
+        stats fall back to the eager update.  The wrapper shares state
+        with ``self``: draining folds into this metric's accumulators.
+        """
+        return _DeferredMetric(self, window)
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+class _DeferredMetric:
+    """Duck-typed EvalMetric wrapper created by ``EvalMetric.defer()``.
+
+    Not a subclass on purpose: every read-style attribute (name, axis,
+    sum_metric, ...) proxies to the wrapped metric, so handler code that
+    introspects metrics keeps working; only update/get/reset interpose.
+    """
+
+    def __init__(self, base, window=None):
+        from .. import pipeline as _pipeline
+        self._base = base
+        self._window = _pipeline.DeferredWindow(window)
+
+    def _apply(self, stats):
+        s, n = stats
+        self._base.sum_metric += s
+        self._base.num_inst += int(n)
+
+    def update(self, labels, preds):
+        dev = getattr(self._base, "_device_stats", None)
+        if dev is None:
+            self._base.update(labels, preds)
+            return
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        if len(labels) < len(preds):  # Loss-style metrics ignore labels
+            labels = list(labels) + [None] * (len(preds) - len(labels))
+        for label, pred in zip(labels, preds):
+            self._window.push(dev(label, pred), self._apply)
+
+    def update_dict(self, label, pred):
+        self.update(list(label.values()), list(pred.values()))
+
+    def drain(self):
+        """Fold every deferred batch into the wrapped metric (one host
+        sync per buffered batch, off the hot path)."""
+        self._window.drain()
+
+    def get(self):
+        self.drain()
+        return self._base.get()
+
+    def get_name_value(self):
+        self.drain()
+        return self._base.get_name_value()
+
+    def reset(self):
+        # buffered stats belong to the interval being reset: drop them
+        # WITHOUT fetching (reset must not become a host sync)
+        self._window.clear()
+        self._base.reset()
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
     def __str__(self):
         return f"EvalMetric: {dict(self.get_name_value())}"
 
@@ -83,6 +164,16 @@ class Accuracy(EvalMetric):
             self.sum_metric += float((onp.asarray(pred[:n]) ==
                                       onp.asarray(label[:n])).sum())
             self.num_inst += n
+
+    def _device_stats(self, label, pred):
+        import jax.numpy as jnp
+        label, pred = _raw_dev(label), _raw_dev(pred)
+        if pred.ndim > label.ndim:
+            pred = pred.argmax(self.axis)
+        pred = pred.astype(jnp.int32).ravel()
+        label = label.astype(jnp.int32).ravel()
+        n = int(label.shape[0])
+        return (pred[:n] == label[:n]).sum(), n
 
 
 @register("top_k_accuracy")
@@ -115,6 +206,12 @@ class MAE(EvalMetric):
                                              - pred).mean()) * label.shape[0]
             self.num_inst += label.shape[0]
 
+    def _device_stats(self, label, pred):
+        import jax.numpy as jnp
+        label, pred = _raw_dev(label), _raw_dev(pred)
+        n = int(label.shape[0])
+        return jnp.abs(label.reshape(pred.shape) - pred).mean() * n, n
+
 
 @register()
 class MSE(EvalMetric):
@@ -127,6 +224,11 @@ class MSE(EvalMetric):
             self.sum_metric += float(((label.reshape(pred.shape) - pred) ** 2)
                                      .mean()) * label.shape[0]
             self.num_inst += label.shape[0]
+
+    def _device_stats(self, label, pred):
+        label, pred = _raw_dev(label), _raw_dev(pred)
+        n = int(label.shape[0])
+        return ((label.reshape(pred.shape) - pred) ** 2).mean() * n, n
 
 
 @register()
@@ -394,6 +496,10 @@ class Loss(EvalMetric):
             loss = float(_as_np(pred).sum())
             self.sum_metric += loss
             self.num_inst += _as_np(pred).size
+
+    def _device_stats(self, _label, pred):
+        pred = _raw_dev(pred)
+        return pred.sum(), int(pred.size)
 
 
 @register()

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import optimizer as opt
+from .. import pipeline as _pipeline
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..kvstore import create as create_kvstore, KVStoreBase
@@ -137,6 +138,7 @@ class Trainer:
         self._fused_update = None
         self._finite_check = None
         self._grad_norm_fn = None
+        self._norm_window = None  # mx.pipeline.DeferredWindow, built lazily
         #: steps skipped by the non-finite grad guard (see step())
         self.nonfinite_steps = 0
 
@@ -237,21 +239,60 @@ class Trainer:
             self._finite_check = jax.jit(
                 lambda gs: jnp.all(jnp.asarray(
                     [jnp.isfinite(g).all() for g in gs])))
+        if _pipeline._guard_depth:
+            _pipeline.note_host_sync("trainer.finite_check")
         return bool(self._finite_check(raws))
 
-    def _grad_norm(self):
-        """Global gradient L2 norm as ONE fused XLA reduction (telemetry:
-        the per-step health signal operators watch for divergence)."""
+    def _grad_norm_device(self):
+        """Global gradient L2 norm as ONE fused XLA reduction, returned as
+        an UNFETCHED device scalar so callers choose when (if ever) to pay
+        the host sync."""
         raws = [p.grad()._data for p in self._params
                 if p.grad_req != "null" and p._data is not None]
         if not raws:
-            return 0.0
+            return None
         if self._grad_norm_fn is None:
             self._grad_norm_fn = jax.jit(
                 lambda gs: jnp.sqrt(sum(
                     jnp.sum(jnp.square(g.astype(jnp.float32)))
                     for g in gs)))
-        return float(self._grad_norm_fn(raws))
+        return self._grad_norm_fn(raws)
+
+    def _grad_norm(self):
+        """Global gradient L2 norm as a host float (telemetry: the
+        per-step health signal operators watch for divergence).  This is
+        a host sync — the step loop uses ``_note_grad_norm`` instead,
+        which defers the fetch through a bounded window."""
+        dev = self._grad_norm_device()
+        if dev is None:
+            return 0.0
+        if _pipeline._guard_depth:
+            _pipeline.note_host_sync("trainer.grad_norm")
+        return float(dev)
+
+    @staticmethod
+    def _observe_grad_norm(norm):
+        if math.isfinite(norm):
+            _telemetry.observe("trainer.grad_norm", norm)
+
+    def _note_grad_norm(self):
+        """Record the step's grad norm without syncing: the device scalar
+        is pushed into a bounded DeferredWindow and fetched only when the
+        window overflows or ``drain_telemetry()`` runs (epoch boundaries,
+        snapshots)."""
+        dev = self._grad_norm_device()
+        if dev is None:
+            return
+        if self._norm_window is None:
+            self._norm_window = _pipeline.DeferredWindow()
+        self._norm_window.push(dev, self._observe_grad_norm)
+
+    def drain_telemetry(self):
+        """Fetch every deferred grad-norm into the telemetry histogram.
+        Call at epoch boundaries / before ``mx.telemetry.snapshot()`` for
+        up-to-the-step numbers; the estimator's TelemetryHandler does."""
+        if self._norm_window is not None:
+            self._norm_window.drain()
 
     def _skip_step(self):
         """Count and absorb a non-finite step: weights untouched, the AMP
@@ -284,9 +325,7 @@ class Trainer:
         # metrics wrapper: wall time, step count, and the global grad norm
         # (observed pre-update so a skipped step still reports what blew up)
         t0 = time.perf_counter()
-        norm = self._grad_norm()
-        if math.isfinite(norm):
-            _telemetry.observe("trainer.grad_norm", norm)
+        self._note_grad_norm()
         try:
             return self._step_impl(batch_size, ignore_stale_grad)
         finally:

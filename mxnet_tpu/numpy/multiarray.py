@@ -27,6 +27,7 @@ import numpy as onp
 from .. import autograd
 from .. import engine
 from .. import fault as _fault
+from .. import pipeline as _pipeline
 from .. import telemetry as _telemetry
 from .._jax_compat import enable_x64 as _enable_x64
 from ..base import MXNetError, np_dtype
@@ -455,6 +456,8 @@ class ndarray:
 
     def wait_to_read(self):
         """Block until the value is computed (Engine::WaitForVar analog)."""
+        if _pipeline._guard_depth:
+            _pipeline.note_host_sync("ndarray.wait_to_read")
         self._data.block_until_ready()
         return self
 
@@ -475,6 +478,8 @@ class ndarray:
         hardware); the reference's asnumpy always yields an owned dense
         buffer (ndarray.cc SyncCopyToCPU), so normalize here.
         """
+        if _pipeline._guard_depth:
+            _pipeline.note_host_sync("ndarray.asnumpy")
         host = onp.asarray(jax.device_get(self._data))
         if not (host.flags["C_CONTIGUOUS"] and host.flags["WRITEABLE"]):
             host = host.copy(order="C")  # owned, dense, writable
@@ -484,6 +489,8 @@ class ndarray:
         return self.asnumpy().item()
 
     def item(self, *args):
+        if _pipeline._guard_depth:
+            _pipeline.note_host_sync("ndarray.item")
         return self._data.item(*args)
 
     def tolist(self):

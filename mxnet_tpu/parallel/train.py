@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import functional
+from .. import pipeline as _pipeline
 from ..numpy.multiarray import ndarray, _wrap
 
 # name-pattern Megatron rules for the transformer family
@@ -228,7 +229,10 @@ class ShardedTrainStep:
         from .. import random as _random
         raws = [b._data if isinstance(b, ndarray) else jnp.asarray(b)
                 for b in batch]
-        raws = [jax.device_put(r, s)
+        # ensure_sharded skips the re-put when a DevicePrefetcher (see
+        # .prefetch) already laid the batch out on the step's shardings —
+        # the common case in an overlapped input pipeline
+        raws = [_pipeline.ensure_sharded(r, s)
                 for r, s in zip(raws, self.batch_shardings)]
         rng = _random._next_key()
         lr = jnp.asarray(self.fopt.opt.learning_rate, jnp.float32)
@@ -236,6 +240,19 @@ class ShardedTrainStep:
             self.trainable, self.aux, self.states, rng, lr, *raws)
         self._n_step += self.steps_per_call
         return _wrap(loss)
+
+    def prefetch(self, batches, depth=None, stall_timeout=None):
+        """Wrap a batch iterable in a DevicePrefetcher targeting this
+        step's batch shardings: jax.device_put runs on a background
+        thread while the previous step computes, and __call__'s
+        ensure_sharded detects the layout match and skips the re-put.
+
+            for batch in step.prefetch(loader):
+                loss = step(*batch)
+        """
+        return _pipeline.DevicePrefetcher(
+            iter(batches), shardings=self.batch_shardings, depth=depth,
+            stall_timeout=stall_timeout)
 
     def sync_to_block(self):
         """Write current sharded weights back into the Block's Parameters

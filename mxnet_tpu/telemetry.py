@@ -115,6 +115,11 @@ declare_metric("dataloader.batches_total", "counter",
                "batches produced by worker-backed loaders")
 declare_metric("dataloader.respawn_total", "counter",
                "worker-pool respawns after a crash or missed heartbeat")
+declare_metric("dataloader.shm_created_total", "counter",
+               "SharedMemory segments created by process workers")
+declare_metric("dataloader.shm_reused_total", "counter",
+               "batch leaves served from the shm reuse pool instead of a "
+               "fresh segment")
 declare_metric("trainer.step_seconds", "histogram",
                "wall time of Trainer.step (allreduce + update)",
                buckets=TIME_BUCKETS)

@@ -63,13 +63,53 @@ def test_mp_loader_multiple_epochs_reuse_pool():
 
 
 def test_mp_loader_shm_cleanup():
-    # only the data blocks (SharedMemory psm_*) must be unlinked promptly;
-    # pool-internal semaphores die with the worker processes
+    # the segment ring holds pooled blocks while the loader is alive;
+    # close() must unlink every one (pool-internal semaphores die with
+    # the worker processes)
     before = set(glob.glob("/dev/shm/psm_*"))
     ds = _PyTransformDataset(n=16)
     dl = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=False)
     _ = [b[0].asnumpy() for b in dl]
-    dl._proc_pool.shutdown(wait=True)
+    dl.close()
+    time.sleep(0.2)
+    after = set(glob.glob("/dev/shm/psm_*"))
+    assert not (after - before), after - before
+
+
+def test_mp_loader_shm_ring_reuse():
+    """Epoch 2+ serves most leaves from pooled segments: bounded creates,
+    growing reuse counter (BENCH_r05 proc-vs-thread gap driver)."""
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    try:
+        ds = _PyTransformDataset(n=32)
+        dl = DataLoader(ds, batch_size=8, num_workers=2, thread_pool=False)
+        for _ in range(3):
+            assert len(list(dl)) == 4
+        agg = telemetry.counters(aggregate=True)
+        created = agg.get("dataloader.shm_created_total", 0)
+        reused = agg.get("dataloader.shm_reused_total", 0)
+        # 3 epochs x 4 batches x 2 leaves = 24 leaf transfers
+        assert created + reused == 24
+        assert reused > created, (created, reused)
+        dl.close()
+    finally:
+        telemetry.disable()
+
+
+def test_mp_loader_shm_ring_off_knob():
+    """dataloader.shm_ring=False restores the one-shot create/unlink
+    protocol (and still leaks nothing)."""
+    before = set(glob.glob("/dev/shm/psm_*"))
+    mx.config.set("dataloader.shm_ring", False)
+    try:
+        ds = _PyTransformDataset(n=16)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=False)
+        batches = [b[0].asnumpy() for b in dl]
+        assert len(batches) == 4
+        dl.close()
+    finally:
+        mx.config.reset("dataloader.shm_ring")
     time.sleep(0.2)
     after = set(glob.glob("/dev/shm/psm_*"))
     assert not (after - before), after - before
