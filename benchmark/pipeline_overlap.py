@@ -91,7 +91,7 @@ def _run_overlapped(step, n, seed):
     t0 = time.perf_counter()
     pf = pipeline.DevicePrefetcher(_producer(n, rs), depth=3)
     for x in pf:
-        window.push(step(x._data), acc.append)
+        window.push(step(x), acc.append)
         time.sleep(HOST_MS / 1000.0)   # host-side step overhead
     window.drain()                     # host syncs paid once, at the end
     return time.perf_counter() - t0, sum(acc)

@@ -598,7 +598,14 @@ class DataLoader:
                         for name, size in leftover:
                             ring.give_back(name, size)
                     _free_shm(spec, ring)
-                except Exception:  # noqa: BLE001 - best-effort cleanup
+                # CancelledError: futures we killed the pool under on a
+                # previous loop pass (it subclasses BaseException)
+                except (Exception, cf.CancelledError):  # noqa: BLE001
+                    # a timed-out worker may still be alive and writing
+                    # into its granted segments: kill the pool first so
+                    # the ring never re-grants a segment under a live
+                    # writer (mirrors the crash path above)
+                    self._kill_pool()
                     if ring is not None and grants:
                         for name, size in grants:
                             ring.give_back(name, size)

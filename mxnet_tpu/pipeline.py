@@ -43,7 +43,9 @@ loop, so TrainState bundles stay authoritative and buffered-but-unserved
 batches replay after preemption (tests/test_pipeline.py proves this
 bitwise).  The ``pipeline.prefetch_stall`` fault point wedges the
 background thread between batches; the consumer's stall deadline then
-hands the same source iterator to a replacement thread, preserving order.
+hands the same source iterator to a replacement thread, preserving order
+— and a producer that was merely slow (not wedged) still delivers its
+in-flight batch, because fetch and enqueue are serialized under one lock.
 """
 from __future__ import annotations
 
@@ -194,14 +196,27 @@ def maybe_device_put(raw, target=None):
     return out, True
 
 
+def _local_nbytes(arr):
+    """Bytes this host actually holds of ``arr``: the sum of its
+    addressable shards.  ``arr.nbytes`` is the *global logical* size,
+    which over-reports multi-host/multi-device sharded puts by roughly
+    the shard count."""
+    try:
+        shards = arr.addressable_shards
+    except Exception:  # noqa: BLE001 - non-jax arrays, exotic shardings
+        shards = None
+    if shards:
+        return sum(getattr(s.data, "nbytes", 0) for s in shards)
+    return getattr(arr, "nbytes", 0)
+
+
 def ensure_sharded(raw, sharding):
     """Place one raw array against ``sharding``, skipping the put when its
     layout already matches (the sync-free path for prefetched batches);
     accounts real transfers in ``pipeline.h2d_bytes_total``."""
     out, moved = maybe_device_put(raw, sharding)
     if moved and _telemetry._active:
-        _telemetry.inc("pipeline.h2d_bytes_total",
-                       getattr(out, "nbytes", 0))
+        _telemetry.inc("pipeline.h2d_bytes_total", _local_nbytes(out))
     return out
 
 
@@ -295,8 +310,13 @@ class DevicePrefetcher:
     thread is presumed wedged (fault point ``pipeline.prefetch_stall``
     injects exactly this); a replacement thread takes over the same
     source iterator under a lock, so batches are neither lost nor
-    reordered.  Queue entries are generation-tagged so a zombie thread's
-    leftovers are discarded.
+    reordered.  The whole fetch->put->offer sequence runs under that
+    lock, so even a superseded thread that was merely *slow* inside
+    ``next(source)`` (cold start, heavy augmentation, network FS) still
+    delivers its in-flight batch — the replacement cannot fetch the
+    following batch until the lock is released, and the consumer accepts
+    every queued item because queue order is source order by
+    construction.  Generations exist only to retire replaced threads.
     """
 
     def __init__(self, source, shardings=None, depth=None,
@@ -327,10 +347,14 @@ class DevicePrefetcher:
     def _stale(self, gen):
         return self._closed.is_set() or gen != self._gen
 
-    def _offer(self, gen, item):
-        while not self._stale(gen):
+    def _offer(self, item):
+        """Enqueue one item.  Called with ``_source_lock`` held, so queue
+        order is source order even across a stall-recovery handover.
+        Aborts only on close — a superseded thread's in-flight batch is
+        still valid and must not be dropped."""
+        while not self._closed.is_set():
             try:
-                self._q.put((gen, item), timeout=0.1)
+                self._q.put(item, timeout=0.1)
                 return True
             except queue.Full:
                 continue
@@ -344,21 +368,28 @@ class DevicePrefetcher:
                 while not self._stale(gen):
                     time.sleep(0.02)
                 return
-            try:
-                with self._source_lock:
-                    if self._stale(gen):
-                        return
+            with self._source_lock:
+                # superseded while waiting for the lock: nothing fetched
+                # yet, so retire and let the replacement take over
+                if self._stale(gen):
+                    return
+                try:
                     try:
                         item = next(self._source)
                     except StopIteration:
-                        self._offer(gen, _DONE)
+                        self._offer(_DONE)
                         return
-                payload = self._put_batch(item)
-            except BaseException as exc:  # noqa: BLE001 - ship to consumer
-                self._offer(gen, _Raise(exc))
-                return
-            if not self._offer(gen, payload):
-                return
+                    # the offer stays under the lock on purpose: if this
+                    # thread was declared stalled while inside next(), a
+                    # slow-but-alive producer still hands its batch on
+                    # instead of dropping it, and the replacement (blocked
+                    # on the lock) cannot fetch the following batch first
+                    payload = self._put_batch(item)
+                except BaseException as exc:  # noqa: BLE001 - to consumer
+                    self._offer(_Raise(exc))
+                    return
+                if not self._offer(payload):
+                    return
 
     def _target_for(self, n):
         sh = self._shardings
@@ -384,7 +415,10 @@ class DevicePrefetcher:
                 or hasattr(raw, "__array__")):
             return leaf  # non-array payload (ids, metadata) passes through
         out = ensure_sharded(raw, target)
-        return nd._wrap(out)
+        # leaves keep their flavor: mx ndarrays come back as mx ndarrays,
+        # raw numpy/jax leaves come back as device-placed jax.Arrays — no
+        # silent type change for users prefetching plain jax pipelines
+        return nd._wrap(out) if wrap else out
 
     # -- consumer side ------------------------------------------------------
 
@@ -400,15 +434,16 @@ class DevicePrefetcher:
         deadline = t0 + self._stall_timeout
         while True:
             try:
-                gen, item = self._q.get(timeout=min(
+                # every queued item is valid regardless of which thread
+                # generation offered it: offers happen under _source_lock,
+                # so queue order is source order by construction
+                item = self._q.get(timeout=min(
                     0.2, max(0.001, deadline - time.perf_counter())))
+                break
             except queue.Empty:
                 if time.perf_counter() >= deadline:
                     self._recover_stall()
                     deadline = time.perf_counter() + self._stall_timeout
-                continue
-            if gen == self._gen:
-                break
         if _telemetry._active:
             _telemetry.observe("pipeline.input_stall_seconds",
                                time.perf_counter() - t0)
@@ -424,12 +459,14 @@ class DevicePrefetcher:
         return item
 
     def _recover_stall(self):
-        """Replace a wedged prefetch thread: bump the generation (queue
-        leftovers and the zombie's future puts become stale) and hand the
-        source iterator to a fresh thread.  Works when the thread stalled
-        between batches (the injected failure mode); a thread wedged
-        *inside* ``next(source)`` holds the source lock and must be cured
-        at the source (e.g. the DataLoader's own heartbeat respawn)."""
+        """Replace a presumed-wedged prefetch thread: bump the generation
+        (the old thread retires at its next loop-top check) and hand the
+        source iterator to a fresh thread.  Lossless when the old thread
+        was merely slow rather than wedged: it still holds the source
+        lock, so it delivers its in-flight batch before the replacement
+        can fetch the next one.  A thread wedged forever *inside*
+        ``next(source)`` keeps the lock and must be cured at the source
+        (e.g. the DataLoader's own heartbeat respawn)."""
         _fault.record("pipeline.stall_recovered")
         if _telemetry._active:
             _telemetry.inc("pipeline.stall_recovered_total")

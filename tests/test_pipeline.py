@@ -44,7 +44,19 @@ def test_prefetcher_preserves_order_and_values():
     out = list(pipeline.DevicePrefetcher(iter(src)))
     assert len(out) == 6
     for a, b in zip(out, src):
-        onp.testing.assert_array_equal(a.asnumpy(), b)
+        onp.testing.assert_array_equal(onp.asarray(a), b)
+
+
+def test_prefetcher_preserves_leaf_type():
+    """Raw numpy/jax leaves come back as device-placed jax.Arrays; mx
+    ndarray leaves come back as mx ndarrays — no silent type change."""
+    import jax
+    raw_out = next(iter(pipeline.DevicePrefetcher(iter(_arrays(1)))))
+    assert isinstance(raw_out, jax.Array)
+    nd_src = [mx.np.array(a) for a in _arrays(2)]
+    for got, want in zip(pipeline.DevicePrefetcher(iter(nd_src)), nd_src):
+        assert isinstance(got, mx.np.ndarray)
+        onp.testing.assert_array_equal(got.asnumpy(), want.asnumpy())
 
 
 def test_prefetcher_tuple_batches_and_passthrough_payloads():
@@ -53,7 +65,8 @@ def test_prefetcher_tuple_batches_and_passthrough_payloads():
             yield (onp.full((2, 2), i, dtype="float32"), {"meta": i})
     out = list(pipeline.DevicePrefetcher(gen()))
     for i, (arr, meta) in enumerate(out):
-        onp.testing.assert_array_equal(arr.asnumpy(), onp.full((2, 2), i))
+        onp.testing.assert_array_equal(onp.asarray(arr),
+                                       onp.full((2, 2), i))
         assert meta == {"meta": i}  # non-array payloads ride along
 
 
@@ -117,13 +130,35 @@ def test_prefetcher_stall_recovery_preserves_order():
     mx.fault.configure("pipeline.prefetch_stall:at=2,times=1")
     src = _arrays(5)
     pf = pipeline.DevicePrefetcher(iter(src), depth=2, stall_timeout=0.4)
-    out = [b.asnumpy() for b in pf]
+    out = [onp.asarray(b) for b in pf]
     assert len(out) == 5
     for a, b in zip(out, src):
         onp.testing.assert_array_equal(a, b)
     assert mx.fault.stats().get("pipeline.stall_recovered", 0) >= 1
     snap = telemetry.counters(aggregate=True)
     assert snap.get("pipeline.stall_recovered_total", 0) >= 1
+
+
+def test_prefetcher_slow_producer_loses_no_batches():
+    """A producer slower than stall_timeout (cold start, heavy
+    augmentation, network FS) triggers stall recovery, but its in-flight
+    batch is handed over under the source lock — not dropped — so the
+    consumer still sees every batch in order."""
+    src = _arrays(5)
+
+    def gen():
+        for i, a in enumerate(src):
+            if i == 2:
+                time.sleep(0.9)  # > stall_timeout: slow, not wedged
+            yield a
+
+    pf = pipeline.DevicePrefetcher(gen(), depth=2, stall_timeout=0.3)
+    out = [onp.asarray(b) for b in pf]
+    assert len(out) == 5
+    for a, b in zip(out, src):
+        onp.testing.assert_array_equal(a, b)
+    # recovery DID fire (the deadline passed) and yet nothing was lost
+    assert mx.fault.stats().get("pipeline.stall_recovered", 0) >= 1
 
 
 def test_prefetch_to_device_disabled_is_identity():
