@@ -21,6 +21,10 @@
 #                >=1.2x with input-stall below the serial producer wait,
 #                and the disabled path must stay <2% on a tight eager
 #                loop (docs/PERFORMANCE.md)
+#   zero       - ZeRO-sharded training suite + the optimizer-state
+#                memory benchmark: zero=1 on a 4-way dp mesh must cut
+#                per-device state bytes >=40% while staying numerically
+#                invisible (docs/PERFORMANCE.md)
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -29,7 +33,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -181,6 +185,13 @@ pipeline() {
     JAX_PLATFORMS=cpu python benchmark/pipeline_overlap.py
 }
 
+zero() {
+    echo "== zero: ZeRO-sharded training suite (docs/PERFORMANCE.md) =="
+    python -m pytest tests/test_zero.py -q
+    echo "== zero: per-device optimizer-state memory (>=40% cut at dp=4) =="
+    JAX_PLATFORMS=cpu python benchmark/zero_memory.py
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -209,8 +220,9 @@ case "$stage" in
     telemetry) telemetry ;;
     resilience) resilience ;;
     pipeline) pipeline ;;
+    zero) zero ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -45,6 +45,45 @@ def _is_nd(x):
     return isinstance(x, ndarray)
 
 
+#: sentinel for "rematerialization disabled" (a policy of None is meaningful
+#: to jax.checkpoint: it means save nothing, i.e. full remat)
+_REMAT_OFF = object()
+
+_REMAT_POLICIES = {
+    "dots": "dots_saveable",
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims": "dots_with_no_batch_dims_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    "nothing": "nothing_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def resolve_remat_policy(remat):
+    """Map a ``hybridize(remat=...)`` value onto a ``jax.checkpoint`` policy.
+
+    ``False``/``None`` — off. ``True`` — full rematerialization (only the
+    inputs are saved; everything recomputes in the backward pass).
+    ``'dots'`` — selective: matmul/einsum outputs are saved, cheap
+    elementwise ops recompute (``jax.checkpoint_policies.dots_saveable``,
+    the usual sweet spot for transformer blocks).
+    ``'dots_with_no_batch_dims'`` — save only weight-stationary matmuls.
+    A callable is used as the policy directly.
+    """
+    if remat is None or remat is False:
+        return _REMAT_OFF
+    if remat is True:
+        return None
+    if callable(remat):
+        return remat
+    attr = _REMAT_POLICIES.get(remat)
+    if attr is None or not hasattr(jax.checkpoint_policies, attr):
+        raise MXNetError(
+            f"unknown remat policy {remat!r}: expected True/False, one of "
+            f"{sorted(set(_REMAT_POLICIES))}, or a policy callable")
+    return getattr(jax.checkpoint_policies, attr)
+
+
 def _flatten_args(args):
     leaves, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
     return leaves, treedef
@@ -309,6 +348,19 @@ class _CachedGraph:
             transform = library.subgraph_backend(block._backend)
             pure = transform(pure, block,
                              **(block._flags.get("backend_opts") or {}))
+        policy = resolve_remat_policy(block._flags.get("remat")) \
+            if getattr(block, "_flags", None) else _REMAT_OFF
+        if policy is not _REMAT_OFF:
+            # selective rematerialization: under autograd the whole forward
+            # replays per the policy instead of saving every activation
+            import functools as _ft
+            inner_pure = pure
+
+            def pure(trainable_raws, aux_raws, input_raws, rng_key,
+                     sig_key):
+                fn = _ft.partial(inner_pure, sig_key=sig_key)
+                return jax.checkpoint(fn, policy=policy)(
+                    trainable_raws, aux_raws, input_raws, rng_key)
         self._jit = jax.jit(pure, static_argnames=("sig_key",))
         self._signatures = {}  # sig_key -> (treedef, static_leaves)
         self._out_trees = {}   # sig_key -> output treedef (set at trace time)
@@ -662,7 +714,14 @@ class HybridBlock(Block):
                   **kwargs):
         """Reference: block.py hybridize. static_alloc/static_shape map to
         XLA buffer donation/compiled executables — both are automatic here;
-        the flags are accepted for compatibility."""
+        the flags are accepted for compatibility.
+
+        ``remat=`` selects activation rematerialization for the compiled
+        forward under autograd: True (full), 'dots' / another name from
+        ``resolve_remat_policy``, or a ``jax.checkpoint`` policy callable.
+        ``parallel.ShardedTrainStep`` honors the same flag.
+        """
+        resolve_remat_policy(kwargs.get("remat"))  # fail fast on bad values
         self._active = active
         if backend is not None:
             from .. import library

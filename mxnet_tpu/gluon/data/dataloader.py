@@ -78,13 +78,16 @@ def default_mp_batchify_fn(data):
 # multiprocess workers (reference: dataloader.py:28-187 worker_loop +
 # ConnectionWrapper + shared-memory NDArray rebuild over
 # src/storage/cpu_shared_storage_manager.h). Transport here is
-# multiprocessing.shared_memory: the worker writes each batch leaf into a
-# shm block and ships (name, shape, dtype, alloc, created); the main
-# process copies it into a device array.  With the dataloader.shm_ring
-# knob (default on) segments are pooled and reused across batches — the
-# per-leaf create/unlink churn made process workers 0.25x thread
-# throughput in BENCH_r05 — otherwise each block is unlinked after its
-# one batch (the historical protocol).
+# multiprocessing.shared_memory: the worker packs ALL leaves of a batch
+# into ONE shm segment at 64-byte-aligned offsets and ships a single
+# ("pack", name, tree, alloc, created) spec whose tree leaves carry
+# (shape, dtype, offset); the main process copies each leaf out into a
+# device array.  One grant/attach/give_back per BATCH instead of per
+# leaf — the per-leaf segment churn (and its per-leaf pool round trips)
+# made process workers 0.25x thread throughput in BENCH_r05.  With the
+# dataloader.shm_ring knob (default on) segments are pooled and reused
+# across batches; otherwise each segment is unlinked after its one batch
+# (the historical protocol).
 # ---------------------------------------------------------------------------
 
 _worker_state = {}
@@ -97,7 +100,7 @@ def _mp_worker_init(dataset, batchify):
 
 
 def _grant_segment(nbytes, grants):
-    """Pick a segment for one leaf: best-fit from the parent's grant list
+    """Pick a segment for one packed batch: best-fit from the parent's grant list
     (mutated: used grants are popped), else create a fresh power-of-2
     sized block — round sizes recur, so the parent's pool converges on a
     small set of reusable segments.  Attached handles are cached in
@@ -129,23 +132,49 @@ def _grant_segment(nbytes, grants):
     return shm, shm.name, size, True
 
 
-def _to_shm(batch, grants=None):
-    """Serialize a batch into shm blocks.  ``grants`` is the mutable list
-    of (name, size) segments the parent loaned this task (ring mode);
-    None means one-shot segments the parent will unlink after copying."""
-    from multiprocessing import shared_memory
+#: leaf offsets inside a packed segment are cache-line aligned so the
+#: consumer-side views copy at full memcpy speed
+_PACK_ALIGN = 64
+
+
+def _pack_layout(batch, leaves, offset):
+    """Flatten ``batch`` into ``leaves`` ([(array, offset)], appended in
+    tree order, offsets :data:`_PACK_ALIGN`-aligned) and return
+    ``(tree, end)`` where the tree's leaves are ("leaf", shape, dtype,
+    offset) and ``end`` is the packed payload size so far."""
     if isinstance(batch, (tuple, list)):
-        return (type(batch).__name__, [_to_shm(b, grants) for b in batch])
+        parts = []
+        for b in batch:
+            sub, offset = _pack_layout(b, leaves, offset)
+            parts.append(sub)
+        return (type(batch).__name__, parts), offset
     a = onp.ascontiguousarray(onp.asarray(batch))
+    offset = -(-offset // _PACK_ALIGN) * _PACK_ALIGN
+    leaves.append((a, offset))
+    return ("leaf", a.shape, str(a.dtype), offset), offset + a.nbytes
+
+
+def _to_shm(batch, grants=None):
+    """Serialize one batch into a SINGLE packed shm segment (all leaves
+    at aligned offsets behind one header) so the whole batch costs one
+    grant/attach/give_back round trip.  ``grants`` is the mutable list of
+    (name, size) segments the parent loaned this task (ring mode; used
+    grants are popped); None means a one-shot segment the parent will
+    unlink after copying."""
+    from multiprocessing import shared_memory
+    leaves = []
+    tree, total = _pack_layout(batch, leaves, 0)
+    total = max(total, 1)
     if grants is None:
-        shm = shared_memory.SharedMemory(create=True, size=max(a.nbytes, 1))
-        onp.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
-        name = shm.name
+        shm = shared_memory.SharedMemory(create=True, size=total)
+        name, size, created = shm.name, total, True
+    else:
+        shm, name, size, created = _grant_segment(total, grants)
+    for a, off in leaves:
+        onp.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)[...] = a
+    if grants is None:
         shm.close()
-        return ("arr", name, a.shape, str(a.dtype), max(a.nbytes, 1), True)
-    shm, name, size, created = _grant_segment(a.nbytes, grants)
-    onp.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
-    return ("arr", name, a.shape, str(a.dtype), size, created)
+    return ("pack", name, tree, size, created)
 
 
 def _mp_worker_task(indices, fault_step=0, grants=None):
@@ -174,7 +203,8 @@ class _ShmRing:
     lives in exactly one place at any time — the free pool, the grant
     list of one in-flight task, or one unconsumed result spec.
     ``grant()`` moves names out best-fit against the previous batch's
-    leaf sizes; ``give_back()`` returns them after the device copy;
+    packed-segment size; ``give_back()`` returns them after the device
+    copy;
     pool overflow unlinks oldest-first (``dataloader.shm_ring_max``).
     Attached parent mappings are cached so a reused segment costs zero
     open/mmap on the copy side too.
@@ -184,7 +214,7 @@ class _ShmRing:
         self._free = []       # [(size, name)] insertion order
         self._attached = {}   # name -> SharedMemory
         self._max = max(1, int(max_segments))
-        self.last_sizes = []  # leaf nbytes of the most recent batch
+        self.last_sizes = []  # packed segment bytes of the latest batch
 
     def grant(self):
         grants = []
@@ -235,58 +265,60 @@ class _ShmRing:
 
 
 def _free_shm(spec, ring=None):
-    """Return a batch's shm blocks without copying (abandoned iterator):
-    back into the ring, or unlinked in one-shot mode."""
+    """Return a batch's packed shm segment without copying (abandoned
+    iterator): back into the ring, or unlinked in one-shot mode."""
     from multiprocessing import shared_memory
-    if spec[0] == "arr":
-        _, name, _shape, _dtype, alloc, _created = spec
-        if ring is not None:
-            ring.give_back(name, alloc)
-            return
-        try:
-            shm = shared_memory.SharedMemory(name=name)
-            shm.close()
-            shm.unlink()
-        except FileNotFoundError:
-            pass
+    _, name, _tree, alloc, _created = spec
+    if ring is not None:
+        ring.give_back(name, alloc)
         return
-    for p in spec[1]:
-        _free_shm(p, ring)
+    try:
+        shm = shared_memory.SharedMemory(name=name)
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _unpack_tree(tree, buf):
+    """Copy every leaf of a packed segment out of ``buf`` into device
+    arrays, rebuilding the original tuple/list nesting."""
+    if tree[0] == "leaf":
+        _, shape, dtype, off = tree
+        import jax.numpy as jnp
+        from ...numpy.multiarray import _wrap
+        view = onp.ndarray(shape, dtype, buffer=buf, offset=off)
+        # copy=True is load-bearing: a CPU backend would otherwise
+        # zero-copy the mapping, which the ring reuses underneath
+        out = _wrap(jnp.array(view, copy=True))
+        out._data.block_until_ready()  # transfer done before reuse
+        return out
+    kind, parts = tree
+    seq = [_unpack_tree(p, buf) for p in parts]
+    return tuple(seq) if kind == "tuple" else seq
 
 
 def _from_shm(spec, ring=None, sizes=None):
     from multiprocessing import shared_memory
-    if spec[0] == "arr":
-        _, name, shape, dtype, alloc, created = spec
-        import jax.numpy as jnp
-        from ...numpy.multiarray import _wrap
-        if ring is not None:
-            shm = ring.attach(name)
-            view = onp.ndarray(shape, dtype, buffer=shm.buf)
-            # copy=True is load-bearing: a CPU backend would otherwise
-            # zero-copy the mapping, which the ring reuses underneath
-            out = _wrap(jnp.array(view, copy=True))
-            out._data.block_until_ready()  # transfer done before reuse
-            if sizes is not None:
-                sizes.append(view.nbytes)
-            ring.give_back(name, alloc)
-            if _telemetry._active:
-                _telemetry.inc("dataloader.shm_created_total" if created
-                               else "dataloader.shm_reused_total")
-        else:
-            shm = shared_memory.SharedMemory(name=name)
-            try:
-                view = onp.ndarray(shape, dtype, buffer=shm.buf)
-                # ... which here is unmapped two lines down
-                out = _wrap(jnp.array(view, copy=True))
-                out._data.block_until_ready()
-            finally:
-                shm.close()
-                shm.unlink()
-        return out
-    kind, parts = spec
-    seq = [_from_shm(p, ring, sizes) for p in parts]
-    return tuple(seq) if kind == "tuple" else seq
+    _, name, tree, alloc, created = spec
+    if ring is not None:
+        shm = ring.attach(name)
+        out = _unpack_tree(tree, shm.buf)
+        if sizes is not None:
+            sizes.append(alloc)
+        ring.give_back(name, alloc)
+        if _telemetry._active:
+            _telemetry.inc("dataloader.shm_created_total" if created
+                           else "dataloader.shm_reused_total")
+    else:
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            out = _unpack_tree(tree, shm.buf)
+        finally:
+            # ... the one-shot mapping instead dies right here
+            shm.close()
+            shm.unlink()
+    return out
 
 
 class DataLoader:

@@ -288,11 +288,14 @@ class Trainer:
         self._norm_window.push(dev, self._observe_grad_norm)
 
     def drain_telemetry(self):
-        """Fetch every deferred grad-norm into the telemetry histogram.
-        Call at epoch boundaries / before ``mx.telemetry.snapshot()`` for
-        up-to-the-step numbers; the estimator's TelemetryHandler does."""
+        """Fetch every deferred grad-norm into the telemetry histogram and
+        refresh the per-device ``memory.*`` gauges.  Call at epoch
+        boundaries / before ``mx.telemetry.snapshot()`` for up-to-the-step
+        numbers; the estimator's TelemetryHandler does."""
         if self._norm_window is not None:
             self._norm_window.drain()
+        if _telemetry._active:
+            _telemetry.record_memory()
 
     def _skip_step(self):
         """Count and absorb a non-finite step: weights untouched, the AMP

@@ -44,6 +44,14 @@ class Optimizer:
     #   "adam": _rule(w, g, m, v, lr, wd, t, beta1, beta2, eps, rescale, clip)
     _FUSED_FAMILY = None
 
+    # Whether the update rule is elementwise, i.e. computing it on an
+    # arbitrary 1-D shard of the (weight, grad, state) tensors yields the
+    # same values as on the whole tensor. ZeRO partitioning
+    # (parallel.ShardedTrainStep(zero=...)) requires this; layer-norm-scaled
+    # rules (LAMB/LANS/LARS: jnp.linalg.norm over the full layer) and rules
+    # drawing fresh host RNG per tensor (SGLD) opt out.
+    _zero_partitionable = True
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
@@ -104,6 +112,16 @@ class Optimizer:
     @property
     def learning_rate(self):
         return self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+
+    @staticmethod
+    def _bc_t(t):
+        """Bias-correction step count as fed to update rules: a python float
+        in the eager path, a traced f32 scalar when the compiled train step
+        (parallel.ShardedTrainStep) threads the count through the jit
+        boundary so warmup/bias correction advance without retracing."""
+        if isinstance(t, jax.Array):
+            return jnp.maximum(t.astype(jnp.float32), 1.0)
+        return float(max(t, 1))
 
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult = dict(args_lr_mult)
@@ -307,6 +325,8 @@ class Signum(Optimizer):
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference: optimizer/sgld.py)."""
 
+    _zero_partitionable = False  # fresh host RNG per full tensor
+
     def _update_impl(self, w, g, state, lr, wd):
         from .. import random as _random
         g = self._prep_grad(g) + wd * w
@@ -347,7 +367,7 @@ class Adam(Optimizer):
         t = self._index_update_count.get(self._cur_index, self.num_update) \
             if hasattr(self, "_cur_index") else self.num_update
         new_w, nm, nv = self._rule(w, g, m._data, v._data, lr, wd,
-                                   float(max(t, 1)), self.beta1, self.beta2,
+                                   self._bc_t(t), self.beta1, self.beta2,
                                    self.epsilon, self.rescale_grad,
                                    self.clip_gradient or -1.0)
         m._rebind(nm)
@@ -461,7 +481,7 @@ class FTML(Optimizer):
         t = self._index_update_count.get(self._cur_index, self.num_update) \
             if hasattr(self, "_cur_index") else self.num_update
         new_w, nd, nv, nz = self._rule(w, g, d._data, v._data, z._data, lr,
-                                       wd, float(max(t, 1)), self.beta1,
+                                       wd, self._bc_t(t), self.beta1,
                                        self.beta2, self.epsilon,
                                        self.rescale_grad,
                                        self.clip_gradient or -1.0)
@@ -625,6 +645,8 @@ class LAMB(Optimizer):
     """Layer-wise adaptive moments (reference: optimizer/lamb.py over
     lamb_update_phase1/2, optimizer_op.cc:1039-1130)."""
 
+    _zero_partitionable = False  # layer-wise norms need the whole tensor
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
                  bias_correction=True, **kwargs):
@@ -674,6 +696,8 @@ class LANS(LAMB):
 @register
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (reference: optimizer/lars.py)."""
+
+    _zero_partitionable = False  # trust ratio needs whole-tensor norms
 
     def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
                  epsilon=1e-8, **kwargs):

@@ -5,6 +5,16 @@ comm_tree.h (topology-aware tree allreduce), kvstore_nccl.h, and ps-lite's
 cross-host path — all collapsed into XLA AllReduce/AllGather/ReduceScatter/
 CollectivePermute over mesh axes: ICI within a slice, DCN across slices.
 Topology solving (gpu_topology.h) is the ICI fabric's job; nothing to port.
+
+These free functions are the standalone/kvstore entry points.  The ZeRO
+update in ``train.ShardedTrainStep`` uses the same shard_map idioms but
+keeps its reduce-scatter/all-gather INSIDE the jitted step (an in_spec
+``P(dp)`` on logically-reduced grads is the reduce-scatter under GSPMD;
+``jax.lax.all_gather(..., tiled=True)`` with ``check_vma=False``
+re-assembles params, exactly as :func:`allgather` below) so XLA can
+overlap them with compute; traffic is counted by the
+``zero.reduce_scatter_bytes_total`` / ``zero.all_gather_bytes_total``
+telemetry counters.
 """
 from __future__ import annotations
 

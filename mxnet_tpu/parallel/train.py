@@ -12,13 +12,28 @@ overlap re-created at compile time.
 """
 from __future__ import annotations
 
+import functools
+
+import numpy as onp
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import functional
 from .. import pipeline as _pipeline
+from .. import telemetry as _telemetry
+from ..base import MXNetError
 from ..numpy.multiarray import ndarray, _wrap
+
+_telemetry.declare_metric(
+    "zero.reduce_scatter_bytes_total", "counter",
+    "logical bytes reduce-scattered over the dp axis by ZeRO gradient "
+    "partitioning (per optimizer update, padded flat layout)")
+_telemetry.declare_metric(
+    "zero.all_gather_bytes_total", "counter",
+    "logical bytes all-gathered over the dp axis re-assembling ZeRO-updated "
+    "parameters")
 
 # name-pattern Megatron rules for the transformer family
 # (column-parallel: shard Dense units; row-parallel: shard in_units, psum)
@@ -65,24 +80,34 @@ class FunctionalOptimizer:
                 is_leaf=lambda x: isinstance(x, ndarray))
         return states
 
-    def update(self, raw_params, raw_grads, states, lr=None):
+    def update(self, raw_params, raw_grads, states, lr=None, t=None):
         new_p, new_s = {}, {}
-        for name in raw_params:
-            if name not in raw_grads:
-                new_p[name] = raw_params[name]
-                new_s[name] = states[name]
-                continue
-            wd = self.opt._get_wd(name)
-            lr_i = lr if lr is not None else self.opt._get_lr(name)
-            wrapped = jax.tree_util.tree_map(
-                _wrap, states[name],
-                is_leaf=lambda x: x is None)
-            w, s = self.opt._update_impl(
-                raw_params[name], raw_grads[name], wrapped, lr_i, wd)
-            new_p[name] = w.astype(raw_params[name].dtype)
-            new_s[name] = jax.tree_util.tree_map(
-                lambda x: x._data if isinstance(x, ndarray) else x, s,
-                is_leaf=lambda x: isinstance(x, ndarray))
+        saved_count = self.opt.num_update
+        if t is not None:
+            # thread the (traced) step count into the update rules so
+            # Adam-family bias correction advances inside the compiled step;
+            # restored below so host-side bookkeeping never sees a tracer
+            self.opt.num_update = t
+        try:
+            for name in raw_params:
+                if name not in raw_grads:
+                    new_p[name] = raw_params[name]
+                    new_s[name] = states[name]
+                    continue
+                wd = self.opt._get_wd(name)
+                lr_i = lr if lr is not None else self.opt._get_lr(name)
+                wrapped = jax.tree_util.tree_map(
+                    _wrap, states[name],
+                    is_leaf=lambda x: x is None)
+                w, s = self.opt._update_impl(
+                    raw_params[name], raw_grads[name], wrapped, lr_i, wd)
+                new_p[name] = w.astype(raw_params[name].dtype)
+                new_s[name] = jax.tree_util.tree_map(
+                    lambda x: x._data if isinstance(x, ndarray) else x, s,
+                    is_leaf=lambda x: isinstance(x, ndarray))
+        finally:
+            if t is not None:
+                self.opt.num_update = saved_count
         return new_p, new_s
 
 
@@ -123,18 +148,48 @@ class ShardedTrainStep:
         e.g. (P('dp', 'sp'), P('dp',)).
     param_specs: dict name -> PartitionSpec; defaults to megatron_specs
         when the mesh has a tp axis else fully replicated.
+    zero: ZeRO optimizer-state partitioning level over the dp axis.
+        0 — state shards like its weight (replicated under pure dp).
+        1 — optimizer state lives in 1/dp flat shards; each step
+        reduce-scatters grads, updates the local shard, all-gathers the
+        new params — all inside the one jitted program so XLA overlaps
+        the collectives with compute.
+        2 — additionally keeps reduced gradients (incl. the grad_accum
+        accumulator) laid out in the same 1/dp shards, so full gradients
+        never materialize replicated.
+    grad_accum: accumulate gradients over K lax.scan microbatches before
+        ONE optimizer update (batch arrays gain a leading K axis).
+        Distinct from steps_per_call, which applies an update every step.
+    remat: activation rematerialization for the fwd/bwd inside the step —
+        same values as ``HybridBlock.hybridize(remat=...)`` (True,
+        'dots', a policy callable); None inherits the block's hybridize
+        flag.
     """
 
     def __init__(self, block, loss_fn, optimizer, mesh, batch_specs,
                  n_labels=1, param_specs=None, donate=True,
-                 steps_per_call=1):
+                 steps_per_call=1, zero=0, grad_accum=1, remat=None,
+                 dp_axis="dp"):
         from ..optimizer import optimizer as opt_mod
+        from ..gluon.block import resolve_remat_policy, _REMAT_OFF
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer)
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.n_labels = n_labels
+        self.dp_axis = dp_axis
+        self.zero = int(zero)
+        self.grad_accum = int(grad_accum)
+        self.steps_per_call = int(steps_per_call)
+        if self.zero not in (0, 1, 2):
+            raise MXNetError(f"zero must be 0, 1 or 2, got {zero}")
+        if self.grad_accum < 1:
+            raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
+        if remat is None and isinstance(getattr(block, "_flags", None), dict):
+            remat = block._flags.get("remat")
+        self._remat_policy = resolve_remat_policy(remat)
+        self._remat_on = self._remat_policy is not _REMAT_OFF
         trainable, aux = functional.split_params(block)
         shapes = {n: v.shape for n, v in trainable.items()}
         shapes.update({n: v.shape for n, v in aux.items()})
@@ -155,23 +210,63 @@ class ShardedTrainStep:
         self.aux = {
             n: jax.device_put(v, sh(param_specs.get(n, P())))
             for n, v in aux.items()}
-        states = self.fopt.init(self.trainable)
-        # optimizer state shards like its weight
-        self.states = jax.tree_util.tree_map(
-            lambda x: x, states)
-        self.states = {
-            n: jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sh(param_specs.get(n, P())))
+
+        # -- ZeRO layout: which params get dp-partitioned optimizer state --
+        if self.zero and dp_axis not in mesh.shape:
+            raise MXNetError(
+                f"zero={self.zero} requires a '{dp_axis}' mesh axis; "
+                f"mesh has {tuple(mesh.shape)}")
+        if self.zero and not type(self.fopt.opt)._zero_partitionable:
+            raise MXNetError(
+                f"{type(self.fopt.opt).__name__} is not elementwise "
+                "(layer-wise norms / per-tensor RNG); it cannot run on "
+                "ZeRO shards — use zero=0")
+        dp_n = int(mesh.shape[dp_axis]) if self.zero else 1
+        # name -> (shape, size, padded_size); only params replicated by
+        # param_specs are partitioned — tp/ep-sharded params keep the
+        # state-shards-like-weight layout
+        self._zero = {}
+        if self.zero:
+            for n, v in self.trainable.items():
+                spec = param_specs.get(n, P())
+                if any(e is not None for e in spec):
+                    continue
+                size = int(v.size)
+                padded = -(-size // dp_n) * dp_n
+                self._zero[n] = (tuple(v.shape), size, padded)
+
+        states = {}
+        for n, v in self.trainable.items():
+            zinfo = self._zero.get(n)
+            if zinfo is None:
+                s = self.fopt.init({n: v})[n]
+                states[n] = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, sh(param_specs.get(n, P())))
+                    if x is not None else None, s,
+                    is_leaf=lambda x: x is None)
+                continue
+            shape, size, padded = zinfo
+            flat = jnp.pad(jnp.ravel(v), (0, padded - size)) \
+                if padded != size else jnp.ravel(v)
+            s = self.fopt.init({n: flat})[n]
+            bad = [l.shape for l in jax.tree_util.tree_leaves(s)
+                   if l.shape != (padded,)]
+            if bad:
+                raise MXNetError(
+                    f"{type(self.fopt.opt).__name__} state for '{n}' is not "
+                    f"elementwise (leaf shapes {bad}); zero>0 unsupported")
+            states[n] = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh(P(dp_axis)))
                 if x is not None else None, s, is_leaf=lambda x: x is None)
-            for n, s in states.items()}
-        self.batch_shardings = tuple(sh(s) for s in batch_specs)
+        self.states = states
 
         param_sh = {n: sh(param_specs.get(n, P())) for n in trainable}
         aux_sh = {n: sh(param_specs.get(n, P())) for n in aux}
         state_sh = {
             n: jax.tree_util.tree_map(
-                lambda x: sh(param_specs.get(n, P())), self.states[n],
-                is_leaf=lambda x: x is None)
+                lambda x: sh(P(dp_axis)) if n in self._zero
+                else sh(param_specs.get(n, P())),
+                self.states[n], is_leaf=lambda x: x is None)
             for n in self.states}
         # None states have no sharding
         state_sh = {
@@ -180,49 +275,177 @@ class ShardedTrainStep:
                 self.states[n], state_sh[n], is_leaf=lambda x: x is None)
             for n in self.states}
 
-        def step(trainable, aux, states, rng, lr, *batch):
+        if self._zero:
+            self._build_zero_update()
+            itemsz = {n: jnp.dtype(self.trainable[n].dtype).itemsize
+                      for n in self._zero}
+            self._zero_bytes = sum(
+                info[2] * itemsz[n] for n, info in self._zero.items())
+        else:
+            self._zero_bytes = 0
+
+        def base_step(trainable, aux, states, rng, lr, t, *batch):
             inputs = batch[:len(batch) - self.n_labels]
             labels = batch[len(batch) - self.n_labels:]
-
-            def lossf(tr):
-                out, mutated = functional.functional_call(
-                    self.block, {**tr, **aux}, *inputs, train=True,
-                    rng_key=rng)
-                return self.loss_fn(out, *labels), mutated
-
-            (loss, mutated), grads = jax.value_and_grad(
-                lossf, has_aux=True)(trainable)
-            new_tr, new_states = self.fopt.update(trainable, grads, states,
-                                                  lr=lr)
+            (loss, mutated), grads = self._loss_and_grad(
+                trainable, aux, rng, inputs, labels)
+            new_tr, new_states = self._apply_updates(
+                trainable, grads, states, lr, t)
             return new_tr, {**aux, **mutated}, new_states, loss
 
-        self.steps_per_call = int(steps_per_call)
-        if self.steps_per_call > 1:
+        spec_list = list(batch_specs)
+        step = base_step
+
+        if self.grad_accum > 1:
             from jax import lax
+            K = self.grad_accum
+            zero2 = self._zero if self.zero >= 2 else {}
+
+            def step(trainable, aux, states, rng, lr, t, *batches):
+                # microbatches carry a leading K axis; ONE update at the end.
+                # At zero>=2 the accumulator holds flat dp shards — the
+                # long-lived gradient memory is 1/dp per device and each
+                # microbatch grad reduce-scatters straight into it.
+                def g_init(n, v):
+                    if n in zero2:
+                        return self._dp_constrain(
+                            jnp.zeros((self._zero[n][2],), v.dtype))
+                    return jnp.zeros(v.shape, v.dtype)
+
+                acc0 = {n: g_init(n, v) for n, v in trainable.items()}
+
+                def body(carry, xs):
+                    aux_c, acc, i = carry
+                    inputs = xs[:len(xs) - self.n_labels]
+                    labels = xs[len(xs) - self.n_labels:]
+                    (loss, mutated), grads = self._loss_and_grad(
+                        trainable, aux_c, jax.random.fold_in(rng, i),
+                        inputs, labels)
+
+                    def add(n):
+                        g = grads[n]
+                        if n in zero2:
+                            g = self._dp_constrain(self._flat_pad(n, g))
+                        return acc[n] + g
+
+                    acc = {n: add(n) for n in acc}
+                    return ({**aux_c, **mutated}, acc, i + 1), loss
+
+                (aux, acc, _), losses = lax.scan(
+                    body, (aux, acc0, 0), tuple(batches))
+                grads = {n: a / K for n, a in acc.items()}
+                zflat = {n: grads.pop(n) for n in zero2} or None
+                new_tr, new_states = self._apply_updates(
+                    trainable, grads, states, lr, t, zero_flat_grads=zflat)
+                return new_tr, aux, new_states, jnp.mean(losses)
+
+            spec_list = [P(None, *s) for s in spec_list]
+
+        if self.steps_per_call > 1:
             inner = step
 
-            def step(trainable, aux, states, rng, lr, *batches):
+            def step(trainable, aux, states, rng, lr, t, *batches):
                 # batches carry a leading steps axis; one launch = K steps
-                def body(carry, xs):
-                    tr, ax, st, i = carry
-                    rngi = jax.random.fold_in(rng, i)
-                    tr, ax, st, loss = inner(tr, ax, st, rngi, lr, *xs)
-                    return (tr, ax, st, i + 1), loss
-                (trainable, aux, states, _), losses = lax.scan(
-                    body, (trainable, aux, states, 0), tuple(batches))
-                return trainable, aux, states, jnp.mean(losses)
+                # (implementation shared with the free function scan_steps)
+                def one(tr, ax, st, i, *xs):
+                    tr, ax, st, loss = inner(
+                        tr, ax, st, jax.random.fold_in(rng, i), lr, t + i,
+                        *xs)
+                    return tr, ax, st, i + 1, loss
 
-            self.batch_shardings = tuple(
-                sh(P(None, *s)) for s in batch_specs)
+                out = scan_steps(one, n_state=4)(
+                    trainable, aux, states, 0, *batches)
+                return out[0], out[1], out[2], out[4]
+
+            spec_list = [P(None, *s) for s in spec_list]
+
+        self.batch_shardings = tuple(sh(s) for s in spec_list)
 
         donate_argnums = (0, 1, 2) if donate else ()
         self._step = jax.jit(
             step,
-            in_shardings=(param_sh, aux_sh, state_sh, sh(P()), sh(P()))
-            + self.batch_shardings,
+            in_shardings=(param_sh, aux_sh, state_sh, sh(P()), sh(P()),
+                          sh(P())) + self.batch_shardings,
             out_shardings=(param_sh, aux_sh, state_sh, sh(P())),
             donate_argnums=donate_argnums)
         self._n_step = 0
+
+    # -- step internals -----------------------------------------------------
+    def _loss_and_grad(self, trainable, aux, rng, inputs, labels):
+        def lossf(tr):
+            out, mutated = functional.functional_call(
+                self.block, {**tr, **aux}, *inputs, train=True,
+                rng_key=rng)
+            return self.loss_fn(out, *labels), mutated
+
+        if self._remat_on:
+            lossf = jax.checkpoint(lossf, policy=self._remat_policy)
+        return jax.value_and_grad(lossf, has_aux=True)(trainable)
+
+    def _flat_pad(self, n, v):
+        _, size, padded = self._zero[n]
+        flat = jnp.ravel(v)
+        return jnp.pad(flat, (0, padded - size)) if padded != size else flat
+
+    def _dp_constrain(self, x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(self.dp_axis)))
+
+    def _build_zero_update(self):
+        from .._jax_compat import shard_map
+        dpx = self.dp_axis
+        fopt = self.fopt
+        names = list(self._zero)
+
+        # in_spec P(dp) on the (logically fully-reduced) grads IS the
+        # reduce-scatter: GSPMD fuses the backward psum with the dp
+        # partition into one collective. Params/state arrive as the local
+        # 1/dp chunk, the elementwise update runs on it, and the explicit
+        # all_gather re-assembles the full params (check_vma=False as in
+        # collectives.allgather: the output is replicated but the static
+        # varying-axes check can't infer it).
+        @functools.partial(shard_map, mesh=self.mesh,
+                           in_specs=(P(dpx), P(dpx), P(dpx), P(), P()),
+                           out_specs=(P(), P(dpx)), check_vma=False)
+        def _zupd(w_flat, g_flat, zstates, lr, t):
+            new_w, new_s = fopt.update(w_flat, g_flat, zstates, lr=lr, t=t)
+            gathered = {n: jax.lax.all_gather(new_w[n], dpx, tiled=True)
+                        for n in names}
+            return gathered, new_s
+
+        self._zero_update = _zupd
+
+    def _apply_updates(self, trainable, grads, states, lr, t,
+                       zero_flat_grads=None):
+        """Optimizer update dispatch: ZeRO-partitioned params go through the
+        shard_map path, everything else through the plain fused update."""
+        if not self._zero:
+            return self.fopt.update(trainable, grads, states, lr=lr, t=t)
+        new_tr, new_st = {}, {}
+        rest = {n: v for n, v in trainable.items() if n not in self._zero}
+        if rest:
+            p, s = self.fopt.update(
+                rest, {n: g for n, g in grads.items() if n in rest},
+                {n: states[n] for n in rest}, lr=lr, t=t)
+            new_tr.update(p)
+            new_st.update(s)
+        if zero_flat_grads is None:
+            zero_flat_grads = {n: self._flat_pad(n, grads[n])
+                               for n in self._zero}
+            if self.zero >= 2:
+                # ZeRO-2: pin the flat grads to the dp shards so the full
+                # gradient never materializes replicated
+                zero_flat_grads = {n: self._dp_constrain(g)
+                                   for n, g in zero_flat_grads.items()}
+        w_flat = {n: self._flat_pad(n, trainable[n]) for n in self._zero}
+        zstates = {n: states[n] for n in self._zero}
+        gathered, new_zs = self._zero_update(
+            w_flat, zero_flat_grads, zstates, lr, t)
+        for n, (shape, size, _) in self._zero.items():
+            w = gathered[n][:size].reshape(shape)
+            new_tr[n] = w.astype(trainable[n].dtype)
+            new_st[n] = new_zs[n]
+        return new_tr, new_st
 
     def __call__(self, *batch):
         """Run one step; returns the (replicated) scalar loss as ndarray."""
@@ -235,10 +458,26 @@ class ShardedTrainStep:
         raws = [_pipeline.ensure_sharded(r, s)
                 for r, s in zip(raws, self.batch_shardings)]
         rng = _random._next_key()
-        lr = jnp.asarray(self.fopt.opt.learning_rate, jnp.float32)
+        opt = self.fopt.opt
+        # advance the update count on host (lr schedules / warmup / bias
+        # correction used to be frozen at step 0 in the compiled path); the
+        # schedule evaluates here in python and the results ride into the
+        # jitted step as traced scalars, so no retrace
+        base = opt.num_update
+        opt.num_update = base + self.steps_per_call
+        lr_val = opt.lr_scheduler(base + 1) if opt.lr_scheduler else opt.lr
+        lr = jnp.asarray(lr_val, jnp.float32)
+        t = jnp.asarray(base + 1, jnp.float32)
         self.trainable, self.aux, self.states, loss = self._step(
-            self.trainable, self.aux, self.states, rng, lr, *raws)
+            self.trainable, self.aux, self.states, rng, lr, t, *raws)
         self._n_step += self.steps_per_call
+        if self._zero and _telemetry.active():
+            rs_per_update = self.grad_accum if self.zero >= 2 else 1
+            _telemetry.inc("zero.reduce_scatter_bytes_total",
+                           self._zero_bytes * self.steps_per_call
+                           * rs_per_update)
+            _telemetry.inc("zero.all_gather_bytes_total",
+                           self._zero_bytes * self.steps_per_call)
         return _wrap(loss)
 
     def prefetch(self, batches, depth=None, stall_timeout=None):
@@ -262,24 +501,73 @@ class ShardedTrainStep:
             params[n]._data._rebind(v)
 
     # -- checkpoint / resume ------------------------------------------------
-    def save_states(self, fname):
-        """Checkpoint weights + optimizer state + step count to one
-        safetensors file (reference: Trainer.save_states, trainer.py:482;
-        sharded arrays are gathered to host — the resume side re-shards
-        them).  safetensors rather than npz so bfloat16 params/state
-        round-trip exactly."""
-        import numpy as onp
-        from .. import serialization
+    def state_dict(self):
+        """Gather weights + optimizer state to host numpy in a CANONICAL
+        topology-independent layout: dp-partitioned (zero>0) state leaves
+        are all-gathered, un-padded and reshaped back to their weight's
+        shape — a bundle saved at one dp size (or zero level) restores at
+        any other."""
         arrays = {}
         for n, v in self.trainable.items():
             arrays[f"trainable/{n}"] = onp.asarray(v)
         for n, v in self.aux.items():
             arrays[f"aux/{n}"] = onp.asarray(v)
         for n, s in self.states.items():
+            zinfo = self._zero.get(n)
             for i, leaf in enumerate(jax.tree_util.tree_leaves(s)):
-                arrays[f"state/{n}/{i}"] = onp.asarray(leaf)
+                a = onp.asarray(leaf)
+                if zinfo is not None:
+                    shape, size, _ = zinfo
+                    a = a[:size].reshape(shape)
+                arrays[f"state/{n}/{i}"] = a
+        return {"arrays": arrays, "n_step": int(self._n_step)}
+
+    def load_state_dict(self, bundle):
+        """Restore from ``state_dict()``: values re-shard per THIS step's
+        param_specs / zero layout (which may differ from the saving run's —
+        resume on a different dp size re-pads and re-partitions here)."""
+        arrays = bundle["arrays"]
+
+        def sh(n):
+            return NamedSharding(self.mesh, self.param_specs.get(n, P()))
+
+        for n in self.trainable:
+            self.trainable[n] = jax.device_put(
+                arrays[f"trainable/{n}"], sh(n))
+        for n in self.aux:
+            self.aux[n] = jax.device_put(arrays[f"aux/{n}"], sh(n))
+        for n, s in self.states.items():
+            leaves, treedef = jax.tree_util.tree_flatten(s)
+            zinfo = self._zero.get(n)
+            new = []
+            for i in range(len(leaves)):
+                a = arrays[f"state/{n}/{i}"]
+                if zinfo is not None:
+                    _, size, padded = zinfo
+                    flat = onp.ravel(a)
+                    if padded != size:
+                        flat = onp.pad(flat, (0, padded - size))
+                    new.append(jax.device_put(
+                        flat, NamedSharding(self.mesh, P(self.dp_axis))))
+                else:
+                    new.append(jax.device_put(a, sh(n)))
+            self.states[n] = jax.tree_util.tree_unflatten(treedef, new)
+        self._n_step = int(bundle["n_step"])
+        # keep lr schedules / bias correction on the restored timeline
+        self.fopt.opt.num_update = self._n_step
+
+    def save_states(self, fname):
+        """Checkpoint weights + optimizer state + step count to one
+        safetensors file (reference: Trainer.save_states, trainer.py:482;
+        sharded arrays are gathered to host in canonical layout — the
+        resume side re-shards them, even at a different dp size).
+        safetensors rather than npz so bfloat16 params/state round-trip
+        exactly."""
+        from .. import serialization
+        bundle = self.state_dict()
         return serialization.save_safetensors(
-            fname, arrays, metadata={"n_step": self._n_step})
+            fname, bundle["arrays"],
+            metadata={"n_step": bundle["n_step"], "zero": self.zero})
 
     def load_states(self, fname):
         """Resume from save_states: values re-sharded per param_specs
@@ -287,18 +575,5 @@ class ShardedTrainStep:
         from .. import serialization
         loaded, meta = serialization.load_safetensors(
             fname, return_metadata=True)
-        self._n_step = int(meta.get("n_step", 0))
-
-        def sh(n):
-            return NamedSharding(self.mesh, self.param_specs.get(n, P()))
-
-        for n in self.trainable:
-            self.trainable[n] = jax.device_put(
-                loaded[f"trainable/{n}"], sh(n))
-        for n in self.aux:
-            self.aux[n] = jax.device_put(loaded[f"aux/{n}"], sh(n))
-        for n, s in self.states.items():
-            leaves, treedef = jax.tree_util.tree_flatten(s)
-            new = [jax.device_put(loaded[f"state/{n}/{i}"], sh(n))
-                   for i in range(len(leaves))]
-            self.states[n] = jax.tree_util.tree_unflatten(treedef, new)
+        self.load_state_dict(
+            {"arrays": loaded, "n_step": int(meta.get("n_step", 0))})

@@ -165,8 +165,12 @@ class TrainState:
     needs: parameters, optimizer/updater states, loss-scaler, sampler
     cursor, RNG streams, step/epoch counters.
 
-    The object holds live references (``net``/``trainer``/``loader`` are
-    all optional — bundle whatever the run has) and moves state in place:
+    The object holds live references (``net``/``trainer``/``loader``/
+    ``sharded_step`` are all optional — bundle whatever the run has) and
+    moves state in place.  A :class:`~mxnet_tpu.parallel.ShardedTrainStep`
+    passed as ``sharded_step`` contributes its canonical (gathered,
+    topology-independent) state, so dp-sharded and ZeRO-partitioned runs
+    resume bitwise even at a different dp size::
 
         state = mx.resilience.TrainState(net=net, trainer=trainer,
                                          loader=loader, path="run.bundle")
@@ -182,10 +186,12 @@ class TrainState:
     preemption it was written under is rejected loudly, never half-loaded.
     """
 
-    def __init__(self, net=None, trainer=None, loader=None, path=None):
+    def __init__(self, net=None, trainer=None, loader=None, path=None,
+                 sharded_step=None):
         self.net = net
         self.trainer = trainer
         self.loader = loader
+        self.sharded_step = sharded_step
         self.path = path
         self.step = 0
         self.epoch = 0
@@ -204,6 +210,12 @@ class TrainState:
             bundle["trainer"] = self.trainer.state_dict()
         if self.loader is not None:
             bundle["loader"] = self.loader.state_dict()
+        if self.sharded_step is not None:
+            # ShardedTrainStep.state_dict() is already canonical (dp-sharded
+            # / ZeRO-partitioned leaves gathered, unpadded and reshaped to
+            # weight form), so the bundle stays topology-independent: it can
+            # be restored into a step with a different dp size or zero level.
+            bundle["sharded_step"] = self.sharded_step.state_dict()
         return bundle
 
     def save(self, path=None):
@@ -257,6 +269,9 @@ class TrainState:
             self.trainer.load_state_dict(bundle["trainer"])
         if bundle.get("loader") is not None and self.loader is not None:
             self.loader.load_state_dict(bundle["loader"])
+        if (bundle.get("sharded_step") is not None
+                and self.sharded_step is not None):
+            self.sharded_step.load_state_dict(bundle["sharded_step"])
         if bundle.get("rng") is not None:
             _random.set_state(bundle["rng"])
         self.step = int(bundle.get("step", 0))

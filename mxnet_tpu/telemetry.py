@@ -166,6 +166,12 @@ declare_metric("train.iter_seconds", "histogram",
                buckets=TIME_BUCKETS)
 declare_metric("telemetry.records_total", "counter",
                "JSONL records emitted by TrainingTelemetry")
+declare_metric("memory.bytes_in_use", "gauge",
+               "per-device live HBM bytes (PJRT memory_stats), by device")
+declare_metric("memory.peak_bytes_in_use", "gauge",
+               "per-device peak HBM bytes since start, by device")
+declare_metric("memory.bytes_limit", "gauge",
+               "per-device HBM capacity reported by the runtime, by device")
 
 
 # -- switches ---------------------------------------------------------------
@@ -270,6 +276,49 @@ def timed(name, **labels):
         yield
     finally:
         observe(name, time.perf_counter() - t0, **labels)
+
+
+def record_memory(devices=None):
+    """Refresh the ``memory.*`` gauges from PJRT ``device.memory_stats()``
+    and return ``{device_id: {live, peak, limit}}`` (bytes; keys present
+    only when the backend reports them).
+
+    Called at the step loop's drain points (``Trainer.drain_telemetry``,
+    ``TrainingTelemetry`` run reports) so live/peak HBM is observable
+    without per-step host syncs.  Backends without memory stats (CPU)
+    yield an empty dict — a cheap no-op, so callers don't need to gate on
+    platform.  No-op while the registry is disabled.
+    """
+    if not _active:
+        return {}
+    if devices is None:
+        import jax
+        devices = jax.local_devices()
+    out = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        dev = str(getattr(d, "id", d))
+        entry = {}
+        live = stats.get("bytes_in_use")
+        if live is not None:
+            set_gauge("memory.bytes_in_use", int(live), device=dev)
+            entry["live"] = int(live)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            set_gauge("memory.peak_bytes_in_use", int(peak), device=dev)
+            entry["peak"] = int(peak)
+        limit = stats.get("bytes_limit")
+        if limit is not None:
+            set_gauge("memory.bytes_limit", int(limit), device=dev)
+            entry["limit"] = int(limit)
+        if entry:
+            out[dev] = entry
+    return out
 
 
 def reset():
@@ -511,6 +560,7 @@ class TrainingTelemetry:
         return {"type": "run_report", "run_id": self.run_id,
                 "steps": self._steps,
                 "wall_seconds": time.time() - self._t0,
+                "memory": record_memory(),
                 "metrics": snapshot()}
 
     def close(self):
