@@ -447,6 +447,61 @@ def bench_gpt_train(precision: str, on_cpu: bool, peak, bs=8, seq=1024,
     return row
 
 
+def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
+                           max_new=48):
+    """Online decode through mx.serve continuous batching (gpt2-124m
+    class on hardware, the CI tiny config on CPU): tokens/s plus the SLO
+    latencies (TTFT/TPOT p50/p99) the serving row is judged by.
+    precision='int8' routes weights through the int8 decode path
+    (serve/quantize.py) — the bandwidth-bound regime where weight bytes
+    are the roofline."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+
+    if on_cpu:
+        vocab, units, layers, heads, maxlen = 512, 64, 2, 4, 128
+        requests, max_new, slots = 12, 24, 4
+    else:  # GPT-2 small decode
+        vocab, units, layers, heads, maxlen = 50257, 768, 12, 12, 512
+    net = GPTForCausalLM(vocab_size=vocab, units=units,
+                         hidden_size=units * 4, num_layers=layers,
+                         num_heads=heads, max_length=maxlen,
+                         dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((1, 2), dtype="int32"))
+    eng = mx.serve.load(
+        net, max_slots=slots,
+        quantize="int8_weights" if precision == "int8" else None,
+        warmup=True)  # compile outside the timed window
+
+    rng = onp.random.RandomState(0)
+    t0 = time.perf_counter()
+    for _ in range(requests):
+        length = int(rng.randint(2, min(24, maxlen // 4) + 1))
+        eng.submit(rng.randint(1, vocab, size=length).tolist(),
+                   max_new_tokens=max_new)
+    eng.run()
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    row = {"name": f"gpt2_decode_serve_slots{slots}_{precision}",
+           "items_per_s": st["tokens_out"] / wall,
+           "unit": "tokens/s",
+           "ms_per_step": wall / max(1, st["steps"]) * 1e3,
+           "precision": precision,
+           "requests": requests,
+           "ttft_p50_ms": (st["ttft"]["p50"] or 0) * 1e3,
+           "ttft_p99_ms": (st["ttft"]["p99"] or 0) * 1e3,
+           "tpot_p50_ms": (st["tpot"]["p50"] or 0) * 1e3,
+           "tpot_p99_ms": (st["tpot"]["p99"] or 0) * 1e3,
+           "post_warmup_compiles": st["post_warmup_compiles"]}
+    if precision == "int8":
+        row["weight_bytes_ratio"] = round(
+            st["weight_bytes"] / st["weight_bytes_fp"], 3)
+    return row
+
+
 def bench_augmentation(precision, on_cpu, peak, bs=256, k_steps=8):
     """Batched image-augmentation throughput (mx.image.apply_batch):
     the ImageIter/DataLoader device-side augment pass."""
@@ -585,6 +640,8 @@ def main():
         (bench_bert_train, dict(precision="bf16", bs=64)),
         (bench_gpt_train, dict(precision="bf16", bs=8, seq=1024)),
         (bench_gpt_train, dict(precision="bf16", bs=4, seq=2048)),
+        (bench_gpt_decode_serve, dict(precision="fp32")),
+        (bench_gpt_decode_serve, dict(precision="int8")),
         (bench_augmentation, dict(precision="fp32")),
         (bench_dataloader_workers, dict(precision="fp32")),
     ]:

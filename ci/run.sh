@@ -25,6 +25,10 @@
 #                memory benchmark: zero=1 on a 4-way dp mesh must cut
 #                per-device state bytes >=40% while staying numerically
 #                invisible (docs/PERFORMANCE.md)
+#   serve      - continuous-batching inference suite + the throughput
+#                benchmark: >=2x tokens/s vs sequential decode under
+#                Poisson arrivals with ZERO post-warmup recompiles
+#                (docs/SERVING.md)
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -33,7 +37,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -192,6 +196,13 @@ zero() {
     JAX_PLATFORMS=cpu python benchmark/zero_memory.py
 }
 
+serve() {
+    echo "== serve: continuous-batching inference suite (docs/SERVING.md) =="
+    python -m pytest tests/test_serve.py -q
+    echo "== serve: throughput benchmark (>=2x vs sequential, 0 post-warmup recompiles) =="
+    JAX_PLATFORMS=cpu python benchmark/serve_throughput.py --assert
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -221,8 +232,9 @@ case "$stage" in
     resilience) resilience ;;
     pipeline) pipeline ;;
     zero) zero ;;
+    serve) serve ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -65,6 +65,7 @@ from . import image
 from . import contrib
 from . import serialization
 from . import resilience
+from . import serve
 from . import storage
 from . import callback
 from . import model

@@ -230,6 +230,22 @@ declare("resilience.max_restarts", int, 3, "MXNET_RESILIENCE_MAX_RESTARTS",
         "In-process training restarts mx.resilience.run() performs after "
         "a WorkerLost escalation (each restart restores the last "
         "TrainState bundle) before re-raising to the caller.")
+declare("serve.max_slots", int, 8, "MXNET_SERVE_MAX_SLOTS",
+        "Decode slots in the mx.serve continuous-batching engine: the "
+        "fixed batch dimension of the one resident compiled decode step "
+        "and of every preallocated KV-cache array.")
+declare("serve.buckets", str, "16,32,64,128,256,512",
+        "MXNET_SERVE_BUCKETS",
+        "Prompt-length buckets for prefill (comma-separated, ascending). "
+        "Each bucket is one compiled prefill graph; prompts pad up to the "
+        "smallest fitting bucket so a mixed request stream never compiles "
+        "after warmup (the telemetry.recompile_limit detector is the "
+        "guard rail). Buckets beyond the cache's max_seq are dropped.")
+declare("serve.drain_window", int, 4, "MXNET_SERVE_DRAIN_WINDOW",
+        "Bounded deferred-drain window of the serve loop: device-resident "
+        "(token, done) vectors pending host fetch. Completions are "
+        "observed at most this many steps late; larger windows keep the "
+        "step loop fully sync-free, smaller ones free slots sooner.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

@@ -101,11 +101,32 @@ class Packer:
         return out
 
 
-def functional_call(block, params, *args, train=False, rng_key=None):
-    """Run ``block.forward`` as a pure function.
+def _wrap_arg_tree(args):
+    """Wrap every array leaf of ``args`` (which may contain nested pytrees
+    such as KV-cache lists) into mx ndarrays; non-array leaves (python
+    ints, None) pass through untouched."""
+    import numpy as onp
+
+    def wrap_leaf(a):
+        if isinstance(a, ndarray):
+            return a
+        if isinstance(a, (jax.Array, onp.ndarray)) or hasattr(a, "aval"):
+            return _wrap(a)
+        return a
+
+    return jax.tree_util.tree_map(
+        wrap_leaf, args, is_leaf=lambda x: isinstance(x, ndarray))
+
+
+def functional_call(block, params, *args, train=False, rng_key=None,
+                    method="forward"):
+    """Run a block method (default ``forward``) as a pure function.
 
     params: dict structural-name -> raw jax.Array (or mx ndarray).
-    args: inputs (raw arrays or mx ndarrays).
+    args: inputs (raw arrays, mx ndarrays, or pytrees of them — the serve
+    engine passes nested KV-cache lists).
+    method: name of the method to call — ``"forward"``, or a serving
+    surface such as ``"prefill"``/``"decode_step"``.
     Returns ``(outputs, mutated)`` where outputs is the forward result with
     raw jax.Arrays as leaves and mutated is a dict of aux-state values the
     forward updated (BatchNorm running stats) — the caller threads them to
@@ -118,6 +139,7 @@ def functional_call(block, params, *args, train=False, rng_key=None):
     saved = {}
     if rng_key is None:
         rng_key = _random._next_key()
+    fn = block.forward if method == "forward" else getattr(block, method)
     try:
         for n, v in params.items():
             p = block_params[n]
@@ -126,11 +148,10 @@ def functional_call(block, params, *args, train=False, rng_key=None):
             saved[n] = p._data._data
             p._data._data = _raw(v)
         markers = {n: block_params[n]._data._data for n in params}
-        nd_args = tuple(a if isinstance(a, ndarray) else _wrap(a)
-                        for a in args)
+        nd_args = _wrap_arg_tree(args)
         with autograd._RecordingStateScope(False, train), \
                 _random.trace_key_scope(rng_key):
-            out = block.forward(*nd_args)
+            out = fn(*nd_args)
         out = jax.tree_util.tree_map(
             _raw, out, is_leaf=lambda x: isinstance(x, ndarray))
         mutated = {n: block_params[n]._data._data for n in params

@@ -30,6 +30,7 @@ class GPTModel(HybridBlock):
                  dropout=0.1, embed_dropout=0.1):
         super().__init__()
         self._units = units
+        self._max_length = max_length
         self.word_embed = Embedding(vocab_size, units)
         self.position_embed = Embedding(max_length, units)
         self.embed_dropout = Dropout(embed_dropout) if embed_dropout else None
@@ -47,6 +48,40 @@ class GPTModel(HybridBlock):
             x = self.embed_dropout(x)
         return self.final_ln(self.decoder(x))
 
+    # -- KV-cache serving surface (mx.serve) ---------------------------
+
+    @property
+    def max_length(self):
+        return self._max_length
+
+    def init_cache(self, max_slots, max_seq=None, dtype="float32"):
+        """Fixed-footprint decode cache: per layer one
+        (max_slots, max_seq, heads, head_dim) K and V pair."""
+        max_seq = self._max_length if max_seq is None else max_seq
+        if max_seq > self._max_length:
+            raise ValueError(
+                f"max_seq {max_seq} exceeds the learned position table "
+                f"({self._max_length})")
+        return self.decoder.init_cache(max_slots, max_seq, dtype)
+
+    def prefill(self, inputs, caches, slot):
+        """Run one prompt (1, L) through the stack, writing K/V into
+        cache slot ``slot``. Returns (hidden (1, L, units), caches)."""
+        b, s = inputs.shape
+        pos = np.arange(s, dtype="int32").reshape(1, s)
+        x = self.word_embed(inputs) + self.position_embed(pos)
+        x, caches = self.decoder.prefill(x, caches, slot)
+        return self.final_ln(x), caches
+
+    def decode_step(self, tokens, caches, positions):
+        """Advance every slot one token: tokens (slots, 1) int32,
+        positions (slots,) int32 cache rows. Returns
+        (hidden (slots, 1, units), caches)."""
+        x = self.word_embed(tokens) \
+            + self.position_embed(positions.reshape(-1, 1))
+        x, caches = self.decoder.decode_step(x, caches, positions)
+        return self.final_ln(x), caches
+
 
 class GPTForCausalLM(HybridBlock):
     """Next-token LM head over GPTModel, weight-tied to the embedding.
@@ -63,6 +98,25 @@ class GPTForCausalLM(HybridBlock):
         h = self.backbone(inputs)
         w = self.backbone.word_embed.weight.data()
         return np.dot(h, w.T)
+
+    # -- KV-cache serving surface (mx.serve) ---------------------------
+
+    @property
+    def max_length(self):
+        return self.backbone.max_length
+
+    def init_cache(self, max_slots, max_seq=None, dtype="float32"):
+        return self.backbone.init_cache(max_slots, max_seq, dtype)
+
+    def prefill(self, inputs, caches, slot):
+        h, caches = self.backbone.prefill(inputs, caches, slot)
+        w = self.backbone.word_embed.weight.data()
+        return np.dot(h, w.T), caches
+
+    def decode_step(self, tokens, caches, positions):
+        h, caches = self.backbone.decode_step(tokens, caches, positions)
+        w = self.backbone.word_embed.weight.data()
+        return np.dot(h[:, 0], w.T), caches
 
 
 def gpt2_124m(vocab_size=50257, **kwargs):

@@ -52,6 +52,42 @@ class MultiHeadAttention(HybridBlock):
             dropout_p=self._dropout, causal=self._causal)
         return self.out_proj(out)
 
+    # -- KV-cache serving surface (mx.serve) ---------------------------
+    # Self-attention only: prefill writes a whole prompt into one cache
+    # slot, decode_step advances every live slot by one token. Both are
+    # pure in (x, cache) -> (y, cache) so hybridize()/jit can trace them
+    # as cached graphs with the cache donated across steps.
+
+    def init_cache(self, max_slots, max_seq, dtype="float32"):
+        """Preallocate one (k, v) cache pair:
+        (max_slots, max_seq, heads, head_dim) each."""
+        d = self._units // self._heads
+        shape = (max_slots, max_seq, self._heads, d)
+        return (np.zeros(shape, dtype=dtype), np.zeros(shape, dtype=dtype))
+
+    def prefill(self, x, kv, slot):
+        """Full causal self-attention over one prompt (1, L, units),
+        recording projected K/V into cache slot ``slot``."""
+        from ...ops.attention import multi_head_attention, write_prefill_kv
+        q = self.query_proj(x)
+        k = self.key_proj(x)
+        v = self.value_proj(x)
+        k_cache, v_cache = write_prefill_kv(kv[0], kv[1], k, v, slot,
+                                            self._heads)
+        out = multi_head_attention(q, k, v, self._heads, causal=True)
+        return self.out_proj(out), (k_cache, v_cache)
+
+    def decode_step(self, x, kv, positions):
+        """One cached decode step: x is (slots, 1, units), ``positions``
+        (slots,) the cache row each slot's token occupies."""
+        from ...ops.attention import decode_attention
+        q = self.query_proj(x)
+        k = self.key_proj(x)
+        v = self.value_proj(x)
+        out, k_cache, v_cache = decode_attention(
+            q, k, v, kv[0], kv[1], positions, self._heads)
+        return self.out_proj(out), (k_cache, v_cache)
+
 
 class PositionwiseFFN(HybridBlock):
     """Transformer FFN block (dense → act → dense), gluon-nlp layout."""
@@ -174,6 +210,32 @@ class TransformerEncoderCell(HybridBlock):
             return constrain(fused, "residual")
         return constrain(self.ffn_ln(x + h), "residual")
 
+    # -- KV-cache serving surface (mx.serve) ---------------------------
+    # Inference-only: dropout is skipped (serving never trains) and the
+    # residual stream follows the same pre/post-norm layout as forward().
+
+    def init_cache(self, max_slots, max_seq, dtype="float32"):
+        return self.attention.init_cache(max_slots, max_seq, dtype)
+
+    def prefill(self, x, kv, slot):
+        if self._pre_norm:
+            h, kv = self.attention.prefill(self.attn_ln(x), kv, slot)
+            x = x + h
+            return x + self.ffn(self.ffn_ln(x)), kv
+        h, kv = self.attention.prefill(x, kv, slot)
+        x = self.attn_ln(x + h)
+        return self.ffn_ln(x + self.ffn(x)), kv
+
+    def decode_step(self, x, kv, positions):
+        if self._pre_norm:
+            h, kv = self.attention.decode_step(self.attn_ln(x), kv,
+                                               positions)
+            x = x + h
+            return x + self.ffn(self.ffn_ln(x)), kv
+        h, kv = self.attention.decode_step(x, kv, positions)
+        x = self.attn_ln(x + h)
+        return self.ffn_ln(x + self.ffn(x)), kv
+
 
 class TransformerDecoderCell(HybridBlock):
     """One decoder layer: causal self-attn, cross-attn, FFN (post-norm)."""
@@ -218,6 +280,28 @@ class TransformerEncoder(HybridBlock):
         for cell in self._layers:
             x = cell(x, mask=mask)
         return x
+
+    # -- KV-cache serving surface (mx.serve) ---------------------------
+
+    def init_cache(self, max_slots, max_seq, dtype="float32"):
+        """One (k, v) pair per layer — the whole decode footprint,
+        allocated once and donated across steps by the serve engine."""
+        return [cell.init_cache(max_slots, max_seq, dtype)
+                for cell in self._layers]
+
+    def prefill(self, x, caches, slot):
+        out = []
+        for cell, kv in zip(self._layers, caches):
+            x, kv = cell.prefill(x, kv, slot)
+            out.append(kv)
+        return x, out
+
+    def decode_step(self, x, caches, positions):
+        out = []
+        for cell, kv in zip(self._layers, caches):
+            x, kv = cell.decode_step(x, kv, positions)
+            out.append(kv)
+        return x, out
 
 
 def valid_length_mask(valid_length, seq_len):

@@ -71,6 +71,63 @@ def _sp_mesh():
     return None
 
 
+def write_prefill_kv(k_cache, v_cache, key, value, slot, heads):
+    """Write a whole prompt's projected K/V into one cache slot.
+
+    ``key``/``value`` are (1, L, heads*dim) projections; the caches are
+    (max_slots, max_seq, heads, dim). Rows [slot, :L] are overwritten (rows
+    beyond L keep stale values — they are never attended because the decode
+    mask is bounded by the slot's position counter and every row below it
+    is rewritten in order before it becomes visible). ``slot`` may be a
+    traced scalar, so one compiled prefill serves every slot.
+    """
+    def fn(kc, vc, k, v, s):
+        _, seq_len, hd = k.shape
+        d = hd // heads
+        kh = k.reshape(1, seq_len, heads, d).astype(kc.dtype)
+        vh = v.reshape(1, seq_len, heads, d).astype(vc.dtype)
+        start = (s.astype(jnp.int32) if hasattr(s, "astype") else
+                 jnp.int32(s), 0, 0, 0)
+        return (jax.lax.dynamic_update_slice(kc, kh, start),
+                jax.lax.dynamic_update_slice(vc, vh, start))
+
+    return _invoke(fn, (k_cache, v_cache, key, value, slot),
+                   name="write_prefill_kv")
+
+
+def decode_attention(query, key, value, k_cache, v_cache, positions, heads):
+    """Single-token cached attention for continuous-batching decode.
+
+    ``query``/``key``/``value`` are (slots, 1, heads*dim) projections of the
+    current token in every slot; caches are (slots, max_seq, heads, dim);
+    ``positions`` (slots,) is the row each slot's new K/V lands in. Writes
+    the new K/V, attends rows <= positions (static shapes — the mask, not
+    the extent, varies), and returns (out, k_cache, v_cache). Score
+    materialization is (slots, heads, max_seq) — tiny, so no flash path.
+    """
+    def fn(q, k, v, kc, vc, pos):
+        n, _, hd = q.shape
+        d = hd // heads
+        max_seq = kc.shape[1]
+        row = jnp.clip(pos.astype(jnp.int32), 0, max_seq - 1)
+        lane = jnp.arange(n)
+        kc = kc.at[lane, row].set(k.reshape(n, heads, d).astype(kc.dtype))
+        vc = vc.at[lane, row].set(v.reshape(n, heads, d).astype(vc.dtype))
+        qh = q.reshape(n, heads, d)
+        scale = 1.0 / (d ** 0.5)
+        scores = jnp.einsum("nhd,nshd->nhs", qh,
+                            kc.astype(q.dtype)) * scale
+        visible = (jnp.arange(max_seq)[None, :] <= row[:, None])[:, None, :]
+        scores = jnp.where(visible, scores, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        out = jnp.einsum("nhs,nshd->nhd", att, vc.astype(q.dtype))
+        return out.reshape(n, 1, hd), kc, vc
+
+    return _invoke(fn, (query, key, value, k_cache, v_cache, positions),
+                   name="decode_attention")
+
+
 def multi_head_attention(query, key, value, heads, mask=None, dropout_p=0.0,
                          causal=False):
     """Fused MHA on (batch, seq, heads*dim) ndarrays.

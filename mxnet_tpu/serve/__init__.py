@@ -1,0 +1,19 @@
+"""mx.serve — continuous-batching online inference (docs/SERVING.md).
+
+One resident compiled decode step over a fixed-footprint slot-based KV
+cache; requests are admitted/evicted per step, prompts bucket-pad so the
+recompile detector stays quiet after warmup, and sampled tokens drain to
+the host asynchronously through a bounded deferred window.
+
+    import mxnet_tpu as mx
+    eng = mx.serve.load(model, max_slots=8, eos_id=50256,
+                        quantize="int8_weights").warmup()
+    req = eng.submit(prompt_ids, max_new_tokens=64)
+    eng.run()
+    req.output_ids, req.ttft, eng.stats()
+"""
+from .engine import Request, ServeEngine, load
+from .quantize import dequantize_params, quantize_params_int8
+
+__all__ = ["Request", "ServeEngine", "load", "quantize_params_int8",
+           "dequantize_params"]

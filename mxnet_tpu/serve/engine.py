@@ -1,0 +1,580 @@
+"""Continuous-batching serve engine: ONE resident compiled decode step.
+
+Design (PAPERS.md "Portable O(1) Autoregressive Caching for Inference"
+is the blueprint; "A Learned Performance Model for TPUs" motivates the
+static-shape discipline):
+
+- **Fixed footprint.** The KV cache — per layer one
+  (max_slots, max_seq, heads, head_dim) K and V array — is allocated
+  once at construction and *donated* through every compiled call, so the
+  decode working set never grows, shrinks, or reallocates no matter how
+  requests arrive. Every device shape in the engine is static.
+- **One decode executable.** All live requests advance together through
+  a single AOT-compiled step (batch dim = max_slots); idle slots ride
+  along masked. Prefill gets one executable per prompt-length *bucket*
+  (``serve.buckets``), prompts pad up to the smallest fitting bucket,
+  and ``warmup()`` compiles the whole grid up front — after that the
+  PR 2 recompile detector (``telemetry.note_compile``) must stay silent,
+  and the engine counts any post-warmup compile as a bug signal.
+- **Continuous batching.** A slot is freed the moment its request
+  finishes (EOS or token budget) and the next queued request is admitted
+  into it mid-flight — no waiting for the batch to drain, the property
+  that buys the ≥2x over sequential decode in
+  benchmark/serve_throughput.py.
+- **Sync-free step loop.** The mx.pipeline deferred-window pattern:
+  each step's sampled (token, done) vectors stay on device and are
+  pushed into a bounded :class:`_EmitWindow`; the host fetches them at
+  most ``serve.drain_window`` steps later (or when it needs a slot).
+  Dispatching a step never blocks on device results, so the device
+  pipeline stays full. The price: completions are observed up to
+  ``drain_window`` steps late — bounded staleness, never lost tokens.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import config as _config
+from .. import functional as _functional
+from .. import pipeline as _pipeline
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from . import quantize as _quantize
+
+__all__ = ["Request", "ServeEngine", "load"]
+
+_telemetry.declare_metric(
+    "serve.requests_total", "counter",
+    "requests submitted to serve engines")
+_telemetry.declare_metric(
+    "serve.admitted_total", "counter",
+    "requests admitted into a decode slot (prefill dispatched)")
+_telemetry.declare_metric(
+    "serve.completed_total", "counter",
+    "requests finished (EOS or token budget)")
+_telemetry.declare_metric(
+    "serve.tokens_total", "counter",
+    "generated tokens delivered to requests")
+_telemetry.declare_metric(
+    "serve.prefill_tokens_total", "counter",
+    "prompt tokens processed by prefill (bucket-padded length)")
+_telemetry.declare_metric(
+    "serve.steps_total", "counter",
+    "continuous-batching decode steps dispatched")
+_telemetry.declare_metric(
+    "serve.step_seconds", "histogram",
+    "host wall time to dispatch one decode step (sync-free: excludes "
+    "device completion)", buckets=_telemetry.TIME_BUCKETS)
+_telemetry.declare_metric(
+    "serve.ttft_seconds", "histogram",
+    "time to first token: submit -> first token drained to the host",
+    buckets=_telemetry.TIME_BUCKETS)
+_telemetry.declare_metric(
+    "serve.tpot_seconds", "histogram",
+    "time per output token after the first (decode cadence per request)",
+    buckets=_telemetry.TIME_BUCKETS)
+_telemetry.declare_metric(
+    "serve.queue_depth", "gauge",
+    "requests waiting for a free slot")
+_telemetry.declare_metric(
+    "serve.slot_occupancy", "gauge",
+    "slots holding a live request")
+_telemetry.declare_metric(
+    "serve.post_warmup_compiles_total", "counter",
+    "XLA compiles after warmup() — should stay 0; any hit means a "
+    "request shape escaped the bucket grid")
+
+
+class Request:
+    """One generation request and its latency record.
+
+    ``generated`` holds every sampled token id (EOS included when hit);
+    ``output_ids`` strips a trailing EOS. TTFT/TPOT are measured at
+    *drain* time — when the token was actually available to the caller,
+    not when the device produced it — so the deferred window's bounded
+    staleness is charged to the engine, keeping the SLO numbers honest.
+    """
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "generated",
+                 "slot", "finished", "t_submit", "t_admitted", "t_first",
+                 "t_done")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_id=None):
+        self.id = rid
+        self.prompt = list(prompt)
+        self.max_new_tokens = max(1, int(max_new_tokens))
+        self.eos_id = eos_id
+        self.generated = []
+        self.slot = None
+        self.finished = False
+        self.t_submit = time.perf_counter()
+        self.t_admitted = None
+        self.t_first = None
+        self.t_done = None
+
+    @property
+    def output_ids(self):
+        out = list(self.generated)
+        if out and self.eos_id is not None and out[-1] == self.eos_id:
+            out.pop()
+        return out
+
+    @property
+    def ttft(self):
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def tpot(self):
+        if self.t_done is None or self.t_first is None:
+            return None
+        return (self.t_done - self.t_first) / max(1, len(self.generated) - 1)
+
+    def __repr__(self):
+        state = "done" if self.finished else (
+            "slot%d" % self.slot if self.slot is not None else "queued")
+        return (f"Request(id={self.id}, prompt={len(self.prompt)} tok, "
+                f"out={len(self.generated)} tok, {state})")
+
+
+class _EmitWindow(_pipeline.DeferredWindow):
+    """DeferredWindow whose entries are device *vectors* (per-slot token
+    ids + done flags), not scalars: the drain fetches with device_get and
+    hands host numpy arrays to the sink. Overflow keeps the base-class
+    behavior — oldest entry drained in place, counted as a host sync and
+    a ``pipeline.deferred_evictions_total`` tick."""
+
+    def _drain_one(self):
+        value, sink = self._pending.pop(0)
+        sink(jax.device_get(value))
+
+    def drain_oldest(self, n=1):
+        for _ in range(min(n, len(self._pending))):
+            if _pipeline._guard_depth:
+                _pipeline.note_host_sync("serve.drain")
+            self._drain_one()
+
+
+def _parse_buckets(spec):
+    try:
+        vals = sorted({int(v) for v in str(spec).split(",") if v.strip()})
+    except ValueError as e:
+        raise MXNetError(f"bad serve.buckets spec {spec!r}") from e
+    if not vals or any(v <= 0 for v in vals):
+        raise MXNetError(f"bad serve.buckets spec {spec!r}")
+    return vals
+
+
+def _sds(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+class ServeEngine:
+    """Online inference over a block exposing the KV-cache surface
+    (``init_cache`` / ``prefill`` / ``decode_step`` — gluon's GPT family
+    and any HybridBlock following the same contract).
+
+    Usage::
+
+        eng = mx.serve.load(model, max_slots=8, eos_id=50256)
+        eng.warmup()                      # compile the whole grid
+        reqs = [eng.submit(ids, max_new_tokens=64) for ids in prompts]
+        eng.run()                         # continuous batching
+        reqs[0].output_ids, reqs[0].ttft, eng.stats()
+
+    ``temperature=0`` is greedy; >0 samples from softmax(logits/T).
+    ``quantize="int8_weights"`` stores large 2-D weights as int8 +
+    per-channel scales (serve/quantize.py) — dequant is fused into the
+    consuming matmuls, HBM reads stay int8.
+    """
+
+    def __init__(self, model, max_slots=None, max_seq=None, buckets=None,
+                 eos_id=None, temperature=0.0, seed=0, quantize=None,
+                 drain_window=None, cache_dtype="float32"):
+        for attr in ("init_cache", "prefill", "decode_step"):
+            if not callable(getattr(model, attr, None)):
+                raise MXNetError(
+                    f"model {type(model).__name__} has no {attr}(); the "
+                    "serve engine needs the KV-cache block surface "
+                    "(gluon.model_zoo.gpt, docs/SERVING.md)")
+        self.model = model
+        self.max_slots = int(max_slots if max_slots is not None
+                             else _config.get("serve.max_slots"))
+        if self.max_slots <= 0:
+            raise MXNetError("max_slots must be positive")
+        if max_seq is None:
+            max_seq = getattr(model, "max_length", None)
+            if max_seq is None:
+                raise MXNetError("max_seq not given and model has no "
+                                 "max_length")
+        self.max_seq = int(max_seq)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self._ensure_initialized()
+        params = _functional.param_arrays(model)
+        if quantize not in (None, "", "int8_weights"):
+            raise MXNetError(f"unknown quantize mode {quantize!r}")
+        self.quantize = quantize or None
+        if self.quantize:
+            pt, qt, qdt = _quantize.quantize_params_int8(params)
+        else:
+            pt, qt, qdt = params, {}, {}
+        self._params = (pt, qt)
+        self._qdtypes = qdt
+        buckets = _parse_buckets(buckets if buckets is not None
+                                 else _config.get("serve.buckets"))
+        self.buckets = [b for b in buckets if b <= self.max_seq] \
+            or [self.max_seq]
+        cache = model.init_cache(self.max_slots, self.max_seq,
+                                 dtype=cache_dtype)
+        self._cache = jax.tree_util.tree_map(
+            _functional._raw, cache,
+            is_leaf=lambda x: hasattr(x, "_data"))
+        n = self.max_slots
+        self._state = {
+            "tokens": jnp.zeros((n,), jnp.int32),
+            "positions": jnp.zeros((n,), jnp.int32),
+            "done": jnp.ones((n,), bool),
+            "limits": jnp.zeros((n,), jnp.int32),
+            "key": jax.random.PRNGKey(seed),
+        }
+        self._queue = collections.deque()
+        self._slots = [None] * n
+        self._free = list(range(n - 1, -1, -1))  # pop() -> lowest first
+        self._window = _EmitWindow(
+            drain_window if drain_window is not None
+            else _config.get("serve.drain_window"))
+        self._exe = {}
+        self._warmed = False
+        self.compiles = 0
+        self.post_warmup_compiles = 0
+        self._next_id = 0
+        self._steps = 0
+        self._completed = []
+
+    # -- model/param plumbing -------------------------------------------
+
+    def _ensure_initialized(self):
+        """Materialize deferred params with one tiny eager forward —
+        shape inference must not happen inside an AOT trace."""
+        needs = any(p._data is None
+                    for p in self.model.collect_params().values())
+        if needs:
+            from .. import numpy as np
+            self.model(np.zeros((1, min(2, self.max_seq)), dtype="int32"))
+
+    def _full_params(self):
+        pt, qt = self._params
+        if not qt:
+            return pt
+        return _quantize.dequantize_params(pt, qt, self._qdtypes)
+
+    def _sample(self, logits, key):
+        if self.temperature > 0:
+            return jax.random.categorical(
+                key, logits / self.temperature, axis=-1).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # -- compiled step functions ----------------------------------------
+
+    def _compile(self, kind, build_args):
+        """AOT lower+compile one step executable, accounted through the
+        PR 2 recompile detector (telemetry.note_compile) so a post-warmup
+        compile trips RecompileWarning exactly like a re-tracing block."""
+        t0 = time.perf_counter()
+        jitted, args = build_args()
+        exe = jitted.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        self.compiles += 1
+        if self._warmed:
+            self.post_warmup_compiles += 1
+            if _telemetry._active:
+                _telemetry.inc("serve.post_warmup_compiles_total")
+        _telemetry.note_compile(self, f"serve.{kind}", dt,
+                                signatures=len(self._exe) + 1)
+        return exe
+
+    def _decode_fn(self, params, cache, state):
+        pt, qt = params
+        full = (_quantize.dequantize_params(pt, qt, self._qdtypes)
+                if qt else pt)
+        key, kf, ks = jax.random.split(state["key"], 3)
+        (logits, cache), _ = _functional.functional_call(
+            self.model, full, state["tokens"][:, None], cache,
+            state["positions"], rng_key=kf, method="decode_step")
+        tok = self._sample(logits, ks)
+        done0 = state["done"]
+        positions = jnp.where(done0, state["positions"],
+                              state["positions"] + 1)
+        hit_eos = (tok == self.eos_id) if self.eos_id is not None \
+            else jnp.zeros_like(done0)
+        done = done0 | hit_eos | (positions >= state["limits"])
+        new_state = {
+            "tokens": jnp.where(done0, state["tokens"], tok),
+            "positions": positions,
+            "done": done,
+            "limits": state["limits"],
+            "key": key,
+        }
+        emit = (jnp.where(done0, -1, tok), done)
+        return cache, new_state, emit
+
+    def _prefill_fn(self, params, cache, state, prompt, slot, length,
+                    limit):
+        pt, qt = params
+        full = (_quantize.dequantize_params(pt, qt, self._qdtypes)
+                if qt else pt)
+        key, kf, ks = jax.random.split(state["key"], 3)
+        (logits, cache), _ = _functional.functional_call(
+            self.model, full, prompt[None, :], cache, slot,
+            rng_key=kf, method="prefill")
+        tok = self._sample(logits[0, length - 1][None, :], ks)[0]
+        hit_eos = (tok == self.eos_id) if self.eos_id is not None \
+            else jnp.array(False)
+        done = hit_eos | (length >= limit)
+        new_state = {
+            "tokens": state["tokens"].at[slot].set(tok),
+            "positions": state["positions"].at[slot].set(length),
+            "done": state["done"].at[slot].set(done),
+            "limits": state["limits"].at[slot].set(limit),
+            "key": key,
+        }
+        return cache, new_state, (tok, done)
+
+    def _decode_exe(self):
+        exe = self._exe.get("decode")
+        if exe is None:
+            def build():
+                jitted = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+                return jitted, (_sds(self._params), _sds(self._cache),
+                                _sds(self._state))
+            exe = self._exe["decode"] = self._compile("decode", build)
+        return exe
+
+    def _prefill_exe(self, bucket):
+        key = ("prefill", bucket)
+        exe = self._exe.get(key)
+        if exe is None:
+            def build():
+                jitted = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+                scalar = jax.ShapeDtypeStruct((), jnp.int32)
+                return jitted, (_sds(self._params), _sds(self._cache),
+                                _sds(self._state),
+                                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                                scalar, scalar, scalar)
+            exe = self._exe[key] = self._compile(f"prefill_{bucket}", build)
+        return exe
+
+    def warmup(self):
+        """Compile the full executable grid (decode + one prefill per
+        bucket). After this the engine never compiles again for any
+        request mix whose prompts fit the buckets — the recompile-guard
+        regression test pins that down."""
+        self._decode_exe()
+        for b in self.buckets:
+            self._prefill_exe(b)
+        self._warmed = True
+        return self
+
+    # -- scheduling ------------------------------------------------------
+
+    def bucket_for(self, length):
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise MXNetError(
+            f"prompt length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]} (serve.buckets, max_seq={self.max_seq})")
+
+    def submit(self, prompt, max_new_tokens=32, eos_id="engine"):
+        """Enqueue one request; returns its :class:`Request` handle.
+        Admission happens inside :meth:`step` when a slot frees up."""
+        prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        self.bucket_for(len(prompt))  # validate now, not at admission
+        req = Request(self._next_id, prompt, max_new_tokens,
+                      self.eos_id if eos_id == "engine" else eos_id)
+        self._next_id += 1
+        self._queue.append(req)
+        if _telemetry._active:
+            _telemetry.inc("serve.requests_total")
+            _telemetry.set_gauge("serve.queue_depth", len(self._queue))
+        return req
+
+    def _finish(self, req):
+        req.finished = True
+        req.t_done = time.perf_counter()
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            self._free.append(req.slot)
+            self._free.sort(reverse=True)
+            req.slot = None
+        self._completed.append(req)
+        if _telemetry._active:
+            _telemetry.inc("serve.completed_total")
+            _telemetry.inc("serve.tokens_total", len(req.generated))
+            if req.tpot is not None:
+                _telemetry.observe("serve.tpot_seconds", req.tpot)
+
+    def _prefill_sink(self, req):
+        def sink(fetched):
+            tok, done = int(fetched[0]), bool(fetched[1])
+            req.t_first = time.perf_counter()
+            req.generated.append(tok)
+            if _telemetry._active and req.ttft is not None:
+                _telemetry.observe("serve.ttft_seconds", req.ttft)
+            if done:
+                self._finish(req)
+        return sink
+
+    def _decode_sink(self, slot_map):
+        def sink(fetched):
+            toks, done = fetched
+            for slot, req in slot_map.items():
+                if req.finished:
+                    continue  # finished in an older entry of this window
+                tok = int(toks[slot])
+                if tok >= 0:
+                    req.generated.append(tok)
+                if bool(done[slot]):
+                    self._finish(req)
+        return sink
+
+    def _admit(self):
+        admitted = 0
+        while self._queue and self._free:
+            req = self._queue.popleft()
+            slot = self._free.pop()
+            length = len(req.prompt)
+            bucket = self.bucket_for(length)
+            padded = onp.zeros((bucket,), dtype=onp.int32)
+            padded[:length] = req.prompt
+            limit = min(length + req.max_new_tokens - 1, self.max_seq - 1)
+            exe = self._prefill_exe(bucket)
+            self._cache, self._state, emit = exe(
+                self._params, self._cache, self._state,
+                jnp.asarray(padded), jnp.int32(slot), jnp.int32(length),
+                jnp.int32(limit))
+            req.slot = slot
+            req.t_admitted = time.perf_counter()
+            self._slots[slot] = req
+            self._window.push(emit, self._prefill_sink(req))
+            admitted += 1
+            if _telemetry._active:
+                _telemetry.inc("serve.admitted_total")
+                _telemetry.inc("serve.prefill_tokens_total", bucket)
+        return admitted
+
+    # -- the serve loop --------------------------------------------------
+
+    def step(self):
+        """One continuous-batching iteration: free slots via bounded
+        drain when the queue is starved, admit, dispatch ONE decode step
+        for every live slot, defer the result. Returns False when fully
+        idle (nothing queued, running, or pending drain)."""
+        if self._queue and not self._free and len(self._window):
+            # starved for slots: reclaim just enough, oldest first
+            self._window.drain_oldest(1)
+        admitted = self._admit()
+        live = {i: r for i, r in enumerate(self._slots) if r is not None}
+        if _telemetry._active:
+            _telemetry.set_gauge("serve.queue_depth", len(self._queue))
+            _telemetry.set_gauge("serve.slot_occupancy", len(live))
+        if not live:
+            if len(self._window):
+                self._window.drain()
+                return True
+            return admitted > 0
+        exe = self._decode_exe()
+        t0 = time.perf_counter()
+        self._cache, self._state, emit = exe(
+            self._params, self._cache, self._state)
+        self._steps += 1
+        if _telemetry._active:
+            _telemetry.inc("serve.steps_total")
+            _telemetry.observe("serve.step_seconds",
+                               time.perf_counter() - t0)
+        self._window.push(emit, self._decode_sink(live))
+        return True
+
+    def drain(self):
+        """Fetch every deferred emit (host sync); completions land."""
+        self._window.drain()
+
+    @property
+    def pending(self):
+        return bool(self._queue or len(self._window)
+                    or any(s is not None for s in self._slots))
+
+    def run(self, max_steps=None):
+        """Drive :meth:`step` until every submitted request finished (or
+        ``max_steps`` decode steps elapsed), then drain. The continuous-
+        batching main loop for offline/batch use; online callers own the
+        loop and call ``step()`` themselves."""
+        steps = 0
+        while self.pending:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        self.drain()
+        return self
+
+    # -- reporting -------------------------------------------------------
+
+    def stats(self):
+        """Host-side aggregate: counts, tokens, latency percentiles (from
+        per-request records — telemetry histograms carry the bucketed
+        view when enabled)."""
+        done = self._completed
+        ttfts = sorted(r.ttft for r in done if r.ttft is not None)
+        tpots = sorted(r.tpot for r in done if r.tpot is not None)
+
+        def pct(vals, q):
+            if not vals:
+                return None
+            return float(onp.percentile(vals, q))
+
+        out = {
+            "completed": len(done),
+            "queued": len(self._queue),
+            "live": sum(1 for s in self._slots if s is not None),
+            "steps": self._steps,
+            "tokens_out": sum(len(r.generated) for r in done),
+            "compiles": self.compiles,
+            "post_warmup_compiles": self.post_warmup_compiles,
+            "max_slots": self.max_slots,
+            "max_seq": self.max_seq,
+            "buckets": list(self.buckets),
+            "quantize": self.quantize,
+        }
+        for name, vals in (("ttft", ttfts), ("tpot", tpots)):
+            out[name] = {"p50": pct(vals, 50), "p95": pct(vals, 95),
+                         "p99": pct(vals, 99)}
+        if self.quantize:
+            pt, qt = self._params
+            now, was = _quantize.quantized_bytes(pt, qt, self._qdtypes)
+            out["weight_bytes"] = now
+            out["weight_bytes_fp"] = was
+        return out
+
+
+def load(model, max_slots=None, quantize=None, warmup=False, **kwargs):
+    """Build a :class:`ServeEngine` over ``model``.
+
+    ``quantize="int8_weights"`` enables the weight-only int8 decode path
+    (docs/SERVING.md); ``warmup=True`` compiles the full bucket grid
+    before returning so the first request never pays a compile.
+    """
+    eng = ServeEngine(model, max_slots=max_slots, quantize=quantize,
+                      **kwargs)
+    if warmup:
+        eng.warmup()
+    return eng

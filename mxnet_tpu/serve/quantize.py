@@ -1,0 +1,79 @@
+"""Weight-only int8 for the decode path.
+
+Decode is memory-bandwidth-bound: every step streams the full weight set
+from HBM to produce one token per slot, so halving (fp32) or quartering
+the weight bytes is a straight bandwidth win with no activation
+quantization risk. Scheme: symmetric per-output-channel int8 (zero-point
+0, the ops/quantization.py scheme) over 2-D float parameters; everything
+else (biases, LayerNorm vectors) stays in float.
+
+The dequant is emitted at the top of the jitted serve step
+(``w_q.astype(dtype) * scale``) so XLA fuses the widen-and-scale into
+the consuming matmul — weights cross HBM as int8, the MXU/VPU sees the
+usual float operand, and ``lax.dot_general`` keeps its
+``preferred_element_type`` accumulation. No calibration pass is needed:
+scales come from the weights themselves.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_INT8_MAX = 127.0
+
+#: 2-D float params smaller than this (elements) stay unquantized — the
+#: bandwidth win is negligible and tiny layers are accuracy-sensitive.
+MIN_ELEMENTS = 4096
+
+
+def eligible(name, arr, min_elements=MIN_ELEMENTS):
+    """Quantize only 2-D float matmul operands of meaningful size."""
+    return (getattr(arr, "ndim", 0) == 2
+            and jnp.issubdtype(arr.dtype, jnp.floating)
+            and arr.size >= min_elements)
+
+
+def quantize_params_int8(params, min_elements=MIN_ELEMENTS):
+    """Split a name->array dict into (passthrough, quantized, dtypes).
+
+    quantized maps name -> (int8 weights, per-row float32 scales);
+    dtypes maps the same names to the original dtype string (kept out of
+    the array pytree so jit/AOT lowering sees arrays only). Rows are
+    output channels for every 2-D weight this framework stores: Dense
+    keeps (units, in_units), Embedding (vocab, units) — the tied LM head
+    consumes it transposed, which turns row scales into
+    per-output-channel scales there too.
+    """
+    passthrough, quantized, dtypes = {}, {}, {}
+    for name, arr in params.items():
+        if not eligible(name, arr, min_elements):
+            passthrough[name] = arr
+            continue
+        a = jnp.asarray(arr)
+        scale = jnp.max(jnp.abs(a), axis=1, keepdims=True) / _INT8_MAX
+        scale = jnp.where(scale == 0, 1.0, scale).astype(jnp.float32)
+        q = jnp.clip(jnp.round(a / scale), -_INT8_MAX, _INT8_MAX)
+        quantized[name] = (q.astype(jnp.int8), scale)
+        dtypes[name] = str(a.dtype)
+    return passthrough, quantized, dtypes
+
+
+def dequantize_params(passthrough, quantized, dtypes):
+    """Rebuild the full float param dict inside a trace. The astype +
+    multiply stays adjacent to each consumer, so XLA fuses it and the
+    HBM reads stay int8."""
+    out = dict(passthrough)
+    for name, (q, scale) in quantized.items():
+        dtype = dtypes[name]
+        out[name] = q.astype(dtype) * scale.astype(dtype)
+    return out
+
+
+def quantized_bytes(passthrough, quantized, dtypes):
+    """(quantized footprint, original footprint) in bytes — the
+    bandwidth story a serve benchmark reports."""
+    now = sum(int(a.size) * a.dtype.itemsize for a in passthrough.values())
+    was = now
+    for name, (q, scale) in quantized.items():
+        now += int(q.size) + int(scale.size) * 4
+        was += int(q.size) * jnp.dtype(dtypes[name]).itemsize
+    return now, was

@@ -392,6 +392,57 @@ def _le(bound):
     return "+Inf" if bound == float("inf") else repr(float(bound))
 
 
+_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _hist_quantiles(h, qs=_QUANTILES):
+    """Estimate quantiles from bucket counts by linear interpolation
+    inside the containing bucket (the Prometheus histogram_quantile
+    rule): the first bucket interpolates from 0, and a quantile landing
+    in the +Inf bucket degrades to the highest finite bound — an
+    estimate, exact only at bucket edges, but monotone and cheap.
+    Returns {q: value}; empty histograms return {}."""
+    if h.count == 0:
+        return {}
+    out = {}
+    finite_hi = 0.0
+    for bound, c in zip(h.buckets, h.counts):
+        if bound != float("inf") and c:
+            finite_hi = bound
+    for q in qs:
+        target = q * h.count
+        acc = 0
+        lo = 0.0
+        val = finite_hi
+        for bound, c in zip(h.buckets, h.counts):
+            if acc + c >= target and c:
+                if bound == float("inf"):
+                    val = lo if lo else finite_hi
+                else:
+                    val = lo + (bound - lo) * (target - acc) / c
+                break
+            acc += c
+            if bound != float("inf"):
+                lo = bound
+        out[q] = val
+    return out
+
+
+def quantiles(name, qs=_QUANTILES, **labels):
+    """Estimated quantiles of one recorded histogram as
+    {"p50": v, "p95": v, ...} (None when nothing was recorded).  Serving
+    SLOs (serve.ttft/tpot) and the latency histograms (dataloader.
+    batch_wait, kvstore.*) read their percentiles through this."""
+    key = (name, _labels_key(labels))
+    with _lock:
+        h = _hists.get(key)
+        if h is None or h.count == 0:
+            return None
+        est = _hist_quantiles(h, qs)
+    return {f"p{('%g' % (100 * q)).replace('.', '_')}": v
+            for q, v in est.items()}
+
+
 def counters(prefix=None, aggregate=False):
     """Flat dict of counters.  ``aggregate=True`` sums away labels (one
     value per metric name) — what LoggingHandler's epoch summary pulls."""
@@ -430,7 +481,9 @@ def snapshot():
                 acc += c
                 cum[_le(bound)] = acc
             hist_snap[_render(n, ls)] = {
-                "buckets": cum, "sum": h.sum, "count": h.count}
+                "buckets": cum, "sum": h.sum, "count": h.count,
+                "quantiles": {("%g" % (100 * q)): v for q, v in
+                              _hist_quantiles(h).items()}}
     return {"counters": dict(sorted(counter_snap.items())),
             "gauges": dict(sorted(gauge_snap.items())),
             "histograms": dict(sorted(hist_snap.items()))}
@@ -469,6 +522,9 @@ def exposition():
                     lines.append(f"{full}_bucket{le} {acc}")
                 lines.append(f"{full}_sum{_render('', labels)} {v.sum:g}")
                 lines.append(f"{full}_count{_render('', labels)} {v.count}")
+                for q, qv in _hist_quantiles(v).items():
+                    ql = _render("", labels, (("quantile", "%g" % q),))
+                    lines.append(f"{full}{ql} {qv:g}")
             else:
                 vv = f"{v:g}" if isinstance(v, float) else str(v)
                 lines.append(f"{full}{_render('', labels)} {vv}")

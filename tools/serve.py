@@ -1,0 +1,106 @@
+"""Serve CLI: drive a mx.serve engine from the command line.
+
+A harness for poking the continuous-batching engine (docs/SERVING.md)
+without writing a script — token-id prompts in, generated ids + SLO
+stats out. The framework ships no tokenizer, so prompts are
+comma-separated token ids (`--prompt 12,40,7`, repeatable) or a random
+demo workload (`--demo N`).
+
+Usage:
+    # tiny CPU demo: 12 random prompts through 4 slots
+    JAX_PLATFORMS=cpu python tools/serve.py --demo 12 --slots 4
+
+    # explicit prompts, greedy, int8 weights
+    python tools/serve.py --prompt 3,14,15 --prompt 92,65 \
+        --quantize int8_weights --max-new 32
+
+    # gpt2-124m shapes (accelerator-sized; slow on CPU)
+    python tools/serve.py --model gpt2_124m --demo 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(name):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import gpt
+
+    if name == "tiny":
+        net = gpt.GPTForCausalLM(vocab_size=512, units=64, hidden_size=256,
+                                 num_layers=2, num_heads=4, max_length=128,
+                                 dropout=0.0, embed_dropout=0.0)
+    else:  # gpt2_* builders return the backbone; serving wants logits
+        net = gpt.GPTForCausalLM(backbone=getattr(gpt, name)(
+            dropout=0.0, embed_dropout=0.0))
+    net.initialize()
+    net(mx.np.zeros((1, 2), dtype="int32"))
+    return net
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="tiny",
+                   choices=["tiny", "gpt2_124m", "gpt2_355m"],
+                   help="model config (random weights; tiny is CPU-sized)")
+    p.add_argument("--prompt", action="append", default=[],
+                   help="comma-separated token ids; repeatable")
+    p.add_argument("--demo", type=int, default=0, metavar="N",
+                   help="add N random prompts (lengths 2..24)")
+    p.add_argument("--slots", type=int, default=None)
+    p.add_argument("--max-new", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--quantize", default=None,
+                   choices=[None, "int8_weights"])
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    net = build_model(args.model)
+    vocab = net.backbone.word_embed.weight.shape[0]
+    prompts = [[int(t) for t in s.split(",")] for s in args.prompt]
+    rng = onp.random.RandomState(args.seed)
+    for _ in range(args.demo):
+        prompts.append(
+            rng.randint(1, vocab, size=rng.randint(2, 25)).tolist())
+    if not prompts:
+        p.error("no work: pass --prompt and/or --demo N")
+
+    telemetry.enable()
+    eng = mx.serve.load(net, max_slots=args.slots, eos_id=args.eos_id,
+                        temperature=args.temperature, seed=args.seed,
+                        quantize=args.quantize)
+    t0 = time.perf_counter()
+    eng.warmup()
+    warmup_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    reqs = [eng.submit(ids, max_new_tokens=args.max_new) for ids in prompts]
+    eng.run()
+    wall = time.perf_counter() - t0
+
+    for r in reqs:
+        print(json.dumps({"id": r.id, "prompt": r.prompt,
+                          "output_ids": r.output_ids,
+                          "ttft_ms": round(r.ttft * 1e3, 3),
+                          "tpot_ms": round(r.tpot * 1e3, 3)}))
+    st = eng.stats()
+    st["warmup_s"] = round(warmup_s, 3)
+    st["wall_s"] = round(wall, 4)
+    st["tokens_per_s"] = round(st["tokens_out"] / wall, 1)
+    print(json.dumps(st))
+    return 1 if st["post_warmup_compiles"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
