@@ -149,6 +149,15 @@ def _row(name, sec_per_step, items_per_step, model_flops_per_step,
     return row
 
 
+def _config_dict(batch, steps_per_call, zero=0, grad_accum=1, remat=False,
+                 prefetch_depth=None):
+    """The full step-config a row actually ran under, in the same shape
+    mx.autotune persists — so bench rows and tuned winners join cleanly."""
+    return {"batch": batch, "steps_per_call": steps_per_call, "zero": zero,
+            "grad_accum": grad_accum, "remat": remat,
+            "prefetch_depth": prefetch_depth}
+
+
 def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
                      precision, on_cpu, peak, k_steps=16, tpu_cfg=(32, None),
                      cpu_cfg=(4, 64, 100), nclass_tpu=1000,
@@ -225,6 +234,7 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
     row = _row(f"{name}_train_bs{bs}_{precision}", sec, bs, flops,
                precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    row["config"] = _config_dict(bs, k_steps)
     from mxnet_tpu import config as _cfg
     row["fused_conv_bn"] = str(_cfg.get("fused_conv_bn"))
     if baseline_img_s:
@@ -306,6 +316,7 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     row = _row(f"resnet50_infer_bs{bs}_{precision}", sec, bs, flops,
                precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    row["config"] = _config_dict(bs, k_steps)
     if int8:
         row["peak_basis"] = f"int8 ({_int8_factor():g}x bf16)"
     base = BASE_R50_INFER_FP16.get(bs)
@@ -372,6 +383,7 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=16,
                sec, bs,
                flops, precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    row["config"] = _config_dict(bs, k_steps)
     row["params_m"] = round(n_params / 1e6, 1)
     from mxnet_tpu import config as _cfg
     row["fused_ln_residual"] = str(_cfg.get("fused_ln_residual"))
@@ -440,6 +452,7 @@ def bench_gpt_train(precision: str, on_cpu: bool, peak, bs=8, seq=1024,
     row = _row(f"gpt2_124m_pretrain_bs{bs}_seq{seq}_{precision}", sec, bs,
                flops, precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
+    row["config"] = _config_dict(bs, k_steps)
     row["params_m"] = round(n_params / 1e6, 1)
     from mxnet_tpu.ops.attention import _FLASH_MIN_SEQ_CAUSAL
     row["flash_attention"] = bool(seq >= _FLASH_MIN_SEQ_CAUSAL
@@ -612,12 +625,71 @@ def _probe_backend(timeout_s=240):
     return "cpu (tpu probe failed)"
 
 
-def main():
+_TRAIN_FAMILIES = {
+    "resnet50_train": "bench_resnet50_train",
+    "bert_train": "bench_bert_train",
+    "gpt_train": "bench_gpt_train",
+}
+
+
+def _tuned_entries(path):
+    """Turn an autotune winners file (mx.autotune winners.json, or a plain
+    {workload: config} mapping) into extra tuned grid points.
+
+    Each tuned config feeds its batch/steps_per_call into the train-family
+    benches; the winner's full config rides on the row as "tuned_config"
+    (the hand-rolled bench steps run zero=0/grad_accum=1/remat=off, and
+    row["config"] always records what actually executed)."""
+    with open(path) as f:
+        data = json.load(f)
+    g = globals()
+    entries = []
+    if isinstance(data, dict) and "winners" in data:
+        # one tuned point per distinct winner config, across all train
+        # families (the winners file has no workload names — keys are
+        # model-fingerprint based)
+        seen = set()
+        for rec in data["winners"].values():
+            cfg = rec.get("config", {})
+            key = json.dumps(cfg, sort_keys=True)
+            if key in seen or "batch_size" not in cfg:
+                continue
+            seen.add(key)
+            for fn_name in _TRAIN_FAMILIES.values():
+                entries.append((g[fn_name],
+                                dict(precision="bf16", bs=cfg["batch_size"],
+                                     k_steps=cfg.get("steps_per_call"),
+                                     _tuned=cfg)))
+    elif isinstance(data, dict):
+        for workload, cfg in data.items():
+            fn_name = _TRAIN_FAMILIES.get(workload, workload)
+            if fn_name not in g:
+                raise SystemExit(f"--config: unknown workload {workload!r}")
+            entries.append((g[fn_name],
+                            dict(precision="bf16", bs=cfg["batch_size"],
+                                 k_steps=cfg.get("steps_per_call"),
+                                 _tuned=cfg)))
+    return entries
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="mxnet_tpu benchmark grid")
+    ap.add_argument("--config", default=None, metavar="WINNERS_JSON",
+                    help="autotune winners file; each tuned config is "
+                         "added to the grid as extra train-family rows")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the summary JSON to this file; "
+                         "stdout's final line is always the JSON alone")
+    args = ap.parse_args(argv)
+
     import jax
 
     probed = _probe_backend()
     if "probe failed" in probed:
-        print(f"# backend probe: {probed}", flush=True)
+        # diagnostics go to stderr: stdout must stay machine-readable
+        # (the last stdout line is the one JSON document)
+        print(f"# backend probe: {probed}", file=sys.stderr, flush=True)
     dev = jax.devices()[0]
     platform, on_cpu = dev.platform, dev.platform == "cpu"
     peak = _chip_peak(dev)
@@ -644,14 +716,18 @@ def main():
         (bench_gpt_decode_serve, dict(precision="int8")),
         (bench_augmentation, dict(precision="fp32")),
         (bench_dataloader_workers, dict(precision="fp32")),
-    ]:
-        if on_cpu and kwargs.get("bs", 32) != 32 and fn in (
+    ] + (_tuned_entries(args.config) if args.config else []):
+        tuned = kwargs.pop("_tuned", None)
+        if kwargs.get("k_steps") is None:
+            kwargs.pop("k_steps", None)
+        if tuned is None and on_cpu and kwargs.get("bs", 32) != 32 and fn in (
                 bench_resnet50_train, bench_resnet50_infer,
                 bench_inception_train):
             # the CPU fallback shrinks every CNN row to one tiny config —
             # the batch-size grid rows would be identical duplicates
             continue
-        if on_cpu and fn is bench_gpt_train and kwargs.get("seq") != 1024:
+        if tuned is None and on_cpu and fn is bench_gpt_train \
+                and kwargs.get("seq") != 1024:
             continue  # same dedup for the shrunken GPT rows
         from mxnet_tpu import config as _cfg
         fused_prior = _cfg.get("fused_conv_bn")
@@ -672,13 +748,16 @@ def main():
         if row is None:
             rows.append({"name": f"{fn.__name__}{kwargs}", "error": err})
             continue
+        if tuned is not None:
+            row["tuned"] = True
+            row["tuned_config"] = tuned
         rows.append({k: (round(v, 2) if isinstance(v, float) else v)
                      for k, v in row.items()})
 
     head = next((r for r in rows if "items_per_s" in r), {})
     best_mfu = max((r["mfu"] for r in rows
                     if "mfu" in r and r.get("valid", True)), default=None)
-    print(json.dumps({
+    summary = json.dumps({
         "metric": head.get("name", "resnet50_train"),
         "value": head.get("items_per_s"),
         "unit": "images/sec",
@@ -692,7 +771,11 @@ def main():
         "device_kind": getattr(dev, "device_kind", "?"),
         "chip_peak_bf16_tflops": round(peak / 1e12, 1) if peak else None,
         "grid": rows,
-    }))
+    })
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(summary + "\n")
+    print(summary, flush=True)
 
 
 if __name__ == "__main__":

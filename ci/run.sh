@@ -20,7 +20,9 @@
 #                benchmark: prefetch-on must beat the synchronous loop
 #                >=1.2x with input-stall below the serial producer wait,
 #                and the disabled path must stay <2% on a tight eager
-#                loop (docs/PERFORMANCE.md)
+#                loop (docs/PERFORMANCE.md); plus the proc-vs-thread
+#                DataLoader gate (spawn pool >= 0.8x threads on the
+#                GIL-bound transform)
 #   zero       - ZeRO-sharded training suite + the optimizer-state
 #                memory benchmark: zero=1 on a 4-way dp mesh must cut
 #                per-device state bytes >=40% while staying numerically
@@ -29,6 +31,11 @@
 #                benchmark: >=2x tokens/s vs sequential decode under
 #                Poisson arrivals with ZERO post-warmup recompiles
 #                (docs/SERVING.md)
+#   autotune   - config-search suite + an e2e CPU search: >=50% of the
+#                grid pruned analytically, winner >= untuned default,
+#                an injected OOM trial survives, and the second run
+#                reloads the winner by fingerprint with zero trials
+#                (docs/PERFORMANCE.md "Autotuning")
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -37,7 +44,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -93,8 +100,13 @@ PY
 contracts() {
     echo "== contracts: driver entrypoints =="
     python __graft_entry__.py
-    echo "== contracts: bench smoke (CPU shapes) =="
-    JAX_PLATFORMS=cpu python bench.py
+    echo "== contracts: bench smoke (CPU shapes, machine-readable out) =="
+    tmp=$(mktemp -d)
+    JAX_PLATFORMS=cpu python bench.py --out "$tmp/bench.json"
+    # the machine-readability gate: --out and the last stdout line are
+    # the same single JSON document (BENCH_r05 "parsed: null" regression)
+    python -c "import json,sys; json.load(open(sys.argv[1]))" "$tmp/bench.json"
+    rm -rf "$tmp"
 }
 
 chaos() {
@@ -187,6 +199,26 @@ pipeline() {
     python -m pytest tests/test_pipeline.py tests/test_dataloader_mp.py -q
     echo "== pipeline: overlap benchmark (>=1.2x, stall < serial wait, off-path <2%) =="
     JAX_PLATFORMS=cpu python benchmark/pipeline_overlap.py
+    echo "== pipeline: proc-vs-thread loader gate (>=0.8x) =="
+    JAX_PLATFORMS=cpu python benchmark/scaling_proc.py --loader-gate
+}
+
+autotune() {
+    echo "== autotune: config-search suite (docs/PERFORMANCE.md) =="
+    python -m pytest tests/test_autotune.py -q
+    echo "== autotune: e2e search (>=50% pruned, winner >= default, OOM survival) =="
+    tmp=$(mktemp -d)
+    # first run: fresh cache, one injected device-OOM mid-search; the
+    # search must finish, record the OOM, prune >=50% of the grid before
+    # compiling, beat the untuned default, and leak zero RecompileWarnings
+    JAX_PLATFORMS=cpu python tools/autotune.py --model mlp \
+        --cache-dir "$tmp" --trial-seconds 0.05 \
+        --inject-oom-at 2 --assert --out "$tmp/first.json"
+    # second run: the winner must come back by fingerprint with ZERO
+    # trials re-executed
+    JAX_PLATFORMS=cpu python tools/autotune.py --model mlp \
+        --cache-dir "$tmp" --trial-seconds 0.05 --expect-reused
+    rm -rf "$tmp"
 }
 
 zero() {
@@ -233,8 +265,9 @@ case "$stage" in
     pipeline) pipeline ;;
     zero) zero ;;
     serve) serve ;;
+    autotune) autotune ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -66,6 +66,7 @@ from . import contrib
 from . import serialization
 from . import resilience
 from . import serve
+from . import autotune
 from . import storage
 from . import callback
 from . import model

@@ -1,0 +1,42 @@
+"""mx.autotune — measured config search for the compiled training step.
+
+Reference parity: none (the reference tunes by hand-edited perf.md
+tables).  On a compiler-backed stack the throughput of one model is a
+function of a small discrete config — ``{batch_size, steps_per_call,
+grad_accum, zero, remat, prefetch_depth}`` — and the honest way to pick
+it is TVM-style (arxiv 1802.04799): an analytic cost model prunes the
+grid, short measured trials of the *real* compiled step rank the
+survivors, and the winner persists next to the XLA compile cache so the
+next run starts tuned with zero trials.
+
+Three surfaces::
+
+    # training-step API
+    tuned_step, result = step.autotune(loader)
+
+    # estimator API
+    est.fit(train_data, epochs=2, autotune=True)
+
+    # CLI
+    JAX_PLATFORMS=cpu python tools/autotune.py --model mlp --assert
+
+See docs/PERFORMANCE.md ("Autotuning the compiled step").
+"""
+from __future__ import annotations
+
+from .cost import (CostModel, ModelStats, REMAT_FLOPS_FACTOR,
+                   REMAT_MEM_FRACTION)
+from .persist import (cache_dir, load_winner, model_fingerprint,
+                      save_winner, winner_key, winners_path)
+from .search import (SearchResult, TrialOOM, TrialResult, last_summary,
+                     search, trial_compile_scope, tune_estimator)
+from .space import Candidate, SearchSpace
+
+__all__ = [
+    "Candidate", "SearchSpace", "CostModel", "ModelStats",
+    "REMAT_MEM_FRACTION", "REMAT_FLOPS_FACTOR",
+    "SearchResult", "TrialResult", "TrialOOM",
+    "search", "tune_estimator", "trial_compile_scope", "last_summary",
+    "cache_dir", "winners_path", "model_fingerprint", "winner_key",
+    "load_winner", "save_winner",
+]

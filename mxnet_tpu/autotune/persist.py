@@ -1,0 +1,106 @@
+"""Winner persistence: tuned configs survive the process, keyed like the
+XLA compile cache they sit next to.
+
+Winners live in ONE JSON file (``winners.json``) under, in order of
+preference: the ``autotune.cache_dir`` knob, the persistent XLA compile
+cache directory (``compilation_cache_dir`` — "next to the XLA cache", so
+one cache volume carries both the compiled executables and the configs
+that produced them), or ``<mxnet home>/autotune``.
+
+Keys are ``<model fingerprint>|<device_kind>|dp<N>``: the fingerprint
+hashes the parameter inventory (structural name, shape, dtype) plus the
+block/loss/optimizer identities, so any architecture change invalidates
+the entry; device_kind and dp size key the hardware point the
+measurement is only valid for.  Writes are atomic (tmp + rename) — a
+preempted run never leaves a torn winners file.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+from .. import config as _config
+
+__all__ = ["cache_dir", "winners_path", "model_fingerprint", "winner_key",
+           "load_winner", "save_winner", "load_all"]
+
+_FILE = "winners.json"
+_VERSION = 1
+
+
+def cache_dir():
+    """Resolve the winners directory (see module docstring)."""
+    path = _config.get("autotune.cache_dir")
+    if not path:
+        path = _config.get("compilation_cache_dir")
+    if not path:
+        path = os.path.join(_config.get("home"), "autotune")
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def winners_path():
+    return os.path.join(cache_dir(), _FILE)
+
+
+def model_fingerprint(block, loss_fn=None, optimizer=None):
+    """Hash of everything a stale winner must not survive: the parameter
+    inventory (name, shape, dtype — sorted, so dict order is irrelevant),
+    the block class, and the loss/optimizer identities."""
+    from .. import functional
+    trainable, aux = functional.split_params(block)
+    items = []
+    for n, v in sorted({**trainable, **aux}.items()):
+        items.append(f"{n}:{tuple(v.shape)}:{v.dtype}")
+    items.append(f"block={type(block).__module__}.{type(block).__qualname__}")
+    if loss_fn is not None:
+        items.append(f"loss={getattr(loss_fn, '__qualname__', None) or type(loss_fn).__qualname__}")
+    if optimizer is not None:
+        items.append(f"opt={type(optimizer).__qualname__}")
+    h = hashlib.sha256("\n".join(items).encode()).hexdigest()
+    return h[:16]
+
+
+def winner_key(fingerprint, device_kind, dp):
+    return f"{fingerprint}|{device_kind}|dp{int(dp)}"
+
+
+def load_all(path=None):
+    """Parse a winners file -> {key: record}; {} when absent/corrupt."""
+    path = path or winners_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("version") != _VERSION:
+        return {}
+    winners = data.get("winners")
+    return winners if isinstance(winners, dict) else {}
+
+
+def load_winner(key, path=None):
+    return load_all(path).get(key)
+
+
+def save_winner(key, record, path=None):
+    """Merge one winner into the file atomically; returns the path."""
+    path = path or winners_path()
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    winners = load_all(path)
+    winners[key] = record
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".winners.", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump({"version": _VERSION, "winners": winners}, f,
+                      indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -1,0 +1,130 @@
+"""Search space for the compiled-step config search.
+
+A ``Candidate`` is one point in the grid the tuner considers:
+
+    {batch_size, steps_per_call, grad_accum, zero, remat, prefetch_depth}
+
+— exactly the knobs ``ShardedTrainStep`` + ``DevicePrefetcher`` accept,
+so every candidate maps 1:1 onto a constructible training step.  Values
+are JSON-native (remat is ``False``/``'dots'``/``True``) so winners
+round-trip through the persisted winners file unchanged.
+"""
+from __future__ import annotations
+
+import itertools
+
+from .. import config as _config
+from ..base import MXNetError
+
+__all__ = ["Candidate", "SearchSpace", "REMAT_VALUES"]
+
+#: remat axis values, cheapest-compute first (order matters for docs only)
+REMAT_VALUES = (False, "dots", True)
+
+
+class Candidate:
+    """One grid point; hashable on its config tuple."""
+
+    __slots__ = ("batch_size", "steps_per_call", "grad_accum", "zero",
+                 "remat", "prefetch_depth")
+
+    def __init__(self, batch_size, steps_per_call=1, grad_accum=1, zero=0,
+                 remat=False, prefetch_depth=None):
+        self.batch_size = int(batch_size)
+        self.steps_per_call = int(steps_per_call)
+        self.grad_accum = int(grad_accum)
+        self.zero = int(zero)
+        self.remat = remat
+        self.prefetch_depth = (None if prefetch_depth is None
+                               else int(prefetch_depth))
+
+    def config(self):
+        """JSON-safe config dict (the shape persisted in winners.json and
+        recorded per bench row)."""
+        return {"batch_size": self.batch_size,
+                "steps_per_call": self.steps_per_call,
+                "grad_accum": self.grad_accum,
+                "zero": self.zero,
+                "remat": self.remat,
+                "prefetch_depth": self.prefetch_depth}
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(**{k: cfg[k] for k in
+                      ("batch_size", "steps_per_call", "grad_accum", "zero",
+                       "remat", "prefetch_depth")})
+
+    def key(self):
+        return (self.batch_size, self.steps_per_call, self.grad_accum,
+                self.zero, self.remat, self.prefetch_depth)
+
+    def __eq__(self, other):
+        return isinstance(other, Candidate) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        return ("Candidate(bs={batch_size}, spc={steps_per_call}, "
+                "ga={grad_accum}, zero={zero}, remat={remat}, "
+                "prefetch={prefetch_depth})").format(**self.config())
+
+
+class SearchSpace:
+    """Cartesian grid over the step-config axes.
+
+    Axis defaults are the production-relevant neighborhoods around the
+    untuned step (steps_per_call 1/2/4, grad_accum 1/2, all zero levels,
+    all remat policies, the configured prefetch depth); any axis can be
+    overridden with an explicit list.  ``candidates()`` enumerates the
+    full grid in deterministic order — validity/pruning is the cost
+    model's job (cost.py), not the space's.
+    """
+
+    def __init__(self, batch_size, steps_per_call=(1, 2, 4),
+                 grad_accum=(1, 2), zero=(0, 1, 2), remat=REMAT_VALUES,
+                 prefetch_depth=None):
+        def _axis(v):
+            return tuple(v) if isinstance(v, (tuple, list)) else (v,)
+        self.batch_size = _axis(batch_size)
+        self.steps_per_call = _axis(steps_per_call)
+        self.grad_accum = _axis(grad_accum)
+        self.zero = _axis(zero)
+        self.remat = _axis(remat)
+        if prefetch_depth is None:
+            prefetch_depth = (_config.get("pipeline.prefetch_depth"),)
+        self.prefetch_depth = _axis(prefetch_depth)
+        if not self.batch_size:
+            raise MXNetError("SearchSpace needs at least one batch size")
+        for z in self.zero:
+            if z not in (0, 1, 2):
+                raise MXNetError(f"zero axis value {z!r} not in (0, 1, 2)")
+
+    @classmethod
+    def default(cls, batch_size):
+        """The default neighborhood around an untuned step with per-update
+        batch ``batch_size``."""
+        return cls(batch_size=batch_size)
+
+    def default_candidate(self):
+        """The untuned point: first batch size, no step fusion, no memory
+        knobs, configured prefetch depth — the baseline every winner's
+        speedup is reported against."""
+        return Candidate(self.batch_size[0], steps_per_call=1, grad_accum=1,
+                         zero=0, remat=False,
+                         prefetch_depth=self.prefetch_depth[0])
+
+    def candidates(self):
+        """Enumerate the grid (deterministic order; includes the default
+        candidate by construction)."""
+        out = []
+        for bs, spc, ga, z, rm, pf in itertools.product(
+                self.batch_size, self.steps_per_call, self.grad_accum,
+                self.zero, self.remat, self.prefetch_depth):
+            out.append(Candidate(bs, spc, ga, z, rm, pf))
+        return out
+
+    def __len__(self):
+        return (len(self.batch_size) * len(self.steps_per_call)
+                * len(self.grad_accum) * len(self.zero) * len(self.remat)
+                * len(self.prefetch_depth))

@@ -246,6 +246,36 @@ declare("serve.drain_window", int, 4, "MXNET_SERVE_DRAIN_WINDOW",
         "(token, done) vectors pending host fetch. Completions are "
         "observed at most this many steps late; larger windows keep the "
         "step loop fully sync-free, smaller ones free slots sooner.")
+declare("autotune.cache_dir", str, "", "MXNET_AUTOTUNE_CACHE",
+        "Directory for mx.autotune's winners.json ('' = fall back to "
+        "compilation_cache_dir — the tuned configs live next to the XLA "
+        "executables they produced — then <home>/autotune).")
+declare("autotune.trial_seconds", float, 0.4, "MXNET_AUTOTUNE_TRIAL_SECONDS",
+        "Target measured window per autotune trial (after warmup); short "
+        "trials keep a full search under a minute, the winner's number is "
+        "re-validated by production telemetry anyway.")
+declare("autotune.trial_warmup", int, 1, "MXNET_AUTOTUNE_TRIAL_WARMUP",
+        "Warmup calls per autotune trial before the timed window (the "
+        "first call — trace + compile — is always excluded).")
+declare("autotune.max_trials", int, 0, "MXNET_AUTOTUNE_MAX_TRIALS",
+        "Cap on measured trials per search (0 = no cap): the cost model "
+        "keeps the predicted-best survivors plus the default baseline and "
+        "prunes the rest as 'ranked_out'.")
+declare("autotune.hbm_fraction", float, 0.9, "MXNET_AUTOTUNE_HBM_FRACTION",
+        "Fraction of the per-device bytes_limit (PJRT memory_stats, the "
+        "memory.* gauges) usable as the autotune HBM budget — headroom "
+        "for allocator fragmentation and the host's transfer buffers.")
+declare("autotune.recompile_limit", int, 64,
+        "MXNET_AUTOTUNE_RECOMPILE_LIMIT",
+        "Trial-scoped telemetry.recompile_limit during an autotune "
+        "search: every candidate legitimately compiles once, so the "
+        "detector budget is widened for the trials and restored (with "
+        "the pre-search compile counts) afterwards.")
+declare("autotune.launch_overhead_items", float, 8.0,
+        "MXNET_AUTOTUNE_LAUNCH_OVERHEAD_ITEMS",
+        "Cost-model constant: per-launch dispatch overhead expressed in "
+        "item-equivalents, amortized over batch*steps_per_call when "
+        "ranking candidates (tunneled-TPU dispatch is ~1-7ms/launch).")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

@@ -70,6 +70,11 @@ POINTS = {
         "the cluster preempts this worker (SIGTERM analog, probed once "
         "per step): the in-flight step finishes, the TrainState bundle "
         "is written, and training stops with the resume sentinel",
+    "autotune.trial_oom":
+        "a measured autotune trial exhausts device memory (probed once "
+        "per trial, before its step compiles): the candidate is recorded "
+        "as oom in autotune.* telemetry and the search continues to the "
+        "next grid point",
 }
 
 _lock = threading.Lock()

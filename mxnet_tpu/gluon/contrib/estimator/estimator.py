@@ -54,7 +54,16 @@ class Estimator:
         return by_event, stop
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
-            batches=None, batch_axis=0):
+            batches=None, batch_axis=0, autotune=False):
+        # autotune=True (or a dict of mx.autotune.search kwargs) runs the
+        # config search on one batch borrowed from train_data before the
+        # loop, applies what eager fit can use (remat, prefetch depth) and
+        # leaves the full result on self.autotune_result
+        if autotune:
+            from .... import autotune as _autotune
+            _autotune.tune_estimator(
+                self, train_data,
+                **(autotune if isinstance(autotune, dict) else {}))
         epochs = epochs or (None if batches else 1)
         by_event, stop = self._handlers(event_handlers, epochs, batches)
 

@@ -179,6 +179,10 @@ class ShardedTrainStep:
         self.mesh = mesh
         self.n_labels = n_labels
         self.dp_axis = dp_axis
+        # per-update specs as given (before the grad_accum/steps_per_call
+        # lead axes are folded in below) — autotune() rebuilds steps with
+        # different lead-axis geometry from these
+        self.batch_specs = tuple(batch_specs)
         self.zero = int(zero)
         self.grad_accum = int(grad_accum)
         self.steps_per_call = int(steps_per_call)
@@ -492,6 +496,50 @@ class ShardedTrainStep:
         return _pipeline.DevicePrefetcher(
             iter(batches), shardings=self.batch_shardings, depth=depth,
             stall_timeout=stall_timeout)
+
+    def autotune(self, batches=None, sample_batch=None, space=None, **kw):
+        """Search the step-config grid around THIS step's model, loss,
+        optimizer and mesh (mx.autotune.search) and return
+        ``(tuned_step, result)``.
+
+        ``batches`` lends ONE sample batch (shaped like ``__call__``'s
+        per-update batch, no lead axes) and is released via
+        ``pipeline.take``; pass ``sample_batch=`` to skip the loader.
+        Current weights sync to the block first so trials — and the
+        returned tuned step — start from this step's training state.  The
+        tuned step reuses the caller's optimizer (schedule position
+        included); trials only ever run on hermetic clones.  Keyword args
+        flow to ``mx.autotune.search`` (space=, hbm_budget=, force=, ...).
+        """
+        from .. import autotune as _autotune
+        if sample_batch is None:
+            if batches is None:
+                raise MXNetError(
+                    "autotune needs `batches` (a loader to borrow one "
+                    "batch from) or an explicit `sample_batch`")
+            sample_batch = next(iter(_pipeline.take(batches, 1)), None)
+            if sample_batch is None:
+                raise MXNetError("autotune: batches yielded nothing")
+        sample = tuple(onp.asarray(b._data) if isinstance(b, ndarray)
+                       else onp.asarray(b) for b in sample_batch)
+        self.sync_to_block()
+        result = _autotune.search(
+            self.block, self.loss_fn, self.fopt.opt, self.mesh,
+            self.batch_specs, sample, n_labels=self.n_labels,
+            param_specs=self.param_specs, dp_axis=self.dp_axis,
+            space=space, **kw)
+        cfg = result.config
+        if cfg is None:  # every trial failed: keep the caller's config
+            return self, result
+        tuned = ShardedTrainStep(
+            self.block, self.loss_fn, self.fopt.opt, self.mesh,
+            self.batch_specs, n_labels=self.n_labels,
+            param_specs=self.param_specs,
+            steps_per_call=cfg["steps_per_call"], zero=cfg["zero"],
+            grad_accum=cfg["grad_accum"], remat=cfg["remat"],
+            dp_axis=self.dp_axis)
+        tuned._n_step = self._n_step
+        return tuned, result
 
     def sync_to_block(self):
         """Write current sharded weights back into the Block's Parameters

@@ -59,7 +59,30 @@ from . import telemetry as _telemetry
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device", "DeferredWindow",
            "maybe_device_put", "ensure_sharded", "sync_guard",
-           "note_host_sync", "SyncGuard"]
+           "note_host_sync", "SyncGuard", "take"]
+
+
+def take(source, n):
+    """Yield at most ``n`` batches from ``source``, then release it:
+    ``close()`` is called on the iterator (or the source) when either
+    side defines it, so peeling a sample batch off a DevicePrefetcher or
+    a worker-backed DataLoader doesn't leave its background machinery
+    running.  Used by the autotune surfaces to borrow one batch from the
+    caller's loader."""
+    it = iter(source)
+    try:
+        for _ in range(int(n)):
+            try:
+                yield next(it)
+            except StopIteration:
+                return
+    finally:
+        close = getattr(it, "close", None) or getattr(source, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:
+                pass
 
 _telemetry.declare_metric(
     "pipeline.input_stall_seconds", "histogram",

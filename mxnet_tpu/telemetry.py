@@ -172,6 +172,26 @@ declare_metric("memory.peak_bytes_in_use", "gauge",
                "per-device peak HBM bytes since start, by device")
 declare_metric("memory.bytes_limit", "gauge",
                "per-device HBM capacity reported by the runtime, by device")
+declare_metric("autotune.candidates_total", "counter",
+               "config-search grid points considered by mx.autotune")
+declare_metric("autotune.pruned_total", "counter",
+               "candidates the analytic cost model rejected without a "
+               "compile, by reason (dominated/hbm/invalid/ranked_out)")
+declare_metric("autotune.trials_total", "counter",
+               "measured autotune trials executed (compile + short "
+               "timed window), including failed ones")
+declare_metric("autotune.trials_oom_total", "counter",
+               "autotune trials that died of device OOM (recorded, "
+               "search continues)")
+declare_metric("autotune.search_seconds", "histogram",
+               "wall time of one full autotune search",
+               buckets=TIME_BUCKETS)
+declare_metric("autotune.best_speedup", "gauge",
+               "measured items/s of the autotune winner over the "
+               "untuned default config")
+declare_metric("autotune.cache_hits_total", "counter",
+               "searches answered from the persisted winners file "
+               "(fingerprint match, zero trials re-run)")
 
 
 # -- switches ---------------------------------------------------------------
@@ -613,11 +633,17 @@ class TrainingTelemetry:
 
     def report(self):
         """The final run report dict (also what ``close()`` emits)."""
-        return {"type": "run_report", "run_id": self.run_id,
-                "steps": self._steps,
-                "wall_seconds": time.time() - self._t0,
-                "memory": record_memory(),
-                "metrics": snapshot()}
+        out = {"type": "run_report", "run_id": self.run_id,
+               "steps": self._steps,
+               "wall_seconds": time.time() - self._t0,
+               "memory": record_memory(),
+               "metrics": snapshot()}
+        # lazy import: autotune imports telemetry at module load
+        from . import autotune as _autotune
+        tuned = _autotune.last_summary()
+        if tuned is not None:
+            out["autotune"] = tuned
+        return out
 
     def close(self):
         """Emit the run report, close the JSONL file, restore the

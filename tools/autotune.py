@@ -1,0 +1,187 @@
+"""Autotune CLI: measured config search for the compiled step.
+
+Runs ``mx.autotune.search`` on a synthetic workload and prints ONE JSON
+summary line on stdout (diagnostics go to stderr).  Winners persist to
+the autotune cache (``--cache-dir`` / ``MXNET_AUTOTUNE_CACHE`` /
+next to ``MXNET_COMPILE_CACHE``): the second run with the same model
+reloads the winner by fingerprint and executes zero trials.
+
+Usage:
+    # CPU-CI end-to-end: search, assert the acceptance bars
+    JAX_PLATFORMS=cpu python tools/autotune.py --model mlp --assert
+
+    # chaos: inject a device-OOM into trial 2; the search must survive
+    JAX_PLATFORMS=cpu python tools/autotune.py --model mlp \
+        --inject-oom-at 2 --assert
+
+    # second run against the same cache: zero trials re-executed
+    JAX_PLATFORMS=cpu python tools/autotune.py --model mlp \
+        --cache-dir /tmp/tune --expect-reused
+
+``--assert`` enforces: >=50% of the grid pruned without compiling, the
+winner's measured items/s >= the untuned default, zero RecompileWarnings
+after the search, and (with --inject-oom-at) the OOM trial recorded.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(name, seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    if name == "mlp":
+        net = nn.Sequential()
+        net.add(nn.Dense(64, activation="relu"),
+                nn.Dense(64, activation="relu"), nn.Dense(10))
+        net.initialize()
+        net(mx.np.zeros((2, 32)))
+        feature_shape, n_classes = (32,), 10
+    elif name == "tiny_gpt":
+        from mxnet_tpu.gluon.model_zoo import gpt
+        net = gpt.GPTForCausalLM(vocab_size=256, units=32, hidden_size=128,
+                                 num_layers=2, num_heads=4, max_length=64,
+                                 dropout=0.0, embed_dropout=0.0)
+        net.initialize()
+        net(mx.np.zeros((2, 8), dtype="int32"))
+        feature_shape, n_classes = None, 256
+    else:
+        raise SystemExit(f"unknown model {name}")
+    return net, feature_shape, n_classes
+
+
+def make_batch(model, feature_shape, n_classes, batch, seq, seed=0):
+    import numpy as onp
+    rng = onp.random.RandomState(seed)
+    if model == "mlp":
+        x = rng.randn(batch, *feature_shape).astype("float32")
+        y = rng.randint(0, n_classes, size=(batch,)).astype("int32")
+    else:  # tiny_gpt: next-token LM on random ids
+        x = rng.randint(1, n_classes, size=(batch, seq)).astype("int32")
+        y = onp.roll(x, -1, axis=1).astype("int32")
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="mlp", choices=["mlp", "tiny_gpt"])
+    p.add_argument("--batch", type=int, nargs="+", default=[32],
+                   help="batch-size axis (first = untuned default)")
+    p.add_argument("--steps-per-call", type=int, nargs="+", default=[1, 2, 4])
+    p.add_argument("--grad-accum", type=int, nargs="+", default=[1, 2])
+    p.add_argument("--zero", type=int, nargs="+", default=[0, 1, 2])
+    p.add_argument("--remat", nargs="+", default=["off", "dots", "full"],
+                   help="remat axis: off | dots | full")
+    p.add_argument("--seq", type=int, default=16, help="tiny_gpt seq len")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel mesh size for the trials")
+    p.add_argument("--hbm-budget", type=int, default=None,
+                   help="explicit per-device byte budget (default: auto "
+                        "from PJRT memory_stats; None on CPU)")
+    p.add_argument("--trial-seconds", type=float, default=None)
+    p.add_argument("--cache-dir", default=None,
+                   help="winners directory (sets autotune.cache_dir)")
+    p.add_argument("--force", action="store_true",
+                   help="ignore a cached winner; re-run the trials")
+    p.add_argument("--inject-oom-at", type=int, default=0, metavar="N",
+                   help="arm the autotune.trial_oom fault point for the "
+                        "Nth trial (chaos: OOM survival)")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON summary to this file")
+    p.add_argument("--assert", dest="check", action="store_true",
+                   help="enforce the acceptance bars (see module doc)")
+    p.add_argument("--expect-reused", action="store_true",
+                   help="fail unless the winner came from the cache with "
+                        "zero trials (second-run check)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune, config, fault, telemetry
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from jax.sharding import PartitionSpec as P
+    import jax.numpy as jnp
+
+    if args.cache_dir:
+        config.set("autotune.cache_dir", args.cache_dir)
+    telemetry.enable()
+    if args.inject_oom_at:
+        fault.configure(f"autotune.trial_oom:at={args.inject_oom_at},times=1")
+
+    net, feature_shape, n_classes = build_model(args.model, args.seed)
+    sample = make_batch(args.model, feature_shape, n_classes,
+                        args.batch[0], args.seq, args.seed)
+
+    from mxnet_tpu.ops.xent import sparse_softmax_xent
+
+    def loss_fn(out, y):
+        return jnp.mean(sparse_softmax_xent(out, y))
+
+    mesh = make_mesh({"dp": args.dp})
+    specs = (P("dp"), P("dp"))
+    remat_axis = tuple({"off": False, "dots": "dots", "full": True}[r]
+                       for r in args.remat)
+    space = autotune.SearchSpace(
+        batch_size=args.batch, steps_per_call=args.steps_per_call,
+        grad_accum=args.grad_accum, zero=args.zero, remat=remat_axis)
+    print(f"# autotune: model={args.model} grid={len(space)} dp={args.dp} "
+          f"cache={autotune.winners_path()}", file=sys.stderr, flush=True)
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always", telemetry.RecompileWarning)
+        result = autotune.search(
+            net, loss_fn, "adam", mesh, specs, sample, space=space,
+            hbm_budget=(args.hbm_budget if args.hbm_budget is not None
+                        else "auto"),
+            force=args.force, trial_seconds=args.trial_seconds)
+        # post-search production steps: the winner config must run without
+        # tripping the recompile detector (trial compiles were scoped)
+        post_warnings = [w for w in caught
+                         if issubclass(w.category, telemetry.RecompileWarning)]
+
+    summary = result.summary()
+    summary["post_search_recompile_warnings"] = len(post_warnings)
+    line = json.dumps(summary)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line, flush=True)
+
+    failures = []
+    if args.expect_reused:
+        if not result.reused or result.trials:
+            failures.append("expected a cached winner with zero trials")
+    if args.check:
+        if post_warnings:
+            failures.append(
+                f"{len(post_warnings)} RecompileWarning(s) escaped the "
+                "trial scope")
+        if not result.reused:
+            if result.pruned_fraction < 0.5:
+                failures.append(
+                    f"cost model pruned only "
+                    f"{result.pruned_fraction:.0%} of the grid (<50%)")
+            if result.best is None:
+                failures.append("no successful trial")
+            elif (result.default is not None
+                    and result.default.items_per_s is not None
+                    and result.best.items_per_s
+                    < result.default.items_per_s):
+                failures.append("winner slower than the untuned default")
+            if args.inject_oom_at and summary["trials_oom"] < 1:
+                failures.append("injected OOM trial not recorded")
+    for f in failures:
+        print(f"ASSERT FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
