@@ -317,8 +317,17 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
                precision, peak, xla_flops=xla_flops)
     row["steps_per_call"] = k_steps
     row["config"] = _config_dict(bs, k_steps)
+    # every inference row names its peak basis so cross-precision MFU
+    # comparisons in BENCH_rN are self-describing
     if int8:
         row["peak_basis"] = f"int8 ({_int8_factor():g}x bf16)"
+        from mxnet_tpu import config as _cfg
+        row["quant_config"] = {
+            "scheme": "int8_sym_perchannel", "calib_mode": "naive",
+            "activations": "int8", "weights": "int8",
+            "fused_matmul": _cfg.get("quantize.fused_matmul")}
+    else:
+        row["peak_basis"] = "bf16"
     base = BASE_R50_INFER_FP16.get(bs)
     if base and not on_cpu and not int8:
         row["vs_v100_fp16_baseline"] = round(bs / sec / base, 2)
@@ -465,9 +474,10 @@ def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
     """Online decode through mx.serve continuous batching (gpt2-124m
     class on hardware, the CI tiny config on CPU): tokens/s plus the SLO
     latencies (TTFT/TPOT p50/p99) the serving row is judged by.
-    precision='int8' routes weights through the int8 decode path
-    (serve/quantize.py) — the bandwidth-bound regime where weight bytes
-    are the roofline."""
+    precision='int8'/'int4' routes weights through the low-bit decode
+    path (serve/quantize.py) — the bandwidth-bound regime where weight
+    bytes are the roofline; int4 adds the int8 KV cache on top (the
+    bytes-minimal decode config)."""
     import numpy as onp
 
     import mxnet_tpu as mx
@@ -478,6 +488,8 @@ def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
         requests, max_new, slots = 12, 24, 4
     else:  # GPT-2 small decode
         vocab, units, layers, heads, maxlen = 50257, 768, 12, 12, 512
+    quantize = {"int8": "int8_weights",
+                "int4": "int4_weights,int8_kv"}.get(precision)
     net = GPTForCausalLM(vocab_size=vocab, units=units,
                          hidden_size=units * 4, num_layers=layers,
                          num_heads=heads, max_length=maxlen,
@@ -485,8 +497,7 @@ def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
     net.initialize()
     net(mx.np.zeros((1, 2), dtype="int32"))
     eng = mx.serve.load(
-        net, max_slots=slots,
-        quantize="int8_weights" if precision == "int8" else None,
+        net, max_slots=slots, quantize=quantize,
         warmup=True)  # compile outside the timed window
 
     rng = onp.random.RandomState(0)
@@ -509,9 +520,13 @@ def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
            "tpot_p50_ms": (st["tpot"]["p50"] or 0) * 1e3,
            "tpot_p99_ms": (st["tpot"]["p99"] or 0) * 1e3,
            "post_warmup_compiles": st["post_warmup_compiles"]}
-    if precision == "int8":
+    if quantize:
         row["weight_bytes_ratio"] = round(
             st["weight_bytes"] / st["weight_bytes_fp"], 3)
+        row["quant_config"] = {
+            "quantize": st["quantize"], "cache_dtype": st["cache_dtype"],
+            "quantized_params": st["quantized_params"],
+            "passthrough_params": st["passthrough_params"]}
     return row
 
 
@@ -714,6 +729,7 @@ def main(argv=None):
         (bench_gpt_train, dict(precision="bf16", bs=4, seq=2048)),
         (bench_gpt_decode_serve, dict(precision="fp32")),
         (bench_gpt_decode_serve, dict(precision="int8")),
+        (bench_gpt_decode_serve, dict(precision="int4")),
         (bench_augmentation, dict(precision="fp32")),
         (bench_dataloader_workers, dict(precision="fp32")),
     ] + (_tuned_entries(args.config) if args.config else []):

@@ -36,6 +36,12 @@
 #                an injected OOM trial survives, and the second run
 #                reloads the winner by fingerprint with zero trials
 #                (docs/PERFORMANCE.md "Autotuning")
+#   quantize   - low-bit inference suite (default route AND the Pallas
+#                path forced on via MXNET_QUANTIZE_FUSED_MATMUL=on) +
+#                the quantized_inference gates: fused kernel bitwise vs
+#                the XLA fallback, int4 weight bytes <=0.15x fp32, zero
+#                post-warmup recompiles with quantization enabled
+#                (docs/PERFORMANCE.md "Low-bit inference")
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -44,7 +50,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|quantize|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -221,6 +227,16 @@ autotune() {
     rm -rf "$tmp"
 }
 
+quantize() {
+    echo "== quantize: low-bit inference suite (docs/PERFORMANCE.md) =="
+    python -m pytest tests/test_quantization.py -q
+    echo "== quantize: Pallas fused path forced on (interpret parity) =="
+    MXNET_QUANTIZE_FUSED_MATMUL=on python -m pytest \
+        tests/test_quantization.py tests/test_serve.py -q
+    echo "== quantize: inference gates (parity, int4 bytes, 0 recompiles) =="
+    JAX_PLATFORMS=cpu python benchmark/quantized_inference.py --assert
+}
+
 zero() {
     echo "== zero: ZeRO-sharded training suite (docs/PERFORMANCE.md) =="
     python -m pytest tests/test_zero.py -q
@@ -252,6 +268,9 @@ tpu() {
     fi
     python tools/tpu_kernel_check.py
     python bench.py
+    # hardware halves of the low-bit gates: int8 infer beats bf16,
+    # int4-weight decode >=1.3x fp32 tokens/s with greedy parity
+    python benchmark/quantized_inference.py --assert
 }
 
 case "$stage" in
@@ -266,8 +285,9 @@ case "$stage" in
     zero) zero ;;
     serve) serve ;;
     autotune) autotune ;;
+    quantize) quantize ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune; quantize ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -3,6 +3,13 @@
 (reference: example/quantization/imagenet_gen_qsym_onedns.py workflow,
 using mx.contrib.quantization.quantize_net).
 
+The quantized blocks forward through the fused low-bit path
+(`npx.quantized_dense_fused` / `npx.quantized_conv_fused`, routed by
+`quantize.fused_matmul`) — docs/PERFORMANCE.md "Low-bit inference" has
+the cost model, and docs/SERVING.md covers the weight-only
+int8/int4 + int8-KV decode storage this calibration flow feeds
+(`Estimator.quantize` is the same hook on a fitted estimator).
+
     python example/quantize_int8.py [--model resnet18_v1] [--mode entropy]
 """
 from __future__ import annotations

@@ -32,7 +32,7 @@ from .. import config as _config
 from .space import Candidate
 
 __all__ = ["ModelStats", "CostModel", "REMAT_MEM_FRACTION",
-           "REMAT_FLOPS_FACTOR"]
+           "REMAT_FLOPS_FACTOR", "PRECISION_COMPUTE_FACTOR"]
 
 #: fraction of peak live activation bytes kept under each remat policy
 #: (full remat keeps only layer inputs; 'dots' keeps matmul outputs)
@@ -45,6 +45,16 @@ REMAT_FLOPS_FACTOR = {False: 1.0, "dots": 1.15, True: 4.0 / 3.0}
 #: candidates inside a dominance group — never to predict wall time)
 _ZERO_PENALTY = 0.05        # all-gather/reduce-scatter per update
 _ACCUM_PENALTY = 0.02       # scan-carry overhead per extra microbatch
+
+#: relative time-per-flop by precision: MXU peak ratios (bf16 2x fp32,
+#: int8/fp8 2x bf16 on generations that rate them — bench.py
+#: PEAK_INT8_FACTOR carries the per-chip truth; this table only orders
+#: candidates). Weight-only modes move bytes, not flops: the matmuls
+#: still run in the activation dtype, so they rank as bf16-ish.
+PRECISION_COMPUTE_FACTOR = {
+    "fp32": 1.0, "bf16": 0.5, "int8": 0.25, "fp8": 0.25,
+    "int8_weights": 0.5, "int4_weights": 0.5,
+}
 
 
 def _state_slots(optimizer, dtype):
@@ -145,6 +155,8 @@ class CostModel:
         group; the memory knobs only ever add cost."""
         st = self.stats
         f = st.flops_per_item * REMAT_FLOPS_FACTOR.get(c.remat, 1.0)
+        f *= PRECISION_COMPUTE_FACTOR.get(
+            getattr(c, "precision", "fp32"), 1.0)
         if c.zero and st.dp > 1:
             f *= 1.0 + _ZERO_PENALTY
         f *= 1.0 + _ACCUM_PENALTY * (c.grad_accum - 1)
@@ -186,8 +198,12 @@ class CostModel:
             if reason is not None and c != default:
                 pruned.append((c, reason))
                 continue
+            # precision is in the group key: a cheaper format is not a
+            # dominance win over a slower one (different numerics), so
+            # formats are only ever compared by measured trials
             groups.setdefault(
-                (c.batch_size, c.steps_per_call, c.prefetch_depth),
+                (c.batch_size, c.steps_per_call, c.prefetch_depth,
+                 getattr(c, "precision", "fp32")),
                 []).append(c)
         for members in groups.values():
             fitting = [c for c in members if self.fits(c)]

@@ -2,12 +2,16 @@
 
 A ``Candidate`` is one point in the grid the tuner considers:
 
-    {batch_size, steps_per_call, grad_accum, zero, remat, prefetch_depth}
+    {batch_size, steps_per_call, grad_accum, zero, remat, prefetch_depth,
+     precision}
 
-— exactly the knobs ``ShardedTrainStep`` + ``DevicePrefetcher`` accept,
-so every candidate maps 1:1 onto a constructible training step.  Values
-are JSON-native (remat is ``False``/``'dots'``/``True``) so winners
-round-trip through the persisted winners file unchanged.
+— the knobs ``ShardedTrainStep`` + ``DevicePrefetcher`` accept, plus a
+``precision`` axis for inference tuning (the numeric format is a config
+dimension like any other per "A Learned Performance Model for TPUs" —
+see PRECISION_VALUES).  Values are JSON-native (remat is
+``False``/``'dots'``/``True``) so winners round-trip through the
+persisted winners file unchanged; configs persisted before the precision
+axis load as ``precision="fp32"``.
 """
 from __future__ import annotations
 
@@ -16,20 +20,27 @@ import itertools
 from .. import config as _config
 from ..base import MXNetError
 
-__all__ = ["Candidate", "SearchSpace", "REMAT_VALUES"]
+__all__ = ["Candidate", "SearchSpace", "REMAT_VALUES", "PRECISION_VALUES"]
 
 #: remat axis values, cheapest-compute first (order matters for docs only)
 REMAT_VALUES = (False, "dots", True)
+
+#: precision axis values an inference search may enumerate: compute
+#: formats (fp32/bf16/int8/fp8) and the serve weight-storage modes.
+#: Free-form strings are allowed — the trial builder decides what a
+#: value means; these are the ones bench.py / mx.serve understand.
+PRECISION_VALUES = ("fp32", "bf16", "int8", "fp8", "int8_weights",
+                    "int4_weights")
 
 
 class Candidate:
     """One grid point; hashable on its config tuple."""
 
     __slots__ = ("batch_size", "steps_per_call", "grad_accum", "zero",
-                 "remat", "prefetch_depth")
+                 "remat", "prefetch_depth", "precision")
 
     def __init__(self, batch_size, steps_per_call=1, grad_accum=1, zero=0,
-                 remat=False, prefetch_depth=None):
+                 remat=False, prefetch_depth=None, precision="fp32"):
         self.batch_size = int(batch_size)
         self.steps_per_call = int(steps_per_call)
         self.grad_accum = int(grad_accum)
@@ -37,6 +48,7 @@ class Candidate:
         self.remat = remat
         self.prefetch_depth = (None if prefetch_depth is None
                                else int(prefetch_depth))
+        self.precision = str(precision)
 
     def config(self):
         """JSON-safe config dict (the shape persisted in winners.json and
@@ -46,17 +58,20 @@ class Candidate:
                 "grad_accum": self.grad_accum,
                 "zero": self.zero,
                 "remat": self.remat,
-                "prefetch_depth": self.prefetch_depth}
+                "prefetch_depth": self.prefetch_depth,
+                "precision": self.precision}
 
     @classmethod
     def from_config(cls, cfg):
-        return cls(**{k: cfg[k] for k in
+        # .get keeps winners persisted before the precision axis loading
+        return cls(precision=cfg.get("precision", "fp32"),
+                   **{k: cfg[k] for k in
                       ("batch_size", "steps_per_call", "grad_accum", "zero",
                        "remat", "prefetch_depth")})
 
     def key(self):
         return (self.batch_size, self.steps_per_call, self.grad_accum,
-                self.zero, self.remat, self.prefetch_depth)
+                self.zero, self.remat, self.prefetch_depth, self.precision)
 
     def __eq__(self, other):
         return isinstance(other, Candidate) and self.key() == other.key()
@@ -67,7 +82,8 @@ class Candidate:
     def __repr__(self):
         return ("Candidate(bs={batch_size}, spc={steps_per_call}, "
                 "ga={grad_accum}, zero={zero}, remat={remat}, "
-                "prefetch={prefetch_depth})").format(**self.config())
+                "prefetch={prefetch_depth}, prec={precision})"
+                ).format(**self.config())
 
 
 class SearchSpace:
@@ -83,7 +99,7 @@ class SearchSpace:
 
     def __init__(self, batch_size, steps_per_call=(1, 2, 4),
                  grad_accum=(1, 2), zero=(0, 1, 2), remat=REMAT_VALUES,
-                 prefetch_depth=None):
+                 prefetch_depth=None, precision="fp32"):
         def _axis(v):
             return tuple(v) if isinstance(v, (tuple, list)) else (v,)
         self.batch_size = _axis(batch_size)
@@ -94,11 +110,16 @@ class SearchSpace:
         if prefetch_depth is None:
             prefetch_depth = (_config.get("pipeline.prefetch_depth"),)
         self.prefetch_depth = _axis(prefetch_depth)
+        # single-value by default so train searches are unchanged; an
+        # inference search passes e.g. precision=("bf16", "int8")
+        self.precision = _axis(precision)
         if not self.batch_size:
             raise MXNetError("SearchSpace needs at least one batch size")
         for z in self.zero:
             if z not in (0, 1, 2):
                 raise MXNetError(f"zero axis value {z!r} not in (0, 1, 2)")
+        if not self.precision:
+            raise MXNetError("SearchSpace needs at least one precision")
 
     @classmethod
     def default(cls, batch_size):
@@ -112,19 +133,20 @@ class SearchSpace:
         speedup is reported against."""
         return Candidate(self.batch_size[0], steps_per_call=1, grad_accum=1,
                          zero=0, remat=False,
-                         prefetch_depth=self.prefetch_depth[0])
+                         prefetch_depth=self.prefetch_depth[0],
+                         precision=self.precision[0])
 
     def candidates(self):
         """Enumerate the grid (deterministic order; includes the default
         candidate by construction)."""
         out = []
-        for bs, spc, ga, z, rm, pf in itertools.product(
+        for bs, spc, ga, z, rm, pf, pr in itertools.product(
                 self.batch_size, self.steps_per_call, self.grad_accum,
-                self.zero, self.remat, self.prefetch_depth):
-            out.append(Candidate(bs, spc, ga, z, rm, pf))
+                self.zero, self.remat, self.prefetch_depth, self.precision):
+            out.append(Candidate(bs, spc, ga, z, rm, pf, pr))
         return out
 
     def __len__(self):
         return (len(self.batch_size) * len(self.steps_per_call)
                 * len(self.grad_accum) * len(self.zero) * len(self.remat)
-                * len(self.prefetch_depth))
+                * len(self.prefetch_depth) * len(self.precision))

@@ -276,6 +276,23 @@ declare("autotune.launch_overhead_items", float, 8.0,
         "Cost-model constant: per-launch dispatch overhead expressed in "
         "item-equivalents, amortized over batch*steps_per_call when "
         "ranking candidates (tunneled-TPU dispatch is ~1-7ms/launch).")
+declare("quantize.fused_matmul", str, "auto", "MXNET_QUANTIZE_FUSED_MATMUL",
+        "Pallas fused quantize+int8-dot+dequant matmul for calibrated "
+        "QuantizedDense layers: 'auto' (TPU only), 'on' (everywhere, "
+        "interpret-mode off-TPU), 'off' (XLA dot_general fallback).")
+declare("quantize.fp8_format", str, "e4m3", "MXNET_QUANTIZE_FP8_FORMAT",
+        "fp8 activation/weight format for the fp8 matmul variant: 'e4m3' "
+        "(more mantissa, inference default) or 'e5m2' (more range).")
+declare("serve.quantize_min_elems", int, 4096, "MXNET_SERVE_QUANTIZE_MIN_ELEMS",
+        "Smallest parameter (elements) serve weight quantization touches; "
+        "below it the bytes saved don't cover the dequant epilogue.")
+declare("serve.quantize_ndim", int, 2, "MXNET_SERVE_QUANTIZE_NDIM",
+        "Parameter rank serve weight quantization targets (2 = matmul "
+        "weights; biases/norms always pass through in fp).")
+declare("serve.quantize_group_size", int, 128,
+        "MXNET_SERVE_QUANTIZE_GROUP_SIZE",
+        "Input-axis group size for int4 group-wise weight scales; rows "
+        "whose width is not divisible fall back to one scale per row.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

@@ -155,9 +155,23 @@ def _quantize_weight(w: onp.ndarray):
     return q, scale.astype(onp.float32)
 
 
+def _fusable_act(act):
+    """The layer's activation type when the fused epilogue can absorb it
+    (see ops.quantization.FUSED_ACTS), else None — the Activation block
+    then runs as a separate op after the fused matmul/conv."""
+    from ..ops.quantization import FUSED_ACTS
+    t = getattr(act, "_act_type", None)
+    return t if t in FUSED_ACTS else None
+
+
 class QuantizedDense(HybridBlock):
     """int8 replacement for nn.Dense (reference:
-    quantized_fully_connected.cc as rewritten by quantize_net)."""
+    quantized_fully_connected.cc as rewritten by quantize_net).
+
+    Forward is ONE fused op (npx.quantized_dense_fused): activation
+    quantize, int8 MXU dot, dequant + bias + activation epilogue — the
+    separate quantize_v2/quantized_fully_connected pair this replaced
+    paid an HBM round-trip per layer (BENCH_r05)."""
 
     def __init__(self, dense: nn.Dense, threshold: float):
         super().__init__()
@@ -171,15 +185,17 @@ class QuantizedDense(HybridBlock):
         self._units = dense._units
         self._flatten = dense._flatten
         self.act = dense.act
+        self._fused_act = _fusable_act(dense.act)
 
     def forward(self, x):
-        xq, mn, mx = npx.quantize_v2(x, -self.threshold, self.threshold)
-        out = npx.quantized_fully_connected(
-            xq, self.qweight.data(), self.threshold / _INT8_MAX,
+        out = npx.quantized_dense_fused(
+            x, self.qweight.data(), self.threshold / _INT8_MAX,
             self.w_scale.data(),
             bias=self.bias_c.data() if self.bias_c is not None else None,
-            flatten=self._flatten)
-        return self.act(out) if self.act is not None else out
+            act=self._fused_act, flatten=self._flatten)
+        if self.act is not None and self._fused_act is None:
+            out = self.act(out)
+        return out
 
     def __repr__(self):
         return f"QuantizedDense({self._units}, T={self.threshold:.4g})"
@@ -204,15 +220,17 @@ class QuantizedConv(HybridBlock):
                               num_filter=conv._channels,
                               num_group=conv._groups, layout=conv._layout)
         self.act = conv.act
+        self._fused_act = _fusable_act(conv.act)
 
     def forward(self, x):
-        xq, mn, mx = npx.quantize_v2(x, -self.threshold, self.threshold)
-        out = npx.quantized_conv(
-            xq, self.qweight.data(), self.threshold / _INT8_MAX,
+        out = npx.quantized_conv_fused(
+            x, self.qweight.data(), self.threshold / _INT8_MAX,
             self.w_scale.data(),
             bias=self.bias_c.data() if self.bias_c is not None else None,
-            **self._conv_cfg)
-        return self.act(out) if self.act is not None else out
+            act=self._fused_act, **self._conv_cfg)
+        if self.act is not None and self._fused_act is None:
+            out = self.act(out)
+        return out
 
     def __repr__(self):
         cfg = self._conv_cfg

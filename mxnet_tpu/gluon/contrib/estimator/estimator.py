@@ -88,6 +88,25 @@ class Estimator:
         _dispatch("train_end")
         return self
 
+    def quantize(self, calib_data, calib_mode="entropy",
+                 num_calib_batches=None, exclude_layers=None,
+                 exclude_layers_match=None, logger=None):
+        """Post-training calibration hook: calibrate the fitted net's
+        activation ranges over ``calib_data`` (typically a slice of the
+        validation loader) with the contrib.quantization observers
+        ('naive' abs-max, 'entropy' KL, 'percentile') and return a new
+        int8 network with Dense/Conv replaced by the fused quantized
+        blocks. The original ``self.net`` is untouched; the result is
+        also kept on ``self.quantized_net`` — the train -> calibrate ->
+        serve pipeline of docs/PERFORMANCE.md "Low-bit inference"."""
+        from ....contrib.quantization import quantize_net
+        self.quantized_net = quantize_net(
+            self.net, calib_data=calib_data, calib_mode=calib_mode,
+            num_calib_batches=num_calib_batches,
+            exclude_layers=exclude_layers,
+            exclude_layers_match=exclude_layers_match, logger=logger)
+        return self.quantized_net
+
     def evaluate(self, val_data, val_metrics=None, batch_axis=0):
         metrics = val_metrics or self.val_metrics or self.train_metrics
         for m in metrics:

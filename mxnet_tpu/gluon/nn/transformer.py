@@ -60,30 +60,58 @@ class MultiHeadAttention(HybridBlock):
 
     def init_cache(self, max_slots, max_seq, dtype="float32"):
         """Preallocate one (k, v) cache pair:
-        (max_slots, max_seq, heads, head_dim) each."""
+        (max_slots, max_seq, heads, head_dim) each.
+
+        ``dtype="int8"`` selects quantized storage: each of k/v becomes a
+        (values int8, scales float32) pair with one symmetric scale per
+        (slot, row, head) — same fixed footprint at a quarter of the
+        fp32 bytes (docs/SERVING.md "Low-bit weights and KV cache")."""
         d = self._units // self._heads
         shape = (max_slots, max_seq, self._heads, d)
+        if str(dtype) == "int8":
+            sshape = (max_slots, max_seq, self._heads, 1)
+            return ((np.zeros(shape, dtype="int8"),
+                     np.ones(sshape, dtype="float32")),
+                    (np.zeros(shape, dtype="int8"),
+                     np.ones(sshape, dtype="float32")))
         return (np.zeros(shape, dtype=dtype), np.zeros(shape, dtype=dtype))
+
+    @staticmethod
+    def _cache_is_q8(kv):
+        return isinstance(kv[0], (tuple, list))
 
     def prefill(self, x, kv, slot):
         """Full causal self-attention over one prompt (1, L, units),
         recording projected K/V into cache slot ``slot``."""
-        from ...ops.attention import multi_head_attention, write_prefill_kv
+        from ...ops.attention import (multi_head_attention,
+                                      write_prefill_kv, write_prefill_kv_q8)
         q = self.query_proj(x)
         k = self.key_proj(x)
         v = self.value_proj(x)
-        k_cache, v_cache = write_prefill_kv(kv[0], kv[1], k, v, slot,
-                                            self._heads)
+        if self._cache_is_q8(kv):
+            (kc, ks), (vc, vs) = kv
+            kc, ks, vc, vs = write_prefill_kv_q8(kc, ks, vc, vs, k, v,
+                                                 slot, self._heads)
+            new_kv = ((kc, ks), (vc, vs))
+        else:
+            k_cache, v_cache = write_prefill_kv(kv[0], kv[1], k, v, slot,
+                                                self._heads)
+            new_kv = (k_cache, v_cache)
         out = multi_head_attention(q, k, v, self._heads, causal=True)
-        return self.out_proj(out), (k_cache, v_cache)
+        return self.out_proj(out), new_kv
 
     def decode_step(self, x, kv, positions):
         """One cached decode step: x is (slots, 1, units), ``positions``
         (slots,) the cache row each slot's token occupies."""
-        from ...ops.attention import decode_attention
+        from ...ops.attention import decode_attention, decode_attention_q8
         q = self.query_proj(x)
         k = self.key_proj(x)
         v = self.value_proj(x)
+        if self._cache_is_q8(kv):
+            (kc, ks), (vc, vs) = kv
+            out, kc, ks, vc, vs = decode_attention_q8(
+                q, k, v, kc, ks, vc, vs, positions, self._heads)
+            return self.out_proj(out), ((kc, ks), (vc, vs))
         out, k_cache, v_cache = decode_attention(
             q, k, v, kv[0], kv[1], positions, self._heads)
         return self.out_proj(out), (k_cache, v_cache)

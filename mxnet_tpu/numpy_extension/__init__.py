@@ -1198,7 +1198,8 @@ def amp_multicast(*data, num_outputs=None):
 
 
 from ..ops.quantization import (  # noqa: E402
-    quantize_v2, dequantize, quantized_fully_connected, quantized_conv)
+    quantize_v2, dequantize, quantized_fully_connected, quantized_conv,
+    quantized_dense_fused, quantized_conv_fused, fp8_dense_fused)
 from ..ops.bbox import (  # noqa: E402
     box_iou, box_nms, box_encode, box_decode, bipartite_matching)
 from ..ops.multibox import (  # noqa: E402

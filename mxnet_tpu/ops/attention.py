@@ -95,6 +95,76 @@ def write_prefill_kv(k_cache, v_cache, key, value, slot, heads):
                    name="write_prefill_kv")
 
 
+def _quantize_kv_rows(x, int8_max=127.0):
+    """Symmetric int8 over the last (head_dim) axis: one scale per
+    (slot, row, head) — each written row computes its own scale, so the
+    fixed-footprint cache never needs requantization."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / int8_max
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / scale), -int8_max, int8_max)
+    return q.astype(jnp.int8), scale
+
+
+def write_prefill_kv_q8(k_cache, k_scale, v_cache, v_scale, key, value,
+                        slot, heads):
+    """int8-cache variant of :func:`write_prefill_kv`: quantizes the
+    prompt's projected K/V per (row, head) and writes values + scales.
+    Caches are (max_slots, max_seq, heads, dim) int8; scales
+    (max_slots, max_seq, heads, 1) float32."""
+    def fn(kc, ks, vc, vs, k, v, s):
+        _, seq_len, hd = k.shape
+        d = hd // heads
+        kq, ksc = _quantize_kv_rows(k.reshape(1, seq_len, heads, d))
+        vq, vsc = _quantize_kv_rows(v.reshape(1, seq_len, heads, d))
+        start = (s.astype(jnp.int32) if hasattr(s, "astype") else
+                 jnp.int32(s), 0, 0, 0)
+        return (jax.lax.dynamic_update_slice(kc, kq, start),
+                jax.lax.dynamic_update_slice(ks, ksc, start),
+                jax.lax.dynamic_update_slice(vc, vq, start),
+                jax.lax.dynamic_update_slice(vs, vsc, start))
+
+    return _invoke(fn, (k_cache, k_scale, v_cache, v_scale, key, value,
+                        slot), name="write_prefill_kv_q8")
+
+
+def decode_attention_q8(query, key, value, k_cache, k_scale, v_cache,
+                        v_scale, positions, heads):
+    """int8-cache variant of :func:`decode_attention`: the cache crosses
+    HBM as int8 + per-(slot, row, head) scales and the dequant
+    (``astype * scale``) fuses into the score/value einsums, so decode —
+    memory-bound on the cache at long contexts — moves a quarter of the
+    fp32 bytes. The current token's K/V is quantized with its own row
+    scale before the write; attention math itself stays in the query
+    dtype with an f32 softmax, exactly like the fp path."""
+    def fn(q, k, v, kc, ks, vc, vs, pos):
+        n, _, hd = q.shape
+        d = hd // heads
+        max_seq = kc.shape[1]
+        row = jnp.clip(pos.astype(jnp.int32), 0, max_seq - 1)
+        lane = jnp.arange(n)
+        kq, ksc = _quantize_kv_rows(k.reshape(n, heads, d))
+        vq, vsc = _quantize_kv_rows(v.reshape(n, heads, d))
+        kc = kc.at[lane, row].set(kq)
+        ks = ks.at[lane, row].set(ksc)
+        vc = vc.at[lane, row].set(vq)
+        vs = vs.at[lane, row].set(vsc)
+        qh = q.reshape(n, heads, d)
+        scale = 1.0 / (d ** 0.5)
+        kf = kc.astype(q.dtype) * ks.astype(q.dtype)
+        scores = jnp.einsum("nhd,nshd->nhs", qh, kf) * scale
+        visible = (jnp.arange(max_seq)[None, :] <= row[:, None])[:, None, :]
+        scores = jnp.where(visible, scores, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        vf = vc.astype(q.dtype) * vs.astype(q.dtype)
+        out = jnp.einsum("nhs,nshd->nhd", att, vf)
+        return out.reshape(n, 1, hd), kc, ks, vc, vs
+
+    return _invoke(fn, (query, key, value, k_cache, k_scale, v_cache,
+                        v_scale, positions), name="decode_attention_q8")
+
+
 def decode_attention(query, key, value, k_cache, v_cache, positions, heads):
     """Single-token cached attention for continuous-batching decode.
 

@@ -21,12 +21,49 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from .. import config as _config
 from ..numpy.multiarray import _invoke
 
 __all__ = ["quantize_v2", "dequantize", "quantized_fully_connected",
-           "quantized_conv"]
+           "quantized_conv", "quantized_dense_fused", "quantized_conv_fused",
+           "fp8_dense_fused"]
 
 _INT8_MAX = 127.0
+
+#: fused-epilogue activations jnp can express inside one traced op (the
+#: Pallas kernel supports the same set — see ops/pallas/quant_matmul.py)
+FUSED_ACTS = (None, "relu", "sigmoid", "tanh", "gelu")
+
+
+def _apply_act(out, act):
+    import jax
+    if act is None:
+        return out
+    if act == "relu":
+        return jnp.maximum(out, 0.0)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(out)
+    if act == "tanh":
+        return jnp.tanh(out)
+    if act == "gelu":
+        return jax.nn.gelu(out)
+    raise ValueError(f"activation {act!r} cannot be fused; "
+                     f"supported: {FUSED_ACTS}")
+
+
+def _route_fused():
+    """(use_pallas, interpret) per the ``quantize.fused_matmul`` knob:
+    'auto' = Pallas on TPU only, 'on' = Pallas everywhere (interpret
+    off-TPU — the CI parity oracle), 'off' = the XLA dot_general chain."""
+    mode = str(_config.get("quantize.fused_matmul")).lower()
+    if mode == "off":
+        return False, False
+    import jax
+    devs = jax.devices()
+    on_tpu = bool(devs) and devs[0].platform in ("tpu", "axon")
+    if mode == "on":
+        return True, not on_tpu
+    return on_tpu, False
 
 
 def _scale_from_range(min_range, max_range):
@@ -131,3 +168,144 @@ def quantized_conv(data, weight, x_scale, w_scale, bias=None, kernel=None,
     if bias is not None:
         args += (bias,)
     return _invoke(fn, args, name="quantized_conv")
+
+
+def quantized_dense_fused(data, weight, x_scale, w_scale, bias=None,
+                          act=None, flatten=True):
+    """Fused quantize -> int8 x int8 dot -> dequant+bias+act dense layer.
+
+    One traced op end to end: the separate quantize_v2 /
+    quantized_fully_connected pair costs an HBM round-trip for the int8
+    activations between the two ops (BENCH_r05: int8 resnet50 *slower*
+    than bf16).  Routing per ``quantize.fused_matmul``: the Pallas kernel
+    (ops/pallas/quant_matmul.py) on TPU / when forced 'on' (interpret
+    mode off-TPU), else the same ``lax.dot_general(preferred=int32)``
+    expression as :func:`quantized_fully_connected` inside one jit so XLA
+    fuses the chain.  ``weight`` is pre-quantized int8 (units, in_units),
+    ``w_scale`` per-output-channel, ``x_scale`` the calibrated
+    threshold / 127.
+    """
+    if act not in FUSED_ACTS:
+        raise ValueError(f"activation {act!r} cannot be fused; "
+                         f"supported: {FUSED_ACTS}")
+    use_pallas, interpret = _route_fused()
+
+    def fn(x, w, xs, ws, *rest):
+        b = rest[0] if rest else None
+        h = x.reshape(x.shape[0], -1) if flatten else x
+        lead = h.shape[:-1]
+        h2 = h.reshape(-1, h.shape[-1])
+        if use_pallas:
+            from .pallas.quant_matmul import quantized_matmul
+            out = quantized_matmul(h2, w, ws, xs, bias=b, act=act,
+                                   interpret=interpret)
+        else:
+            xs32 = jnp.asarray(xs, jnp.float32)
+            xq = jnp.clip(jnp.round(h2 / xs32), -_INT8_MAX, _INT8_MAX
+                          ).astype(jnp.int8)
+            acc = lax.dot_general(xq, w, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (xs32 * ws)
+            if b is not None:
+                out = out + b
+            out = _apply_act(out, act)
+        return out.reshape(lead + (w.shape[0],))
+
+    args = (data, weight, x_scale, w_scale)
+    if bias is not None:
+        args += (bias,)
+    return _invoke(fn, args, name="quantized_dense_fused")
+
+
+def fp8_dense_fused(data, weight, x_scale, w_scale, bias=None, act=None,
+                    flatten=True, fmt=None):
+    """fp8-activation variant of :func:`quantized_dense_fused`.
+
+    ``weight`` is pre-cast to the fp8 format (per-output-channel scaled),
+    accumulation is fp32.  Gated on device capability by the caller via
+    :func:`mxnet_tpu.ops.pallas.quant_matmul.fp8_capable`; the fallback
+    (fp8 operands into ``lax.dot_general`` with fp32 preferred type)
+    runs anywhere XLA supports the dtype, including CPU.
+    """
+    if act not in FUSED_ACTS:
+        raise ValueError(f"activation {act!r} cannot be fused; "
+                         f"supported: {FUSED_ACTS}")
+    fmt = fmt or _config.get("quantize.fp8_format")
+    use_pallas, interpret = _route_fused()
+
+    def fn(x, w, xs, ws, *rest):
+        from .pallas.quant_matmul import FP8_FORMATS, fp8_matmul
+        if fmt not in FP8_FORMATS:
+            raise ValueError(f"unknown fp8 format {fmt!r}")
+        b = rest[0] if rest else None
+        h = x.reshape(x.shape[0], -1) if flatten else x
+        lead = h.shape[:-1]
+        h2 = h.reshape(-1, h.shape[-1])
+        if use_pallas:
+            out = fp8_matmul(h2, w, ws, xs, bias=b, act=act, fmt=fmt,
+                             interpret=interpret)
+        else:
+            xs32 = jnp.asarray(xs, jnp.float32)
+            xq = (h2.astype(jnp.float32) / xs32).astype(FP8_FORMATS[fmt][0])
+            acc = lax.dot_general(xq, w, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            out = acc * (xs32 * ws)
+            if b is not None:
+                out = out + b
+            out = _apply_act(out, act)
+        return out.reshape(lead + (w.shape[0],))
+
+    args = (data, weight, x_scale, w_scale)
+    if bias is not None:
+        args += (bias,)
+    return _invoke(fn, args, name="fp8_dense_fused")
+
+
+def quantized_conv_fused(data, weight, x_scale, w_scale, bias=None,
+                         act=None, kernel=None, stride=None, dilate=None,
+                         pad=None, num_filter=1, num_group=1, layout="NCHW"):
+    """Fused quantize -> int8 conv -> dequant+bias+act convolution.
+
+    Same contract as :func:`quantized_conv` but the activation quantize
+    and the epilogue live inside ONE traced op, so XLA keeps the int8
+    activations in registers/VMEM instead of round-tripping them through
+    HBM between quantize_v2 and the conv (there is no Pallas conv kernel;
+    on TPU XLA's own int8 ``conv_general_dilated`` hits the MXU).
+    """
+    if act not in FUSED_ACTS:
+        raise ValueError(f"activation {act!r} cannot be fused; "
+                         f"supported: {FUSED_ACTS}")
+    nd = data.ndim - 2
+    spatial = "DHW"[3 - nd:]
+    lhs_spec = layout
+    rhs_spec = "OI" + spatial
+    out_spec = layout
+    strides = tuple(stride or (1,) * nd)
+    dilation = tuple(dilate or (1,) * nd)
+    padding = tuple((p, p) for p in (pad or (0,) * nd))
+    c_axis = layout.index("C")
+
+    def fn(x, w, xs, ws, *rest):
+        b = rest[0] if rest else None
+        xs32 = jnp.asarray(xs, jnp.float32)
+        xq = jnp.clip(jnp.round(x / xs32), -_INT8_MAX, _INT8_MAX
+                      ).astype(jnp.int8)
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (lhs_spec, rhs_spec, out_spec))
+        acc = lax.conv_general_dilated(
+            xq, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=num_group,
+            preferred_element_type=jnp.int32)
+        shape = [1] * acc.ndim
+        shape[c_axis] = -1
+        sc = xs32 * jnp.reshape(ws, shape)
+        out = acc.astype(jnp.float32) * sc
+        if b is not None:
+            out = out + jnp.reshape(b, shape)
+        return _apply_act(out, act)
+
+    args = (data, weight, x_scale, w_scale)
+    if bias is not None:
+        args += (bias,)
+    return _invoke(fn, args, name="quantized_conv_fused")

@@ -12,8 +12,10 @@ the host asynchronously through a bounded deferred window.
     eng.run()
     req.output_ids, req.ttft, eng.stats()
 """
-from .engine import Request, ServeEngine, load
-from .quantize import dequantize_params, quantize_params_int8
+from .engine import QUANTIZE_MODES, Request, ServeEngine, load
+from .quantize import (dequantize_params, quantize_params_int4,
+                       quantize_params_int8)
 
-__all__ = ["Request", "ServeEngine", "load", "quantize_params_int8",
+__all__ = ["Request", "ServeEngine", "load", "QUANTIZE_MODES",
+           "quantize_params_int8", "quantize_params_int4",
            "dequantize_params"]

@@ -87,6 +87,35 @@ _telemetry.declare_metric(
     "serve.post_warmup_compiles_total", "counter",
     "XLA compiles after warmup() — should stay 0; any hit means a "
     "request shape escaped the bucket grid")
+_telemetry.declare_metric(
+    "serve.quantized_params", "gauge",
+    "parameters stored low-bit by the engine's weight quantization "
+    "(serve.quantize_min_elems / serve.quantize_ndim govern eligibility)")
+_telemetry.declare_metric(
+    "serve.passthrough_params", "gauge",
+    "parameters kept in float by the engine's weight quantization "
+    "(ineligible rank/size, or quantization off)")
+
+#: weight-storage modes ServeEngine(quantize=...) understands; combine
+#: with "," (e.g. "int4_weights,int8_kv")
+QUANTIZE_MODES = ("int8_weights", "int4_weights", "int8_kv")
+
+
+def _parse_quantize(quantize):
+    """-> (normalized spec or None, weight mode or None, kv_int8 flag)."""
+    if not quantize:
+        return None, None, False
+    modes = [m.strip() for m in str(quantize).split(",") if m.strip()]
+    unknown = [m for m in modes if m not in QUANTIZE_MODES]
+    if unknown or not modes:
+        raise MXNetError(
+            f"unknown quantize mode {quantize!r}; modes: "
+            f"{', '.join(QUANTIZE_MODES)} (comma-combinable)")
+    weight = [m for m in modes if m.endswith("_weights")]
+    if len(weight) > 1:
+        raise MXNetError(f"conflicting weight modes in {quantize!r}")
+    return ",".join(dict.fromkeys(modes)), \
+        (weight[0] if weight else None), "int8_kv" in modes
 
 
 class Request:
@@ -189,9 +218,12 @@ class ServeEngine:
         reqs[0].output_ids, reqs[0].ttft, eng.stats()
 
     ``temperature=0`` is greedy; >0 samples from softmax(logits/T).
-    ``quantize="int8_weights"`` stores large 2-D weights as int8 +
-    per-channel scales (serve/quantize.py) — dequant is fused into the
-    consuming matmuls, HBM reads stay int8.
+    ``quantize`` picks low-bit storage (serve/quantize.py, comma-
+    combinable): ``"int8_weights"`` = per-channel int8 weights,
+    ``"int4_weights"`` = group-wise int4 packed two nibbles per byte,
+    ``"int8_kv"`` = int8 KV cache with per-(slot, row, head) scales.
+    Dequant always fuses into the consuming matmuls, so HBM reads stay
+    low-bit.
     """
 
     def __init__(self, model, max_slots=None, max_seq=None, buckets=None,
@@ -218,19 +250,25 @@ class ServeEngine:
         self.temperature = float(temperature)
         self._ensure_initialized()
         params = _functional.param_arrays(model)
-        if quantize not in (None, "", "int8_weights"):
-            raise MXNetError(f"unknown quantize mode {quantize!r}")
-        self.quantize = quantize or None
-        if self.quantize:
+        self.quantize, weight_mode, kv_int8 = _parse_quantize(quantize)
+        if kv_int8:
+            cache_dtype = "int8"
+        if weight_mode == "int8_weights":
             pt, qt, qdt = _quantize.quantize_params_int8(params)
+        elif weight_mode == "int4_weights":
+            pt, qt, qdt = _quantize.quantize_params_int4(params)
         else:
             pt, qt, qdt = params, {}, {}
         self._params = (pt, qt)
         self._qdtypes = qdt
+        if _telemetry._active and weight_mode:
+            _telemetry.set_gauge("serve.quantized_params", len(qt))
+            _telemetry.set_gauge("serve.passthrough_params", len(pt))
         buckets = _parse_buckets(buckets if buckets is not None
                                  else _config.get("serve.buckets"))
         self.buckets = [b for b in buckets if b <= self.max_seq] \
             or [self.max_seq]
+        self.cache_dtype = cache_dtype
         cache = model.init_cache(self.max_slots, self.max_seq,
                                  dtype=cache_dtype)
         self._cache = jax.tree_util.tree_map(
@@ -554,6 +592,7 @@ class ServeEngine:
             "max_seq": self.max_seq,
             "buckets": list(self.buckets),
             "quantize": self.quantize,
+            "cache_dtype": self.cache_dtype,
         }
         for name, vals in (("ttft", ttfts), ("tpot", tpots)):
             out[name] = {"p50": pct(vals, 50), "p95": pct(vals, 95),
@@ -563,15 +602,18 @@ class ServeEngine:
             now, was = _quantize.quantized_bytes(pt, qt, self._qdtypes)
             out["weight_bytes"] = now
             out["weight_bytes_fp"] = was
+            out["quantized_params"] = len(qt)
+            out["passthrough_params"] = len(pt)
         return out
 
 
 def load(model, max_slots=None, quantize=None, warmup=False, **kwargs):
     """Build a :class:`ServeEngine` over ``model``.
 
-    ``quantize="int8_weights"`` enables the weight-only int8 decode path
-    (docs/SERVING.md); ``warmup=True`` compiles the full bucket grid
-    before returning so the first request never pays a compile.
+    ``quantize`` enables low-bit decode storage — "int8_weights",
+    "int4_weights", "int8_kv", comma-combinable (docs/SERVING.md);
+    ``warmup=True`` compiles the full bucket grid before returning so
+    the first request never pays a compile.
     """
     eng = ServeEngine(model, max_slots=max_slots, quantize=quantize,
                       **kwargs)

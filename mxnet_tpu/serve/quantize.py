@@ -1,16 +1,28 @@
-"""Weight-only int8 for the decode path.
+"""Weight-only low-bit storage for the decode path.
 
 Decode is memory-bandwidth-bound: every step streams the full weight set
-from HBM to produce one token per slot, so halving (fp32) or quartering
-the weight bytes is a straight bandwidth win with no activation
-quantization risk. Scheme: symmetric per-output-channel int8 (zero-point
-0, the ops/quantization.py scheme) over 2-D float parameters; everything
-else (biases, LayerNorm vectors) stays in float.
+from HBM to produce one token per slot, so halving (fp32 -> int8) or
+cutting to ~an eighth (fp32 -> int4) the weight bytes is a straight
+bandwidth win with no activation quantization risk.
 
-The dequant is emitted at the top of the jitted serve step
-(``w_q.astype(dtype) * scale``) so XLA fuses the widen-and-scale into
-the consuming matmul — weights cross HBM as int8, the MXU/VPU sees the
-usual float operand, and ``lax.dot_general`` keeps its
+Schemes:
+
+- **int8**: symmetric per-output-channel (zero-point 0, the
+  ops/quantization.py scheme) over eligible float parameters.
+- **int4**: symmetric group-wise along the input axis
+  (``serve.quantize_group_size`` columns per scale; rows whose width is
+  not divisible fall back to one scale per row), packed two nibbles per
+  byte. Bytes per fp32 element: 1/8 for the nibbles + 4/group for the
+  scales — ~0.133x at the default group of 128.
+
+Eligibility is governed by the ``serve.quantize_min_elems`` /
+``serve.quantize_ndim`` config knobs; everything else (biases, LayerNorm
+vectors, tiny heads) stays in float.
+
+The dequant is emitted at the top of the jitted serve step (unpack +
+``astype(dtype) * scale``) so XLA fuses the widen-and-scale into the
+consuming matmul — weights cross HBM as int8/packed-int4, the MXU/VPU
+sees the usual float operand, and ``lax.dot_general`` keeps its
 ``preferred_element_type`` accumulation. No calibration pass is needed:
 scales come from the weights themselves.
 """
@@ -18,62 +30,136 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-_INT8_MAX = 127.0
+from .. import config as _config
 
-#: 2-D float params smaller than this (elements) stay unquantized — the
-#: bandwidth win is negligible and tiny layers are accuracy-sensitive.
+_INT8_MAX = 127.0
+_INT4_MAX = 7.0
+
+#: historical default for the eligibility floor; the live value is the
+#: ``serve.quantize_min_elems`` config knob.
 MIN_ELEMENTS = 4096
 
 
-def eligible(name, arr, min_elements=MIN_ELEMENTS):
-    """Quantize only 2-D float matmul operands of meaningful size."""
-    return (getattr(arr, "ndim", 0) == 2
+def _min_elements(v=None):
+    return int(_config.get("serve.quantize_min_elems") if v is None else v)
+
+
+def _ndim(v=None):
+    return int(_config.get("serve.quantize_ndim") if v is None else v)
+
+
+def _group_size(v=None):
+    return int(_config.get("serve.quantize_group_size") if v is None else v)
+
+
+def eligible(name, arr, min_elements=None, ndim=None):
+    """Quantize only float matmul operands of meaningful size (rank and
+    floor from the serve.quantize_* knobs unless overridden)."""
+    return (getattr(arr, "ndim", 0) == _ndim(ndim)
             and jnp.issubdtype(arr.dtype, jnp.floating)
-            and arr.size >= min_elements)
+            and arr.size >= _min_elements(min_elements))
 
 
-def quantize_params_int8(params, min_elements=MIN_ELEMENTS):
-    """Split a name->array dict into (passthrough, quantized, dtypes).
+def quantize_params_int8(params, min_elements=None, ndim=None):
+    """Split a name->array dict into (passthrough, quantized, meta).
 
     quantized maps name -> (int8 weights, per-row float32 scales);
-    dtypes maps the same names to the original dtype string (kept out of
+    meta maps the same names to the original dtype string (kept out of
     the array pytree so jit/AOT lowering sees arrays only). Rows are
     output channels for every 2-D weight this framework stores: Dense
     keeps (units, in_units), Embedding (vocab, units) — the tied LM head
     consumes it transposed, which turns row scales into
     per-output-channel scales there too.
     """
-    passthrough, quantized, dtypes = {}, {}, {}
+    passthrough, quantized, meta = {}, {}, {}
     for name, arr in params.items():
-        if not eligible(name, arr, min_elements):
+        if not eligible(name, arr, min_elements, ndim):
             passthrough[name] = arr
             continue
         a = jnp.asarray(arr)
-        scale = jnp.max(jnp.abs(a), axis=1, keepdims=True) / _INT8_MAX
+        # per-row for the 2-D default; last-axis generalizes to whatever
+        # rank serve.quantize_ndim admits (1-D -> one scale)
+        scale = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / _INT8_MAX
         scale = jnp.where(scale == 0, 1.0, scale).astype(jnp.float32)
         q = jnp.clip(jnp.round(a / scale), -_INT8_MAX, _INT8_MAX)
         quantized[name] = (q.astype(jnp.int8), scale)
-        dtypes[name] = str(a.dtype)
-    return passthrough, quantized, dtypes
+        meta[name] = str(a.dtype)
+    return passthrough, quantized, meta
 
 
-def dequantize_params(passthrough, quantized, dtypes):
-    """Rebuild the full float param dict inside a trace. The astype +
-    multiply stays adjacent to each consumer, so XLA fuses it and the
-    HBM reads stay int8."""
+def quantize_params_int4(params, min_elements=None, ndim=None,
+                         group_size=None):
+    """int4 variant: group-wise symmetric scales along the input axis,
+    nibbles packed two per byte (even column = low nibble).
+
+    quantized maps name -> (packed uint8 (rows, cols//2),
+    float32 scales (rows, cols//group)); meta entries are dicts
+    ``{"mode": "int4", "dtype", "cols", "group"}`` so
+    :func:`dequantize_params` can tell them from legacy int8 strings.
+    Odd-width weights pass through (no half byte to park the last
+    nibble in).
+    """
+    g0 = _group_size(group_size)
+    passthrough, quantized, meta = {}, {}, {}
+    for name, arr in params.items():
+        if not eligible(name, arr, min_elements, ndim) \
+                or getattr(arr, "ndim", 0) != 2 or arr.shape[-1] % 2:
+            passthrough[name] = arr
+            continue
+        a = jnp.asarray(arr)
+        rows, cols = a.shape
+        g = g0 if g0 > 0 and cols % g0 == 0 else cols
+        grouped = a.reshape(rows, cols // g, g)
+        scale = jnp.max(jnp.abs(grouped), axis=2) / _INT4_MAX
+        scale = jnp.where(scale == 0, 1.0, scale).astype(jnp.float32)
+        q = jnp.clip(jnp.round(grouped / scale[:, :, None]),
+                     -_INT4_MAX, _INT4_MAX)
+        q = q.astype(jnp.int8).reshape(rows, cols)
+        lo = q[:, 0::2].astype(jnp.uint8) & 0xF
+        hi = q[:, 1::2].astype(jnp.uint8) & 0xF
+        quantized[name] = (lo | (hi << 4), scale)
+        meta[name] = {"mode": "int4", "dtype": str(a.dtype),
+                      "cols": int(cols), "group": int(g)}
+    return passthrough, quantized, meta
+
+
+def _unpack_int4(packed, cols):
+    """(rows, cols//2) uint8 -> (rows, cols) int8 in [-7, 7]."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=-1).reshape(packed.shape[0], cols)
+
+
+def dequantize_params(passthrough, quantized, meta):
+    """Rebuild the full float param dict inside a trace. The unpack +
+    astype + multiply stays adjacent to each consumer, so XLA fuses it
+    and the HBM reads stay low-bit."""
     out = dict(passthrough)
     for name, (q, scale) in quantized.items():
-        dtype = dtypes[name]
-        out[name] = q.astype(dtype) * scale.astype(dtype)
+        m = meta[name]
+        if isinstance(m, dict):  # int4: unpack nibbles, group scales
+            dtype, cols, g = m["dtype"], m["cols"], m["group"]
+            w = _unpack_int4(q, cols).astype(dtype)
+            w = (w.reshape(q.shape[0], cols // g, g)
+                 * scale[:, :, None].astype(dtype))
+            out[name] = w.reshape(q.shape[0], cols)
+        else:
+            out[name] = q.astype(m) * scale.astype(m)
     return out
 
 
-def quantized_bytes(passthrough, quantized, dtypes):
+def quantized_bytes(passthrough, quantized, meta):
     """(quantized footprint, original footprint) in bytes — the
     bandwidth story a serve benchmark reports."""
     now = sum(int(a.size) * a.dtype.itemsize for a in passthrough.values())
     was = now
     for name, (q, scale) in quantized.items():
-        now += int(q.size) + int(scale.size) * 4
-        was += int(q.size) * jnp.dtype(dtypes[name]).itemsize
+        m = meta[name]
+        now += int(q.size) * q.dtype.itemsize + int(scale.size) * 4
+        if isinstance(m, dict):
+            was += int(q.shape[0]) * m["cols"] * jnp.dtype(m["dtype"]).itemsize
+        else:
+            was += int(q.size) * jnp.dtype(m).itemsize
     return now, was

@@ -100,6 +100,46 @@ def test_candidate_config_roundtrips_json():
     assert back == c and hash(back) == hash(c)
 
 
+def test_precision_axis_enumerates_and_defaults():
+    space = SearchSpace(batch_size=16, precision=("fp32", "int8_weights"))
+    assert len(space) == 2 * 3 * 2 * 3 * 3
+    precs = {c.precision for c in space.candidates()}
+    assert precs == {"fp32", "int8_weights"}
+    # default candidate takes the first precision — the measured baseline
+    assert space.default_candidate().precision == "fp32"
+    # train searches are unchanged: single-value axis by default
+    assert len(SearchSpace(batch_size=16)) == 3 * 2 * 3 * 3
+    with pytest.raises(mx.MXNetError):
+        SearchSpace(batch_size=16, precision=())
+
+
+def test_precision_roundtrips_and_loads_legacy_configs():
+    c = Candidate(32, precision="int4_weights")
+    back = Candidate.from_config(json.loads(json.dumps(c.config())))
+    assert back == c and back.precision == "int4_weights"
+    # winners persisted before the precision axis have no such key
+    legacy = Candidate(32, steps_per_call=2).config()
+    del legacy["precision"]
+    assert Candidate.from_config(legacy).precision == "fp32"
+    assert Candidate.from_config(legacy) == Candidate(32, steps_per_call=2)
+
+
+def test_precision_never_pruned_by_dominance():
+    """Different numeric formats have different numerics: the cost model
+    may rank them (int8 cheaper) but must never analytically prune one
+    in favor of another — only measured trials compare formats."""
+    from mxnet_tpu.autotune.cost import PRECISION_COMPUTE_FACTOR
+    model = CostModel(_stats(dp=1), hbm_budget=None)
+    a = Candidate(16, prefetch_depth=0, precision="fp32")
+    b = Candidate(16, prefetch_depth=0, precision="int8")
+    assert model.compute_cost(b) < model.compute_cost(a)
+    keep, pruned = model.plan([a, b])
+    assert a in keep and b in keep and not pruned
+    # factor table covers every advertised axis value
+    from mxnet_tpu.autotune.space import PRECISION_VALUES
+    assert set(PRECISION_VALUES) <= set(PRECISION_COMPUTE_FACTOR)
+
+
 # ---------------------------------------------------------------------------
 # cost model
 # ---------------------------------------------------------------------------

@@ -179,3 +179,227 @@ def test_quantize_net_of_hybridized_net():
     net2 = copy.deepcopy(net)
     assert net2(x).shape == (2, 3)
     assert net2._cached_graphs is not net._cached_graphs
+
+
+# -- calibration observers (satellite: explicit oracles) ---------------------
+
+def test_percentile_threshold_clips_tail():
+    rs = onp.random.RandomState(1)
+    a = onp.abs(rs.randn(50000)).astype(onp.float32)
+    a[0] = 30.0  # outlier that minmax would calibrate to
+    hist, edges = onp.histogram(a, bins=2048, range=(0, 30.0))
+    t = qz._percentile_threshold(hist, edges, percentile=99.99)
+    inlier99 = onp.percentile(a[1:], 99)
+    assert inlier99 < t < 30.0, t
+
+
+@pytest.mark.parametrize("mode", ["entropy", "percentile"])
+def test_observer_threshold_bounds_quantize_error(mode):
+    """Quantize -> dequantize under a calibrated threshold: values inside
+    the threshold err by at most one int8 step; the outlier-clipping step
+    size must beat minmax's on the inlier mass."""
+    rs = onp.random.RandomState(2)
+    a = rs.randn(50000).astype(onp.float32)
+    a[0] = 25.0
+    amax = float(onp.abs(a).max())
+    hist, edges = onp.histogram(onp.abs(a), bins=2048, range=(0, amax))
+    t = (qz.optimal_threshold(hist, edges) if mode == "entropy"
+         else qz._percentile_threshold(hist, edges))
+    assert t < amax  # the whole point: clip the tail
+    x = mx.np.array(a.reshape(100, 500))
+    q, mn, mxr = npx.quantize_v2(x, -t, t)
+    back = npx.dequantize(q, mn, mxr).asnumpy().ravel()
+    inlier = onp.abs(a) <= t
+    step = t / 127.0
+    assert (onp.abs(back[inlier] - a[inlier]) <= step / 2 + 1e-6).all()
+    assert step < amax / 127.0  # finer than the minmax grid
+    # clipped values saturate at the threshold, not explode
+    assert abs(back[0] - t) <= step
+
+
+# -- fused low-bit dense path (tentpole) -------------------------------------
+
+def _fused_inputs(m=24, k=40, n=12, seed=0, scale=1.0):
+    x = _rand(m, k, seed=seed, scale=scale)
+    w = _rand(n, k, seed=seed + 1, scale=0.5)
+    qw, w_scale = qz._quantize_weight(w)
+    x_scale = float(onp.abs(x).max()) / 127.0
+    return (mx.np.array(x), mx.np.array(qw), x_scale,
+            mx.np.array(w_scale), x @ w.T)
+
+
+def _with_route(mode, fn):
+    from mxnet_tpu import config
+    prev = config.set("quantize.fused_matmul", mode)
+    try:
+        return fn()
+    finally:
+        config.set("quantize.fused_matmul", prev)
+
+
+def test_route_knob_controls_pallas_dispatch():
+    from mxnet_tpu.ops import quantization as oq
+    assert _with_route("off", oq._route_fused) == (False, False)
+    use, interpret = _with_route("on", oq._route_fused)
+    assert use  # forced on: Pallas everywhere, interpret off-TPU
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    assert interpret == (not on_tpu)
+    use_auto, _ = _with_route("auto", oq._route_fused)
+    assert use_auto == on_tpu  # auto never interprets off-TPU
+
+
+def test_fused_dense_pallas_matches_fallback_bitwise():
+    """The Pallas kernel (interpret on CPU) and the XLA fallback chain
+    quantize identically and accumulate in exact int32 — without a bias
+    the fused epilogue is a single multiply, so parity is bitwise."""
+    x, qw, xs, ws, _ = _fused_inputs()
+    a = _with_route("on", lambda: npx.quantized_dense_fused(
+        x, qw, xs, ws)).asnumpy()
+    b = _with_route("off", lambda: npx.quantized_dense_fused(
+        x, qw, xs, ws)).asnumpy()
+    assert (a == b).all()
+
+
+def test_fused_dense_pallas_matches_fallback_with_bias():
+    # with a bias the kernel may contract mul+add into an FMA: allow one
+    # ulp, nothing more
+    x, qw, xs, ws, _ = _fused_inputs(seed=3)
+    b = mx.np.array(_rand(12, seed=5))
+    out_p = _with_route("on", lambda: npx.quantized_dense_fused(
+        x, qw, xs, ws, bias=b)).asnumpy()
+    out_x = _with_route("off", lambda: npx.quantized_dense_fused(
+        x, qw, xs, ws, bias=b)).asnumpy()
+    onp.testing.assert_allclose(out_p, out_x, rtol=0, atol=1e-5)
+
+
+def test_fused_dense_nonaligned_shapes_bitwise():
+    """Zero padding to tile boundaries is exact for symmetric int8
+    (0 quantizes to 0, contributes 0 to the dot): odd M/K/N must still be
+    bitwise against the unpadded fallback."""
+    for m, k, n in [(1, 7, 3), (5, 33, 7), (130, 257, 129)]:
+        x, qw, xs, ws, _ = _fused_inputs(m=m, k=k, n=n, seed=m)
+        a = _with_route("on", lambda: npx.quantized_dense_fused(
+            x, qw, xs, ws)).asnumpy()
+        b = _with_route("off", lambda: npx.quantized_dense_fused(
+            x, qw, xs, ws)).asnumpy()
+        assert (a == b).all(), (m, k, n)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "gelu"])
+def test_fused_dense_activation_epilogue(act):
+    x, qw, xs, ws, _ = _fused_inputs(seed=7)
+    b = mx.np.array(_rand(12, seed=8))
+    out = _with_route("on", lambda: npx.quantized_dense_fused(
+        x, qw, xs, ws, bias=b, act=act)).asnumpy()
+    ref = _with_route("off", lambda: npx.quantized_dense_fused(
+        x, qw, xs, ws, bias=b, act=act)).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=0, atol=1e-5)
+    if act == "relu":
+        assert (out >= 0).all()
+
+
+def test_fused_dense_rejects_unfusable_act():
+    x, qw, xs, ws, _ = _fused_inputs()
+    with pytest.raises(ValueError):
+        npx.quantized_dense_fused(x, qw, xs, ws, act="softmax")
+
+
+def test_fused_dense_matches_unfused_chain():
+    """Fused single-op path reproduces the documented fallback pair
+    (quantize_v2 -> quantized_fully_connected) it replaces."""
+    x, qw, xs, ws, want = _fused_inputs(seed=9)
+    fused = npx.quantized_dense_fused(x, qw, xs, ws).asnumpy()
+    T = xs * 127.0
+    xq, _, _ = npx.quantize_v2(x, -T, T)
+    chain = npx.quantized_fully_connected(xq, qw, xs, ws).asnumpy()
+    onp.testing.assert_allclose(fused, chain, rtol=0, atol=1e-5)
+    rel = onp.abs(fused - want).max() / onp.abs(want).max()
+    assert rel < 0.05, rel
+
+
+def test_quantize_net_uses_fused_dense_path():
+    """QuantizedDense forwards through quantized_dense_fused with the act
+    folded into the epilogue; output must match the net built before the
+    rewiring (same numerics as the fallback chain + eager act)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize()
+    calib = [mx.np.array(_rand(16, 20, seed=i)) for i in range(4)]
+    net(calib[0])
+    qnet = qz.quantize_net(net, calib_data=calib, calib_mode="naive")
+    assert qnet[0]._fused_act == "relu"
+    x = mx.np.array(_rand(16, 20, seed=9))
+    got = _with_route("on", lambda: qnet(x)).asnumpy()
+    ref = _with_route("off", lambda: qnet(x)).asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=0, atol=1e-4)
+
+
+# -- fp8 variant -------------------------------------------------------------
+
+def test_fp8_capable_is_gated_off_cpu():
+    from mxnet_tpu.ops.pallas.quant_matmul import fp8_capable
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        assert not fp8_capable()
+
+
+@pytest.mark.parametrize("fmt", ["e4m3", "e5m2"])
+def test_fp8_dense_fused_error_bounds(fmt):
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.quant_matmul import FP8_FORMATS
+    x = _rand(16, 64, seed=1)
+    w = _rand(8, 64, seed=2, scale=0.5)
+    dt, absmax = FP8_FORMATS[fmt]
+    w_scale = onp.abs(w).max(axis=1) / absmax
+    wq = mx.np.array(jnp.asarray(w / w_scale[:, None]).astype(dt))
+    x_scale = float(onp.abs(x).max()) / absmax
+    out = npx.fp8_dense_fused(mx.np.array(x), wq, x_scale,
+                              mx.np.array(w_scale), fmt=fmt).asnumpy()
+    want = x @ w.T
+    rel = onp.abs(out - want).max() / onp.abs(want).max()
+    # e4m3: 3 mantissa bits (~6% element error); e5m2: 2 bits (~12%) —
+    # K=64 accumulation averages much of it out
+    assert rel < (0.08 if fmt == "e4m3" else 0.2), (fmt, rel)
+
+
+def test_fp8_dense_fused_pallas_matches_fallback():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.quant_matmul import FP8_FORMATS
+    x = _rand(9, 33, seed=4)
+    w = _rand(5, 33, seed=5, scale=0.5)
+    dt, absmax = FP8_FORMATS["e4m3"]
+    w_scale = onp.abs(w).max(axis=1) / absmax
+    wq = mx.np.array(jnp.asarray(w / w_scale[:, None]).astype(dt))
+    xs = float(onp.abs(x).max()) / absmax
+    a = _with_route("on", lambda: npx.fp8_dense_fused(
+        mx.np.array(x), wq, xs, mx.np.array(w_scale))).asnumpy()
+    b = _with_route("off", lambda: npx.fp8_dense_fused(
+        mx.np.array(x), wq, xs, mx.np.array(w_scale))).asnumpy()
+    onp.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_fp8_dense_fused_rejects_unknown_format():
+    x, qw, xs, ws, _ = _fused_inputs()
+    with pytest.raises(ValueError):
+        npx.fp8_dense_fused(x, qw, xs, ws, fmt="e3m4")
+
+
+def test_fused_conv_matches_unfused_chain():
+    from jax import lax
+    x = _rand(2, 3, 8, 8, seed=1)
+    w = _rand(4, 3, 3, 3, seed=2, scale=0.3)
+    b = _rand(4, seed=3)
+    qw, w_scale = qz._quantize_weight(w)
+    T = float(onp.abs(x).max())
+    fused = npx.quantized_conv_fused(
+        mx.np.array(x), mx.np.array(qw), T / 127, mx.np.array(w_scale),
+        bias=mx.np.array(b), act="relu", kernel=(3, 3), pad=(1, 1),
+        num_filter=4).asnumpy()
+    xq, _, _ = npx.quantize_v2(mx.np.array(x), -T, T)
+    chain = npx.quantized_conv(
+        xq, mx.np.array(qw), T / 127, mx.np.array(w_scale),
+        kernel=(3, 3), pad=(1, 1), num_filter=4).asnumpy()
+    ref = onp.maximum(chain + b[None, :, None, None], 0.0)
+    onp.testing.assert_allclose(fused, ref, rtol=0, atol=1e-4)
+    assert (fused >= 0).all()

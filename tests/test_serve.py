@@ -330,6 +330,165 @@ def test_engine_rejects_unknown_quantize():
         _engine(quantize="int4")
 
 
+# -- int4 weights + int8 KV cache (tentpole) ---------------------------------
+
+def test_int4_pack_roundtrip_and_bytes():
+    rng = onp.random.RandomState(0)
+    w = rng.randn(64, 256).astype("float32")
+    pt, qt, qdt = squant.quantize_params_int4({"w": w}, min_elements=1)
+    assert not pt and list(qt) == ["w"]
+    packed, scales = qt["w"]
+    assert onp.asarray(packed).dtype == onp.uint8
+    assert onp.asarray(packed).shape == (64, 128)     # two nibbles/byte
+    assert qdt["w"]["mode"] == "int4"
+    deq = onp.asarray(squant.dequantize_params(pt, qt, qdt)["w"])
+    # group-wise symmetric int4: error <= half a step per group
+    g = qdt["w"]["group"]
+    gmax = onp.abs(w.reshape(64, -1, g)).max(axis=2, keepdims=True)
+    step = onp.broadcast_to(gmax / 7.0, w.reshape(64, -1, g).shape)
+    assert (onp.abs(deq - w) <= step.reshape(64, 256) / 2 + 1e-7).all()
+    now, was = squant.quantized_bytes(pt, qt, qdt)
+    assert now / was <= 0.15, now / was                # the CI gate's bound
+
+
+def test_int4_skips_odd_cols_and_non2d():
+    rng = onp.random.RandomState(1)
+    params = {"odd": rng.randn(64, 129).astype("float32"),
+              "vec": rng.randn(8192).astype("float32"),
+              "ok": rng.randn(64, 128).astype("float32")}
+    pt, qt, _ = squant.quantize_params_int4(params, min_elements=1)
+    assert set(qt) == {"ok"} and set(pt) == {"odd", "vec"}
+
+
+def test_int4_engine_generates_and_shrinks_weights():
+    # greedy on an untrained net is argmax over near-uniform logits —
+    # seed chosen so fp32 decode has enough margin to survive 4-bit
+    # weights (a trained model's logit margins are far larger)
+    mx.random.seed(29)
+    net = _tiny(units=64, hidden_size=128)
+    e4 = _engine(net, quantize="int4_weights")
+    r4 = e4.submit([5, 9, 3], max_new_tokens=5)
+    e4.run()
+    st = e4.stats()
+    assert st["weight_bytes"] < 0.25 * st["weight_bytes_fp"]
+    assert st["quantized_params"] > 0
+    assert st["quantized_params"] + st["passthrough_params"] == \
+        st["quantized_params"] + len(e4._params[0])
+    assert len(r4.generated) == 5
+    efp = _engine(net)
+    rfp = efp.submit([5, 9, 3], max_new_tokens=5)
+    efp.run()
+    # 4-bit weights on a tiny random net: most greedy tokens still agree
+    agree = sum(a == b for a, b in zip(r4.generated, rfp.generated))
+    assert agree >= 3, (r4.generated, rfp.generated)
+
+
+def test_int8_kv_cache_greedy_parity():
+    """int8 KV storage quantizes each written row against its own absmax:
+    on a well-scaled tiny model greedy decode must match fp32 KV."""
+    mx.random.seed(14)
+    net = _tiny()
+    rng = onp.random.RandomState(14)
+    prompts = [rng.randint(1, 97, size=rng.randint(2, 8)).tolist()
+               for _ in range(5)]
+    ekv = _engine(net, quantize="int8_kv")
+    assert ekv.cache_dtype == "int8"
+    assert ekv.stats()["cache_dtype"] == "int8"
+    rkv = [ekv.submit(p, max_new_tokens=6) for p in prompts]
+    ekv.run()
+    efp = _engine(net)
+    rfp = [efp.submit(p, max_new_tokens=6) for p in prompts]
+    efp.run()
+    match = sum(a.generated == b.generated for a, b in zip(rkv, rfp))
+    assert match >= 4, [(a.generated, b.generated)
+                        for a, b in zip(rkv, rfp)]
+
+
+def test_int8_kv_cache_arrays_are_int8():
+    net = _tiny()
+    eng = _engine(net, quantize="int8_kv")
+    (kq, ks), (vq, vs) = eng._cache[0]
+    assert onp.asarray(kq).dtype == onp.int8
+    assert onp.asarray(vq).dtype == onp.int8
+    assert onp.asarray(ks).dtype == onp.float32
+    assert ks.shape == kq.shape[:3] + (1,)   # per-(slot, row, head) scales
+
+
+def test_combined_int4_weights_int8_kv():
+    mx.random.seed(15)
+    net = _tiny(units=64, hidden_size=128)
+    eng = _engine(net, quantize="int4_weights,int8_kv")
+    assert eng.quantize == "int4_weights,int8_kv"
+    assert eng.cache_dtype == "int8"
+    r = eng.submit([7, 2, 9], max_new_tokens=5)
+    eng.run()
+    assert len(r.generated) == 5
+    st = eng.stats()
+    assert st["weight_bytes"] < 0.25 * st["weight_bytes_fp"]
+
+
+def test_conflicting_weight_modes_rejected():
+    with pytest.raises(mx.MXNetError):
+        _engine(quantize="int8_weights,int4_weights")
+
+
+def test_zero_recompiles_with_quantization(metrics):
+    """The low-bit cache pytree and dequant-on-read must not change the
+    traced signature per step: PR 2's detector stays at zero after
+    warmup in every quantize mode."""
+    mx.random.seed(16)
+    for spec in ("int8_weights", "int4_weights,int8_kv"):
+        telemetry.reset()
+        eng = _engine(_tiny(), quantize=spec)
+        eng.warmup()
+        for p in ([3, 1, 4], [1, 5], [9, 2, 6, 5]):
+            eng.submit(p, max_new_tokens=4)
+        eng.run()
+        assert eng.stats()["post_warmup_compiles"] == 0, spec
+
+
+def test_quantize_eligibility_knobs():
+    rng = onp.random.RandomState(2)
+    params = {"mid": rng.randn(32, 32).astype("float32")}   # 1024 elems
+    pt, qt, _ = squant.quantize_params_int8(params)         # default 4096
+    assert set(pt) == {"mid"} and not qt
+    prev = mx.config.set("serve.quantize_min_elems", 512)
+    try:
+        pt, qt, _ = squant.quantize_params_int8(params)
+        assert set(qt) == {"mid"}
+    finally:
+        mx.config.set("serve.quantize_min_elems", prev)
+    prev = mx.config.set("serve.quantize_ndim", 1)
+    try:
+        pt, qt, _ = squant.quantize_params_int8(
+            {"vec": rng.randn(8192).astype("float32")})
+        assert set(qt) == {"vec"}                            # 1-D now eligible
+    finally:
+        mx.config.set("serve.quantize_ndim", prev)
+
+
+def test_int4_group_size_knob():
+    rng = onp.random.RandomState(3)
+    w = rng.randn(8, 256).astype("float32")
+    prev = mx.config.set("serve.quantize_group_size", 64)
+    try:
+        _, qt, qdt = squant.quantize_params_int4({"w": w}, min_elements=1)
+    finally:
+        mx.config.set("serve.quantize_group_size", prev)
+    assert qdt["w"]["group"] == 64
+    assert qt["w"][1].shape == (8, 4)                        # 256/64 groups
+
+
+def test_quantized_param_counts_in_telemetry(metrics):
+    mx.random.seed(17)
+    eng = _engine(_tiny(units=64, hidden_size=128),
+                  quantize="int8_weights")
+    g = telemetry.snapshot()["gauges"]
+    st = eng.stats()
+    assert g["serve.quantized_params"] == st["quantized_params"] > 0
+    assert g["serve.passthrough_params"] == st["passthrough_params"]
+
+
 # -- serve.* telemetry ------------------------------------------------------
 
 def test_serve_metrics_recorded(metrics):

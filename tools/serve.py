@@ -14,6 +14,9 @@ Usage:
     python tools/serve.py --prompt 3,14,15 --prompt 92,65 \
         --quantize int8_weights --max-new 32
 
+    # int4 weights + int8 KV cache (the bandwidth-min decode config)
+    python tools/serve.py --demo 8 --quantize int4_weights,int8_kv
+
     # gpt2-124m shapes (accelerator-sized; slow on CPU)
     python tools/serve.py --model gpt2_124m --demo 8
 """
@@ -58,7 +61,9 @@ def main(argv=None):
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--eos-id", type=int, default=None)
     p.add_argument("--quantize", default=None,
-                   choices=[None, "int8_weights"])
+                   help="low-bit storage: int8_weights, int4_weights, "
+                        "int8_kv — comma-combinable, e.g. "
+                        "'int4_weights,int8_kv'")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
