@@ -78,12 +78,16 @@ def _percentiles(name):
 
 def run_engine(net, work, slots, arrivals, drain_window=8, seed=0):
     """Drive one engine over the workload; percentiles read back out of
-    the serve.* telemetry histograms."""
+    the serve.* telemetry histograms, per-phase breakdown (queue-wait /
+    prefill / per-token decode) out of the mx.trace spans the engine
+    records while tracing is on."""
     import mxnet_tpu as mx
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import telemetry, trace
 
     telemetry.reset()
     telemetry.enable()
+    trace.clear()
+    trace.enable()
     try:
         eng = mx.serve.load(net, max_slots=slots, drain_window=drain_window,
                             seed=seed, warmup=True)
@@ -116,8 +120,13 @@ def run_engine(net, work, slots, arrivals, drain_window=8, seed=0):
             "ttft_s": _percentiles("serve.ttft_seconds"),
             "tpot_s": _percentiles("serve.tpot_seconds"),
             "step_s": _percentiles("serve.step_seconds"),
+            "phases_s": {
+                phase: (q and {k: round(v, 6) for k, v in q.items()})
+                for phase, q in st["phases"].items()},
         }, [r.output_ids for r in reqs]
     finally:
+        trace.disable()
+        trace.clear()
         telemetry.disable()
         telemetry.reset()
 

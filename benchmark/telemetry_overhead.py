@@ -1,17 +1,21 @@
 #!/usr/bin/env python
-"""Disabled-telemetry fast-path overhead budget (CI `telemetry` stage).
+"""Disabled-observability fast-path overhead budget (CI stages).
 
-The contract (mxnet_tpu/telemetry.py, mirroring fault.py): with the
-registry off, every instrumentation hook in the stack is ONE module
-attribute read + branch.  This benchmark measures that cost against a
-tight eager-op loop and fails if the probes add more than the budget
-(default 2%) — the guard that keeps future instrumentation honest.
+The contract (mxnet_tpu/telemetry.py and mxnet_tpu/trace.py, mirroring
+fault.py): with the registry/recorder off, every instrumentation hook in
+the stack is ONE module attribute read + branch.  This benchmark
+measures that cost against a tight eager-op loop and fails if the probes
+add more than the budget (default 2%) — the guard that keeps future
+instrumentation honest.  The trace-enabled path is also measured and
+reported (informational: enabling tracing is a deliberate choice, only
+the disabled paths are gated).
 
 Method: time a tight eager add loop (N ops, synced once) as the
-baseline, then the same loop with K extra disabled-telemetry probes per
-iteration, scale the measured per-probe cost down to the ~1 probe a real
-dispatch performs, and compare medians of R repeats (medians + many
-probes per iteration keep the number stable on noisy CI hosts).
+baseline, then the same loop with K extra disabled probes per iteration
+(telemetry and trace each), scale the measured per-probe cost down to
+the ~1 probe a real dispatch performs, and compare medians of R repeats
+(medians + many probes per iteration keep the number stable on noisy CI
+hosts).
 
 Usage: python benchmark/telemetry_overhead.py [--budget 0.02] [--json]
 """
@@ -45,28 +49,70 @@ def _loop(a, n, probes_per_op, telemetry):
     return time.perf_counter() - t0
 
 
+def _trace_loop(a, n, probes_per_op, trace):
+    """Same shape, probing the mx.trace disabled gate instead."""
+    t0 = time.perf_counter()
+    out = a
+    probe = range(probes_per_op)
+    for _ in range(n):
+        out = out + a
+        for _ in probe:
+            if trace._active:  # the hook pattern under test
+                trace.emit("bench.never", 0, 0)
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
+def _trace_enabled_loop(a, n, trace):
+    """Eager loop with one real recorded span per op (tracing ON)."""
+    t0 = time.perf_counter()
+    out = a
+    for _ in range(n):
+        with trace.span("bench.op"):
+            out = out + a
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
 def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     import mxnet_tpu as mx
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import telemetry, trace
 
     telemetry.disable()
-    assert not telemetry.active()
+    trace.disable()
+    assert not telemetry.active() and not trace.active()
     a = mx.np.ones((8, 8))
     _loop(a, 200, 0, telemetry)          # warmup: compile + caches hot
-    base_s, probed_s = [], []
+    base_s, probed_s, tprobed_s, ton_s = [], [], [], []
     for _ in range(repeats):
         base_s.append(_loop(a, n, 0, telemetry))
         probed_s.append(_loop(a, n, probes_per_op, telemetry))
+        tprobed_s.append(_trace_loop(a, n, probes_per_op, trace))
+        trace.enable(buffer=max(1024, n))
+        ton_s.append(_trace_enabled_loop(a, n, trace))
+        trace.disable()
+        trace.clear()
     base = statistics.median(base_s)
     probed = statistics.median(probed_s)
+    tprobed = statistics.median(tprobed_s)
+    ton = statistics.median(ton_s)
     # cost of the K probes, scaled to the ~1 probe a real dispatch adds
-    per_probe_overhead = max(0.0, probed - base) / probes_per_op
-    ratio = per_probe_overhead / base
+    per_probe = max(0.0, probed - base) / probes_per_op
+    per_trace_probe = max(0.0, tprobed - base) / probes_per_op
+    ratio = per_probe / base
+    trace_ratio = per_trace_probe / base
     return {"ops": n, "probes_per_op": probes_per_op, "repeats": repeats,
             "baseline_s": round(base, 6), "probed_s": round(probed, 6),
-            "per_op_probe_overhead_ns": round(per_probe_overhead / n * 1e9, 2),
-            "overhead_ratio": round(ratio, 6), "budget": budget,
-            "ok": ratio < budget}
+            "trace_probed_s": round(tprobed, 6),
+            "trace_enabled_s": round(ton, 6),
+            "per_op_probe_overhead_ns": round(per_probe / n * 1e9, 2),
+            "per_op_trace_probe_overhead_ns":
+                round(per_trace_probe / n * 1e9, 2),
+            "overhead_ratio": round(ratio, 6),
+            "trace_overhead_ratio": round(trace_ratio, 6),
+            "trace_enabled_ratio": round(max(0.0, ton - base) / base, 6),
+            "budget": budget,
+            "ok": ratio < budget and trace_ratio < budget}
 
 
 def main(argv=None):
@@ -85,16 +131,23 @@ def main(argv=None):
     else:
         print(f"baseline eager loop   {r['baseline_s'] * 1e3:9.2f} ms "
               f"({r['ops']} ops)")
-        print(f"with {r['probes_per_op']}x disabled probes/op "
+        print(f"with {r['probes_per_op']}x disabled telemetry probes/op "
               f"{r['probed_s'] * 1e3:9.2f} ms")
-        print(f"per-op probe overhead {r['per_op_probe_overhead_ns']:9.2f} ns")
-        print(f"overhead ratio        {r['overhead_ratio'] * 100:9.4f} % "
+        print(f"with {r['probes_per_op']}x disabled trace probes/op "
+              f"{r['trace_probed_s'] * 1e3:9.2f} ms")
+        print(f"with tracing ENABLED (1 span/op) "
+              f"{r['trace_enabled_s'] * 1e3:9.2f} ms "
+              f"(+{r['trace_enabled_ratio'] * 100:.2f}%, informational)")
+        print(f"telemetry overhead ratio {r['overhead_ratio'] * 100:9.4f} % "
+              f"(budget {r['budget'] * 100:g}%)")
+        print(f"trace overhead ratio     "
+              f"{r['trace_overhead_ratio'] * 100:9.4f} % "
               f"(budget {r['budget'] * 100:g}%)")
     if not r["ok"]:
-        print("FAIL: disabled telemetry fast path exceeds the overhead "
-              "budget", file=sys.stderr)
+        print("FAIL: a disabled observability fast path exceeds the "
+              "overhead budget", file=sys.stderr)
         return 1
-    print("OK: disabled telemetry fast path within budget")
+    print("OK: disabled telemetry + trace fast paths within budget")
     return 0
 
 

@@ -36,6 +36,12 @@
 #                an injected OOM trial survives, and the second run
 #                reloads the winner by fingerprint with zero trials
 #                (docs/PERFORMANCE.md "Autotuning")
+#   trace      - causal-tracing suite + e2e span-tree validation: the
+#                acceptance tests export one traced train epoch and one
+#                traced serve run (MXNET_TRACE_E2E_DIR), tools/trace.py
+#                re-validates both trees from the JSON, and the
+#                disabled-fast-path budget (<2%) is re-enforced with the
+#                trace probe included (docs/OBSERVABILITY.md "Tracing")
 #   quantize   - low-bit inference suite (default route AND the Pallas
 #                path forced on via MXNET_QUANTIZE_FUSED_MATMUL=on) +
 #                the quantized_inference gates: fused kernel bitwise vs
@@ -50,7 +56,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|quantize|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|quantize|trace|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -237,6 +243,28 @@ quantize() {
     JAX_PLATFORMS=cpu python benchmark/quantized_inference.py --assert
 }
 
+trace() {
+    echo "== trace: causal-tracing suite (docs/OBSERVABILITY.md) =="
+    tmp=$(mktemp -d)
+    MXNET_TRACE_E2E_DIR="$tmp" python -m pytest tests/test_trace.py -q
+    echo "== trace: e2e span trees (tools/trace.py validate) =="
+    python tools/trace.py validate "$tmp/e2e_train.json" \
+        --expect train.step \
+        --expect-child train.step=train.data_wait \
+        --expect-child train.step=train.h2d \
+        --expect-child train.step=train.dispatch \
+        --expect-child train.step=train.drain
+    python tools/trace.py validate "$tmp/e2e_serve.json" \
+        --expect serve.request \
+        --expect-child serve.request=serve.enqueue \
+        --expect-child serve.request=serve.prefill \
+        --expect-child serve.request=serve.decode_step \
+        --expect-child serve.request=serve.drain
+    rm -rf "$tmp"
+    echo "== trace: disabled fast-path overhead budget (<2%) =="
+    JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
+}
+
 zero() {
     echo "== zero: ZeRO-sharded training suite (docs/PERFORMANCE.md) =="
     python -m pytest tests/test_zero.py -q
@@ -286,8 +314,9 @@ case "$stage" in
     serve) serve ;;
     autotune) autotune ;;
     quantize) quantize ;;
+    trace) trace ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune; quantize ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune; quantize; trace ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

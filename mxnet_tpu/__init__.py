@@ -30,6 +30,7 @@ from .base import MXNetError
 from . import config
 from . import telemetry
 from . import fault
+from . import trace
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, device, num_gpus, num_tpus
 from . import engine

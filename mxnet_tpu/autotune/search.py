@@ -32,6 +32,7 @@ import numpy as onp
 from .. import config as _config
 from .. import fault as _fault
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..base import MXNetError
 from .cost import CostModel, ModelStats
 from .persist import (load_winner, model_fingerprint, save_winner,
@@ -343,9 +344,17 @@ def search(block, loss_fn, optimizer, mesh, batch_specs, sample_batch,
         warmup = _config.get("autotune.trial_warmup")
 
     trials = []
+    root = _trace.begin("autotune.search", category="autotune",
+                        candidates=n_candidates, kept=len(keep),
+                        pruned=len(pruned)) if _trace._active else None
     with trial_compile_scope(block):
         for c in keep:
             t0 = time.perf_counter()
+            # trial span carries the candidate config as attrs, so a
+            # trace export reads as (config -> measured wall time) pairs
+            sp = _trace.begin("autotune.trial", category="autotune",
+                             parent=(root.context if root else None),
+                             **c.config()) if _trace._active else None
             try:
                 if measure is not None:
                     if _fault._active and _fault.fire("autotune.trial_oom"):
@@ -366,7 +375,13 @@ def search(block, loss_fn, optimizer, mesh, batch_specs, sample_batch,
                 if status == "oom":
                     _telemetry.inc("autotune.trials_oom_total")
                     _fault.record("autotune.trial_oom")
+            if sp is not None:
+                last = trials[-1]
+                sp.end(status=last.status,
+                       items_per_s=(last.items_per_s or 0.0))
             _telemetry.inc("autotune.trials_total")
+    if root is not None:
+        root.end(trials=len(trials))
 
     ok = [t for t in trials if t.status == "ok"]
     best = max(ok, key=lambda t: t.items_per_s) if ok else None

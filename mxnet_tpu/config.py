@@ -293,6 +293,19 @@ declare("serve.quantize_group_size", int, 128,
         "MXNET_SERVE_QUANTIZE_GROUP_SIZE",
         "Input-axis group size for int4 group-wise weight scales; rows "
         "whose width is not divisible fall back to one scale per row.")
+declare("trace.enable", bool, False, "MXNET_TRACE",
+        "Enable the mx.trace span recorder (causal tracing through the "
+        "train step, pipeline prefetch, serve request and autotune trial "
+        "lifecycles); disabled, every hook costs one module-attribute "
+        "read, like telemetry.enable.")
+declare("trace.buffer", int, 4096, "MXNET_TRACE_BUFFER",
+        "Capacity of the per-process mx.trace span ring buffer; overflow "
+        "drops oldest-first and counts trace.dropped_total.")
+declare("telemetry.http_port", int, 0, "MXNET_TELEMETRY_PORT",
+        "Arm the stdlib ops endpoint at import on this port (0 = off): "
+        "GET /metrics (Prometheus exposition), /healthz, /trace?last=N. "
+        "mx.telemetry.serve_http(port) starts it at runtime; port 0 "
+        "there binds an ephemeral port.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

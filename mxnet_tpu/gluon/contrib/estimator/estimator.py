@@ -7,6 +7,8 @@ GradientUpdateHandler at batch_end, and handlers run in ascending
 """
 from __future__ import annotations
 
+from .... import pipeline as _pipeline
+from .... import trace as _trace
 from ...metric import Accuracy, Loss as LossMetric
 from ...trainer import Trainer
 from .batch_processor import BatchProcessor
@@ -16,6 +18,26 @@ from .event_handler import (
 
 _EVENTS = ("train_begin", "train_end", "epoch_begin", "epoch_end",
            "batch_begin", "batch_end")
+
+
+def _place_batch(batch):
+    """Ensure every array leaf of ``batch`` is device-resident.  Leaves
+    already on device pass through untouched (the sync-free common
+    case); only genuinely host-side leaves pay a device_put — this is
+    the h2d phase the ``train.step`` span tree times."""
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_place_batch(b) for b in batch)
+    raw = getattr(batch, "_data", None)
+    if raw is not None:
+        out, moved = _pipeline.maybe_device_put(raw)
+        if not moved:
+            return batch
+        from ....numpy.multiarray import _wrap
+        return _wrap(out)
+    if hasattr(batch, "__array__"):
+        out, _ = _pipeline.maybe_device_put(batch)
+        return out
+    return batch
 
 
 class Estimator:
@@ -72,16 +94,59 @@ class Estimator:
                 getattr(h, kind)(self, *args, **kwargs)
 
         _dispatch("train_begin")
+        step_no = 0
         while not stop.stop_training:
             _dispatch("epoch_begin")
-            for batch in train_data:
-                if stop.stop_training:
-                    break
-                _dispatch("batch_begin")
-                _, label, pred, loss = self.batch_processor.fit_batch(
-                    self, batch, batch_axis)
-                _dispatch("batch_end", pred=pred, label=label, loss=loss,
-                          num_samples=batch[0].shape[batch_axis])
+            batch_iter = iter(train_data)
+            while True:
+                if not _trace._active:
+                    try:
+                        batch = next(batch_iter)
+                    except StopIteration:
+                        break
+                    if stop.stop_training:
+                        break
+                    _dispatch("batch_begin")
+                    _, label, pred, loss = self.batch_processor.fit_batch(
+                        self, batch, batch_axis)
+                    _dispatch("batch_end", pred=pred, label=label,
+                              loss=loss,
+                              num_samples=batch[0].shape[batch_axis])
+                    continue
+                # traced step anatomy: one span tree per step, children
+                # data_wait -> h2d -> dispatch -> drain.  The drain child
+                # only notes the deferred-window depth — actual fetches
+                # stay at epoch boundaries, so the loop remains sync-free
+                step_no += 1
+                sp = _trace.span("train.step", category="train",
+                                 step=step_no)
+                sp.__enter__()
+                try:
+                    with _trace.span("train.data_wait", category="train"):
+                        try:
+                            batch = next(batch_iter)
+                        except StopIteration:
+                            break
+                    if stop.stop_training:
+                        break
+                    with _trace.span("train.h2d", category="train"):
+                        batch = _place_batch(batch)
+                    _dispatch("batch_begin")
+                    with _trace.span("train.dispatch", category="train"):
+                        _, label, pred, loss = \
+                            self.batch_processor.fit_batch(
+                                self, batch, batch_axis)
+                        _dispatch("batch_end", pred=pred, label=label,
+                                  loss=loss,
+                                  num_samples=batch[0].shape[batch_axis])
+                    window = getattr(self.trainer, "_norm_window", None)
+                    with _trace.span("train.drain", category="train",
+                                     pending=(len(window)
+                                              if window is not None
+                                              else 0)):
+                        pass
+                finally:
+                    sp.__exit__(None, None, None)
             _dispatch("epoch_end")
             if epochs is None and batches is None:
                 break

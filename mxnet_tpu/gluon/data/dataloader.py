@@ -25,6 +25,7 @@ from ... import fault as _fault
 from ... import numpy as _np
 from ... import pipeline as _pipeline
 from ... import telemetry as _telemetry
+from ... import trace as _trace
 from ...numpy.multiarray import ndarray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -177,7 +178,7 @@ def _to_shm(batch, grants=None):
     return ("pack", name, tree, size, created)
 
 
-def _mp_worker_task(indices, fault_step=0, grants=None):
+def _mp_worker_task(indices, fault_step=0, grants=None, trace_ctx=None):
     # fault hooks (armed via MXNET_FAULT_SPEC, inherited by the spawned
     # worker's environment): crash = hard death with no cleanup, the
     # failure a preempted/OOM-killed worker produces; hang = the worker
@@ -189,11 +190,22 @@ def _mp_worker_task(indices, fault_step=0, grants=None):
             os._exit(117)
         if _fault.fire("dataloader.worker_hang", step=fault_step):
             time.sleep(3600)
+    # trace_ctx is the consumer's (trace_id, span_id): spans built here
+    # ride the result tuple back and land on the parent's timeline (the
+    # trace clock is CLOCK_MONOTONIC, system-wide on Linux)
+    t0u = _trace.clock_us() if trace_ctx is not None else 0
     ds, bf = _worker_state["dataset"], _worker_state["batchify"]
     grants = list(grants) if grants is not None else None
     spec = _to_shm(bf([ds[i] for i in indices]), grants)
+    spans = []
+    if trace_ctx is not None:
+        spans.append(_trace.make_span(
+            "dataloader.worker_batch", t0u, _trace.clock_us() - t0u,
+            tuple(trace_ctx), category="dataloader",
+            samples=len(indices), task_seq=fault_step,
+            worker_pid=os.getpid()))
     # leftover grants ride back so the parent can return them to the pool
-    return (grants or [], spec)
+    return (grants or [], spec, spans)
 
 
 class _ShmRing:
@@ -575,7 +587,10 @@ class DataLoader:
                         try:
                             inflight.append(
                                 (pool.submit(_mp_worker_task, indices,
-                                             self._task_seq, grants),
+                                             self._task_seq, grants,
+                                             (_trace.current_context()
+                                              if _trace._active
+                                              else None)),
                                  indices, grants))
                         except BaseException:
                             todo.appendleft(indices)
@@ -588,12 +603,16 @@ class DataLoader:
                         _telemetry.set_gauge("dataloader.queue_depth",
                                              len(inflight))
                         _t0 = time.perf_counter()
-                        leftover, spec = fut.result(timeout=self._timeout)
+                        leftover, spec, wspans = \
+                            fut.result(timeout=self._timeout)
                         _telemetry.observe("dataloader.wait_seconds",
                                            time.perf_counter() - _t0)
                         _telemetry.inc("dataloader.batches_total")
                     else:
-                        leftover, spec = fut.result(timeout=self._timeout)
+                        leftover, spec, wspans = \
+                            fut.result(timeout=self._timeout)
+                    if wspans and _trace._active:
+                        _trace.ingest(wspans)
                     inflight.popleft()
                 except (BrokenProcessPool, cf.BrokenExecutor,
                         cf.TimeoutError, TimeoutError):
@@ -625,7 +644,8 @@ class DataLoader:
         finally:
             for fut, _, grants in inflight:
                 try:
-                    leftover, spec = fut.result(timeout=self._timeout)
+                    leftover, spec, _wspans = \
+                        fut.result(timeout=self._timeout)
                     if ring is not None:
                         for name, size in leftover:
                             ring.give_back(name, size)
@@ -653,7 +673,7 @@ class DataLoader:
             if fut.done() and not fut.cancelled() and \
                     fut.exception() is None:
                 try:
-                    leftover, spec = fut.result()
+                    leftover, spec, _wspans = fut.result()
                     if ring is not None:
                         for name, size in leftover:
                             ring.give_back(name, size)

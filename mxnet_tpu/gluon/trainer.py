@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from .. import optimizer as opt
 from .. import pipeline as _pipeline
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..base import MXNetError
 from ..kvstore import create as create_kvstore, KVStoreBase
 from .parameter import Parameter
@@ -292,6 +293,16 @@ class Trainer:
         refresh the per-device ``memory.*`` gauges.  Call at epoch
         boundaries / before ``mx.telemetry.snapshot()`` for up-to-the-step
         numbers; the estimator's TelemetryHandler does."""
+        if _trace._active:
+            with _trace.span("train.drain", category="train",
+                             pending=(len(self._norm_window)
+                                      if self._norm_window is not None
+                                      else 0)):
+                if self._norm_window is not None:
+                    self._norm_window.drain()
+                if _telemetry._active:
+                    _telemetry.record_memory()
+            return
         if self._norm_window is not None:
             self._norm_window.drain()
         if _telemetry._active:

@@ -56,6 +56,7 @@ import time
 from . import config as _config
 from . import fault as _fault
 from . import telemetry as _telemetry
+from . import trace as _trace
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device", "DeferredWindow",
            "maybe_device_put", "ensure_sharded", "sync_guard",
@@ -293,6 +294,12 @@ class DeferredWindow:
 
     def drain(self):
         """Fetch and deliver every pending value, oldest first."""
+        if _trace._active and self._pending:
+            with _trace.span("pipeline.drain", category="pipeline",
+                             pending=len(self._pending)):
+                while self._pending:
+                    self._drain_one()
+            return
         while self._pending:
             self._drain_one()
 
@@ -358,10 +365,15 @@ class DevicePrefetcher:
         self._gen = 0
         self._thread = None
         self._done = False
+        self._trace_ctx = None
 
     # -- background side ----------------------------------------------------
 
     def _start(self):
+        if _trace._active and self._trace_ctx is None:
+            # span context of the consumer that spawned us: every h2d
+            # span on the prefetch thread parents back to it
+            self._trace_ctx = _trace.current_context()
         t = threading.Thread(target=self._run, args=(self._gen,),
                              name="mx-device-prefetch", daemon=True)
         self._thread = t
@@ -384,6 +396,8 @@ class DevicePrefetcher:
         return False
 
     def _run(self, gen):
+        if _trace._active and self._trace_ctx:
+            _trace.adopt(self._trace_ctx)
         while not self._stale(gen):
             if _fault._active and _fault.fire("pipeline.prefetch_stall"):
                 # wedge BETWEEN batches, holding neither the source lock
@@ -407,7 +421,12 @@ class DevicePrefetcher:
                     # slow-but-alive producer still hands its batch on
                     # instead of dropping it, and the replacement (blocked
                     # on the lock) cannot fetch the following batch first
-                    payload = self._put_batch(item)
+                    if _trace._active:
+                        with _trace.span("pipeline.h2d",
+                                         category="pipeline"):
+                            payload = self._put_batch(item)
+                    else:
+                        payload = self._put_batch(item)
                 except BaseException as exc:  # noqa: BLE001 - to consumer
                     self._offer(_Raise(exc))
                     return

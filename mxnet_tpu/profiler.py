@@ -31,6 +31,14 @@ _events = []
 _lock = threading.Lock()
 
 
+def now_us():
+    """Monotonic microseconds — THE clock of the host observability
+    plane: profiler events, ``mx.trace`` spans and DataLoader-worker
+    spans all stamp from here (CLOCK_MONOTONIC, system-wide on Linux),
+    so aggregate tables and trace exports line up."""
+    return time.perf_counter_ns() // 1000
+
+
 def set_config(**kwargs):
     """Reference: profiler.py set_config (filename, profile_all, ...)."""
     _config.update(kwargs)
@@ -90,7 +98,7 @@ class _Span:
         self.name, self.category = name, category
 
     def __enter__(self):
-        self._t0 = time.perf_counter_ns()
+        self._t0 = now_us()
         self._jax = jax.profiler.TraceAnnotation(self.name)
         self._jax.__enter__()
         return self
@@ -98,9 +106,8 @@ class _Span:
     def __exit__(self, *exc):
         self._jax.__exit__(*exc)
         if _state["running"]:
-            t1 = time.perf_counter_ns()
-            record_event(self.name, self.category, self._t0 // 1000,
-                         (t1 - self._t0) // 1000)
+            record_event(self.name, self.category, self._t0,
+                         now_us() - self._t0)
 
 
 def span(name, category="op"):
@@ -184,13 +191,12 @@ class Task:
         self._t0 = None
 
     def start(self):
-        self._t0 = time.perf_counter_ns()
+        self._t0 = now_us()
 
     def stop(self):
         if self._t0 is not None:
             record_event(self.name, f"task:{self.domain.name}",
-                         self._t0 // 1000,
-                         (time.perf_counter_ns() - self._t0) // 1000)
+                         self._t0, now_us() - self._t0)
 
 
 Frame = Task
@@ -203,8 +209,7 @@ class Counter:
     def set_value(self, value):
         self.value = value
         record_event(self.name, f"counter:{self.domain.name}",
-                     time.perf_counter_ns() // 1000, 0,
-                     {"value": value})
+                     now_us(), 0, {"value": value})
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)
@@ -219,7 +224,7 @@ class Marker:
 
     def mark(self, scope="process"):
         record_event(self.name, f"marker:{self.domain.name}",
-                     time.perf_counter_ns() // 1000, 0)
+                     now_us(), 0)
 
 
 class Event:
@@ -231,12 +236,12 @@ class Event:
         self._t0 = None
 
     def start(self):
-        self._t0 = time.perf_counter_ns()
+        self._t0 = now_us()
 
     def stop(self):
         if self._t0 is not None:
-            record_event(self.name, "event", self._t0 // 1000,
-                         (time.perf_counter_ns() - self._t0) // 1000)
+            record_event(self.name, "event", self._t0,
+                         now_us() - self._t0)
             self._t0 = None
 
 

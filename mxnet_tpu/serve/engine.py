@@ -41,7 +41,9 @@ import numpy as onp
 from .. import config as _config
 from .. import functional as _functional
 from .. import pipeline as _pipeline
+from .. import profiler as _profiler
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..base import MXNetError
 from . import quantize as _quantize
 
@@ -130,7 +132,7 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "generated",
                  "slot", "finished", "t_submit", "t_admitted", "t_first",
-                 "t_done")
+                 "t_done", "phases", "_span", "_enq")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id=None):
         self.id = rid
@@ -144,6 +146,11 @@ class Request:
         self.t_admitted = None
         self.t_first = None
         self.t_done = None
+        #: per-phase wall-time samples (seconds), filled while mx.trace
+        #: records this request — the source of stats()["phases"]
+        self.phases = {}
+        self._span = None   # serve.request root (trace.SpanHandle)
+        self._enq = None    # serve.enqueue child, open until admission
 
     @property
     def output_ids(self):
@@ -441,6 +448,13 @@ class ServeEngine:
                       self.eos_id if eos_id == "engine" else eos_id)
         self._next_id += 1
         self._queue.append(req)
+        if _trace._active:
+            req._span = _trace.begin("serve.request", category="serve",
+                                     request=req.id,
+                                     prompt_tokens=len(prompt))
+            req._enq = _trace.begin("serve.enqueue", category="serve",
+                                    parent=req._span.context,
+                                    request=req.id)
         if _telemetry._active:
             _telemetry.inc("serve.requests_total")
             _telemetry.set_gauge("serve.queue_depth", len(self._queue))
@@ -455,6 +469,12 @@ class ServeEngine:
             self._free.sort(reverse=True)
             req.slot = None
         self._completed.append(req)
+        if req._enq is not None:  # finished without ever being admitted
+            req._enq.end()
+            req._enq = None
+        if req._span is not None:
+            req._span.end(tokens=len(req.generated))
+            req._span = None
         if _telemetry._active:
             _telemetry.inc("serve.completed_total")
             _telemetry.inc("serve.tokens_total", len(req.generated))
@@ -463,6 +483,8 @@ class ServeEngine:
 
     def _prefill_sink(self, req):
         def sink(fetched):
+            t0u = _profiler.now_us() if _trace._active else 0
+            span_ctx = req._span.context if req._span is not None else None
             tok, done = int(fetched[0]), bool(fetched[1])
             req.t_first = time.perf_counter()
             req.generated.append(tok)
@@ -470,19 +492,31 @@ class ServeEngine:
                 _telemetry.observe("serve.ttft_seconds", req.ttft)
             if done:
                 self._finish(req)
+            if _trace._active and span_ctx is not None:
+                _trace.emit("serve.drain", t0u, _profiler.now_us() - t0u,
+                            parent=span_ctx, category="serve",
+                            request=req.id, first_token=True)
         return sink
 
     def _decode_sink(self, slot_map):
         def sink(fetched):
+            t0u = _profiler.now_us() if _trace._active else 0
             toks, done = fetched
             for slot, req in slot_map.items():
                 if req.finished:
                     continue  # finished in an older entry of this window
+                span_ctx = (req._span.context
+                            if req._span is not None else None)
                 tok = int(toks[slot])
                 if tok >= 0:
                     req.generated.append(tok)
                 if bool(done[slot]):
                     self._finish(req)
+                if _trace._active and span_ctx is not None and tok >= 0:
+                    _trace.emit("serve.drain", t0u,
+                                _profiler.now_us() - t0u,
+                                parent=span_ctx, category="serve",
+                                request=req.id)
         return sink
 
     def _admit(self):
@@ -496,12 +530,24 @@ class ServeEngine:
             padded[:length] = req.prompt
             limit = min(length + req.max_new_tokens - 1, self.max_seq - 1)
             exe = self._prefill_exe(bucket)
+            t0u = _profiler.now_us() if _trace._active else 0
             self._cache, self._state, emit = exe(
                 self._params, self._cache, self._state,
                 jnp.asarray(padded), jnp.int32(slot), jnp.int32(length),
                 jnp.int32(limit))
             req.slot = slot
             req.t_admitted = time.perf_counter()
+            if req._enq is not None:
+                req._enq.end()
+                req._enq = None
+            if _trace._active and req._span is not None:
+                duru = _profiler.now_us() - t0u
+                _trace.emit("serve.prefill", t0u, duru,
+                            parent=req._span.context, category="serve",
+                            request=req.id, slot=slot, bucket=bucket)
+                req.phases.setdefault("queue_wait", []).append(
+                    req.t_admitted - req.t_submit)
+                req.phases.setdefault("prefill", []).append(duru / 1e6)
             self._slots[slot] = req
             self._window.push(emit, self._prefill_sink(req))
             admitted += 1
@@ -534,11 +580,23 @@ class ServeEngine:
         t0 = time.perf_counter()
         self._cache, self._state, emit = exe(
             self._params, self._cache, self._state)
+        dt = time.perf_counter() - t0
         self._steps += 1
         if _telemetry._active:
             _telemetry.inc("serve.steps_total")
-            _telemetry.observe("serve.step_seconds",
-                               time.perf_counter() - t0)
+            _telemetry.observe("serve.step_seconds", dt)
+        if _trace._active:
+            # one span per live request per step: the dispatch wall time
+            # was measured anyway, so re-stamp it on the shared clock
+            duru = int(dt * 1e6)
+            t0u = _profiler.now_us() - duru
+            for slot, req in live.items():
+                if req._span is not None:
+                    _trace.emit("serve.decode_step", t0u, duru,
+                                parent=req._span.context,
+                                category="serve", request=req.id,
+                                slot=slot, step=self._steps)
+                req.phases.setdefault("decode_step", []).append(dt)
         self._window.push(emit, self._decode_sink(live))
         return True
 
@@ -597,6 +655,17 @@ class ServeEngine:
         for name, vals in (("ttft", ttfts), ("tpot", tpots)):
             out[name] = {"p50": pct(vals, 50), "p95": pct(vals, 95),
                          "p99": pct(vals, 99)}
+        # per-request phase breakdown from trace instrumentation (filled
+        # while mx.trace was recording; None per phase otherwise)
+        phases = {}
+        for key, label in (("queue_wait", "queue_wait"),
+                           ("prefill", "prefill"),
+                           ("decode_step", "decode_per_token")):
+            vals = sorted(v for r in done for v in r.phases.get(key, ()))
+            phases[label] = None if not vals else {
+                "p50": pct(vals, 50), "p95": pct(vals, 95),
+                "p99": pct(vals, 99)}
+        out["phases"] = phases
         if self.quantize:
             pt, qt = self._params
             now, was = _quantize.quantized_bytes(pt, qt, self._qdtypes)

@@ -54,8 +54,9 @@ from .base import MXNetError
 
 __all__ = ["enable", "disable", "configure", "active", "inc", "set_gauge",
            "observe", "timed", "declare_metric", "note_compile", "counters",
-           "summary_line", "snapshot", "exposition", "reset",
-           "RecompileWarning", "TrainingTelemetry", "CATALOG"]
+           "summary_line", "snapshot", "exposition", "serve_http",
+           "stop_http", "reset", "RecompileWarning", "TrainingTelemetry",
+           "CATALOG", "EXPOSITION_CONTENT_TYPE"]
 
 _lock = threading.Lock()
 #: hot-path gate — instrumentation sites read this one attribute; False
@@ -189,6 +190,9 @@ declare_metric("autotune.search_seconds", "histogram",
 declare_metric("autotune.best_speedup", "gauge",
                "measured items/s of the autotune winner over the "
                "untuned default config")
+declare_metric("telemetry.scrape_duration_seconds", "gauge",
+               "wall time the ops endpoint spent rendering the last "
+               "/metrics exposition")
 declare_metric("autotune.cache_hits_total", "counter",
                "searches answered from the persisted winners file "
                "(fingerprint match, zero trials re-run)")
@@ -551,6 +555,103 @@ def exposition():
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+# -- stdlib ops endpoint ----------------------------------------------------
+
+#: the Prometheus text-format content type scrapers key parsing on
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_http_server = None
+
+
+def serve_http(port=None):
+    """Start the in-process ops endpoint (stdlib ``http.server``, daemon
+    thread) — the surface a fleet scrapes:
+
+    - ``GET /metrics``  — :func:`exposition` with the proper
+      ``Content-Type: text/plain; version=0.0.4`` header; each scrape
+      sets the ``telemetry.scrape_duration_seconds`` gauge.
+    - ``GET /healthz``  — liveness JSON (pid, telemetry/trace state).
+    - ``GET /trace?last=N`` — the newest N ``mx.trace`` spans as JSON.
+
+    ``port=None`` reads the ``telemetry.http_port`` knob
+    (``MXNET_TELEMETRY_PORT``); 0 binds an ephemeral port — read it back
+    from ``server.server_address[1]``.  Idempotent: a running server is
+    returned as-is; ``stop_http()`` shuts it down."""
+    global _http_server
+    if _http_server is not None:
+        return _http_server
+    import http.server
+    import urllib.parse
+
+    class _OpsHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):  # keep scrapes out of stderr
+            pass
+
+        def _send(self, code, body, ctype):
+            data = body.encode("utf-8") if isinstance(body, str) else body
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            url = urllib.parse.urlsplit(self.path)
+            if url.path == "/metrics":
+                t0 = time.perf_counter()
+                exposition()
+                set_gauge("telemetry.scrape_duration_seconds",
+                          time.perf_counter() - t0)
+                # render again so the gauge is visible in THIS scrape
+                self._send(200, exposition(), EXPOSITION_CONTENT_TYPE)
+            elif url.path == "/healthz":
+                from . import trace as _trace
+                self._send(200, json.dumps(
+                    {"status": "ok", "pid": os.getpid(),
+                     "telemetry_active": _active,
+                     "trace": _trace.stats()}), "application/json")
+            elif url.path == "/trace":
+                from . import trace as _trace
+                query = urllib.parse.parse_qs(url.query)
+                last = None
+                if "last" in query:
+                    try:
+                        last = int(query["last"][0])
+                    except ValueError:
+                        self._send(400, json.dumps(
+                            {"error": "last must be an integer"}),
+                            "application/json")
+                        return
+                self._send(200, json.dumps(
+                    {"spans": _trace.spans(last),
+                     "dropped": _trace.stats()["dropped"]}),
+                    "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": f"unknown path {url.path!r}",
+                     "paths": ["/metrics", "/healthz", "/trace?last=N"]}),
+                    "application/json")
+
+    if port is None:
+        port = int(_config.get("telemetry.http_port"))
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                             _OpsHandler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever,
+                     name="mx-telemetry-http", daemon=True).start()
+    _http_server = server
+    return server
+
+
+def stop_http():
+    """Shut the ops endpoint down (no-op when not running)."""
+    global _http_server
+    server, _http_server = _http_server, None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+
+
 # -- structured training run reports ---------------------------------------
 
 class TrainingTelemetry:
@@ -670,3 +771,11 @@ class TrainingTelemetry:
 # fault.py, so spawned workers and plain scripts inherit the switch
 if _config.get("telemetry.enable"):
     _active = True
+
+# MXNET_TELEMETRY_PORT=N arms the ops endpoint at import (best-effort:
+# a taken port must not kill the training job it observes)
+if _config.get("telemetry.http_port"):
+    try:
+        serve_http()
+    except OSError:
+        pass

@@ -1,0 +1,155 @@
+"""Trace CLI: inspect and validate mx.trace Chrome-trace exports.
+
+Works on the JSON ``mx.trace.export(path)`` writes (and on any
+chrome://tracing / Perfetto "JSON trace event" file with complete
+``ph: "X"`` events).  Prints ONE JSON summary line on stdout;
+diagnostics go to stderr.
+
+Usage:
+    # per-name span counts + the tree of the first recorded trace
+    python tools/trace.py summary mxtrace.json [--last N]
+
+    # CI: well-formedness + structural assertions (exit 1 on failure)
+    python tools/trace.py validate mxtrace.json \
+        --expect train.step \
+        --expect-child train.step=train.data_wait \
+        --expect-child serve.request=serve.decode_step
+
+``validate`` checks every event is a well-formed Chrome trace event
+(name/ph/ts/dur/pid/tid), ``--expect NAME`` requires at least one span
+with that name, and ``--expect-child PARENT=CHILD`` requires at least
+one PARENT span with a CHILD span parented to it (via the
+``args.span_id``/``args.parent_id`` links ``mx.trace`` records).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def fail(msg):
+    print(f"trace.py: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def load(path):
+    """Load + structurally validate one export -> list of events."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"{path}: not loadable as JSON ({e})")
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        fail(f"{path}: missing traceEvents (not a Chrome trace export)")
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        fail(f"{path}: traceEvents is not a list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            fail(f"{path}: traceEvents[{i}] is not an object")
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            fail(f"{path}: traceEvents[{i}] has no name")
+        if ev.get("ph") not in ("X", "B", "E", "i", "C", "M"):
+            fail(f"{path}: traceEvents[{i}] bad ph {ev.get('ph')!r}")
+        for key in ("ts", "pid", "tid"):
+            if not isinstance(ev.get(key), (int, float)):
+                fail(f"{path}: traceEvents[{i}] missing numeric {key}")
+        if ev["ph"] == "X" and not isinstance(ev.get("dur"), (int, float)):
+            fail(f"{path}: traceEvents[{i}] complete event without dur")
+        if ev["ph"] == "X" and ev["dur"] < 0:
+            fail(f"{path}: traceEvents[{i}] negative dur")
+    return events
+
+
+def by_span_id(events):
+    return {ev["args"]["span_id"]: ev for ev in events
+            if isinstance(ev.get("args"), dict)
+            and "span_id" in ev["args"]}
+
+
+def children_of(events):
+    """span_id -> [child events] via args.parent_id links."""
+    out = {}
+    for ev in events:
+        args = ev.get("args")
+        if isinstance(args, dict) and args.get("parent_id") is not None:
+            out.setdefault(args["parent_id"], []).append(ev)
+    return out
+
+
+def has_parent_child(events, parent_name, child_name):
+    kids = children_of(events)
+    for ev in events:
+        args = ev.get("args")
+        if ev.get("name") != parent_name or not isinstance(args, dict):
+            continue
+        for child in kids.get(args.get("span_id"), ()):
+            if child.get("name") == child_name:
+                return True
+    return False
+
+
+def render_tree(events, root, kids, depth=0, lines=None):
+    lines = [] if lines is None else lines
+    lines.append("  " * depth + f"{root['name']} ({root.get('dur', 0)}us)")
+    for child in sorted(kids.get(root["args"]["span_id"], ()),
+                        key=lambda e: e.get("ts", 0)):
+        render_tree(events, child, kids, depth + 1, lines)
+    return lines
+
+
+def summarize(events):
+    counts = {}
+    for ev in events:
+        counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+    spans = by_span_id(events)
+    roots = [ev for ev in spans.values()
+             if ev["args"].get("parent_id") not in spans]
+    return counts, roots
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=("summary", "validate"))
+    ap.add_argument("path")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only consider the newest N events")
+    ap.add_argument("--expect", action="append", default=[],
+                    metavar="NAME", help="require >=1 span named NAME")
+    ap.add_argument("--expect-child", action="append", default=[],
+                    metavar="PARENT=CHILD",
+                    help="require a CHILD span parented to a PARENT span")
+    args = ap.parse_args(argv)
+
+    events = load(args.path)
+    if args.last is not None:
+        events = sorted(events, key=lambda e: e.get("ts", 0))[-args.last:]
+    counts, roots = summarize(events)
+
+    if args.command == "summary":
+        kids = children_of(events)
+        roots.sort(key=lambda e: e.get("ts", 0))
+        for root in roots[:8]:
+            for line in render_tree(events, root, kids):
+                print(line, file=sys.stderr)
+        print(json.dumps({"events": len(events), "names": counts,
+                          "roots": len(roots)}))
+        return 0
+
+    for name in args.expect:
+        if name not in counts:
+            fail(f"expected a span named {name!r}; have {sorted(counts)}")
+    for pair in args.expect_child:
+        parent, _, child = pair.partition("=")
+        if not child:
+            fail(f"--expect-child wants PARENT=CHILD, got {pair!r}")
+        if not has_parent_child(events, parent, child):
+            fail(f"no {child!r} span parented to a {parent!r} span")
+    print(json.dumps({"ok": True, "events": len(events),
+                      "checked": len(args.expect) + len(args.expect_child)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
