@@ -48,6 +48,11 @@
 #                the XLA fallback, int4 weight bytes <=0.15x fp32, zero
 #                post-warmup recompiles with quantization enabled
 #                (docs/PERFORMANCE.md "Low-bit inference")
+#   lint       - framework-aware static analysis (tools/mxlint.py):
+#                trace-safety, donated-buffer, lock-order and registry
+#                drift rules over the whole tree, gated on ZERO new
+#                findings against ci/lint_baseline.json
+#                (docs/STATIC_ANALYSIS.md)
 #   nightly    - the slow bucket (MXNET_TEST_SLOW=1), reference
 #                tests/nightly analog
 #   tpu        - hardware-only: Mosaic kernel checks + full bench grid
@@ -56,7 +61,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|quantize|trace|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|quantize|trace|lint|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -279,6 +284,13 @@ serve() {
     JAX_PLATFORMS=cpu python benchmark/serve_throughput.py --assert
 }
 
+lint() {
+    echo "== lint: static-analysis suite (docs/STATIC_ANALYSIS.md) =="
+    python -m pytest tests/test_analyze.py -q
+    echo "== lint: mxlint over the tree (0 new findings vs baseline) =="
+    python tools/mxlint.py --baseline ci/lint_baseline.json --assert-clean
+}
+
 nightly() {
     echo "== nightly: slow bucket (reference tests/nightly analog) =="
     MXNET_TEST_SLOW=1 python -m pytest tests/ -q -m slow
@@ -315,8 +327,9 @@ case "$stage" in
     autotune) autotune ;;
     quantize) quantize ;;
     trace) trace ;;
+    lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune; quantize; trace ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune; quantize; trace; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

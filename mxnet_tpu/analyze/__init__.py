@@ -1,0 +1,21 @@
+"""``mx.analyze`` — framework-aware static analysis.
+
+AST-level enforcement of the invariants the runtime can only sample:
+trace purity (TRC), buffer-donation discipline (DON), lock ordering
+(LCK), and string-keyed registry coherence (REG).  See
+docs/STATIC_ANALYSIS.md for the rule catalog, baseline workflow, and
+waiver syntax; ``tools/mxlint.py`` is the CLI and the CI ``lint``
+stage gates on it.
+
+Stdlib-only by design: importing or running this package never
+imports jax and never executes the code under analysis.
+"""
+
+from .core import (  # noqa: F401
+    DEFAULT_ROOTS, Finding, RULES, apply_baseline, last_summary,
+    load_baseline, run_suite, write_baseline,
+)
+
+__all__ = ["run_suite", "Finding", "RULES", "DEFAULT_ROOTS",
+           "load_baseline", "write_baseline", "apply_baseline",
+           "last_summary"]

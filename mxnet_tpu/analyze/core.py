@@ -1,0 +1,425 @@
+"""Shared machinery for the ``mx.analyze`` static-analysis suite.
+
+Everything here is pure stdlib (``ast`` + ``re`` + ``json``) on purpose:
+the linter must be runnable in the ``sanity`` tier of CI without paying a
+jax import, and must never execute the code it inspects.
+
+The pieces:
+
+``Finding``
+    one diagnostic: rule id, file:line, message, fix hint, and the
+    stripped source line (``snippet``).  The baseline keys findings on
+    ``(rule, path, snippet)`` rather than the line *number*, so unrelated
+    edits that shift a file down do not invalidate the baseline.
+
+``ModuleInfo``
+    a parsed source file: AST, source lines, the import-alias map, the
+    parent map (``ast`` has no uplinks), and the inline-waiver table.
+
+``ImportMap``
+    resolves names/attribute chains back to canonical dotted module
+    paths (``jnp.asarray`` -> ``jax.numpy.asarray``,
+    ``_config.get`` -> ``mxnet_tpu.config.get``) including relative
+    imports (``from . import config as _config``).  Rules match against
+    canonical paths so aliasing cannot hide a violation — and so a
+    module-local dict that happens to be called ``_config`` (see
+    ``profiler.py``) is *not* mistaken for the knob registry.
+
+``run_suite``
+    the driver: discover files, parse, run every rule module, apply
+    inline waivers, and remember a rule->count summary for the
+    telemetry ``analyze`` plane.
+"""
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+# rule modules are imported lazily in run_suite to avoid a cycle
+# (trc/don/lck/reg each import core for Finding/helpers)
+
+__all__ = [
+    "Finding", "ModuleInfo", "ImportMap", "Context",
+    "run_suite", "load_baseline", "write_baseline", "apply_baseline",
+    "DEFAULT_ROOTS", "RULES",
+]
+
+# every rule id -> one-line description (drives --list-rules and docs)
+RULES = {
+    "TRC001": "host sync (asnumpy/.item()/np.asarray/float()) inside a "
+              "traced scope",
+    "TRC002": "impure call (time.*/random.*/np.random.*) inside a traced "
+              "scope",
+    "TRC003": "Python if/while branching on a traced value",
+    "TRC004": "traced closure captures a step-varying Python scalar",
+    "TRC005": "unconditional host sync in a per-batch hot path",
+    "DON001": "buffer read after being donated through donate_argnums",
+    "LCK001": "lock-acquisition cycle (potential deadlock)",
+    "LCK002": "blocking call (queue get/put, join, sleep, collective) "
+              "while holding a lock",
+    "REG001": "config knob read that is not declared in config.py",
+    "REG002": "declared config knob with no doc string",
+    "REG003": "metric recorded without a declare_metric declaration",
+    "REG004": "fault point not exercised by any test",
+    "REG005": "fault fire/armed on an unknown point name",
+    "REG006": "CI stage drift between ci/matrix.yaml and ci/run.sh",
+    "REG007": "declared metric missing from docs/OBSERVABILITY.md",
+    "REG008": "fault point missing from docs/FAULT_TOLERANCE.md",
+    "WVR001": "inline waiver without a reason string",
+}
+
+# directories scanned when the CLI is given no paths
+DEFAULT_ROOTS = ("mxnet_tpu", "tests", "benchmark", "tools", "example",
+                 "bench.py")
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "node_modules"}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+    snippet: str = ""    # stripped source line (baseline key component)
+    col: int = 0
+
+    def key(self):
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "hint": self.hint, "snippet": self.snippet}
+
+    def render(self):
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class ImportMap:
+    """Alias -> canonical dotted path, built from a module's imports."""
+
+    def __init__(self, tree, package):
+        # package: dotted package of the module itself ("" for scripts),
+        # used to resolve relative imports
+        self.map = {}
+        self.package = package
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.map[a.asname] = a.name
+                    else:
+                        # "import jax.numpy" binds "jax"
+                        head = a.name.split(".")[0]
+                        self.map.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bound = a.asname or a.name
+                    self.map[bound] = (base + "." + a.name) if base \
+                        else a.name
+
+    def _from_base(self, node):
+        if node.level == 0:
+            return node.module or ""
+        # relative: walk up from this module's package
+        parts = self.package.split(".") if self.package else []
+        up = node.level - 1
+        if up > len(parts):
+            return None
+        base_parts = parts[:len(parts) - up] if up else parts
+        if node.module:
+            base_parts = base_parts + node.module.split(".")
+        return ".".join(base_parts)
+
+    def resolve(self, node):
+        """Dotted canonical path for a Name/Attribute chain rooted at an
+        import, or None (locals, self.*, un-imported names)."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.map.get(node.id)
+        if head is None:
+            return None
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+# --- inline waivers ------------------------------------------------------
+# syntax:  # mxlint: disable=TRC001(reason),LCK002(another reason)
+# a waiver with no reason does NOT suppress and raises WVR001 instead.
+
+_WAIVER_RE = re.compile(r"#\s*mxlint:\s*disable=(.*)$")
+_WAIVER_ITEM_RE = re.compile(r"([A-Z]{3}\d{3})(?:\(([^()]*)\))?")
+
+
+def parse_waivers(lines):
+    """-> {lineno: {rule: reason_or_None}}; a comment-only line applies
+    to the next line as well (block style)."""
+    waivers = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _WAIVER_RE.search(raw)
+        if not m:
+            continue
+        items = {}
+        for rule, reason in _WAIVER_ITEM_RE.findall(m.group(1)):
+            reason = reason.strip()
+            items[rule] = reason or None
+        if not items:
+            continue
+        waivers.setdefault(i, {}).update(items)
+        if raw[:m.start()].strip() == "":
+            # standalone comment line: waive the following line too
+            waivers.setdefault(i + 1, {}).update(items)
+    return waivers
+
+
+class ModuleInfo:
+    """One parsed python source file plus derived lookup tables."""
+
+    def __init__(self, path, root):
+        self.abspath = os.path.abspath(path)
+        self.path = os.path.relpath(self.abspath, root).replace(os.sep, "/")
+        with open(self.abspath, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=self.path)
+        self.package = self._package_of(self.path)
+        self.imports = ImportMap(self.tree, self.package)
+        self.waivers = parse_waivers(self.lines)
+        self.parents = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    @staticmethod
+    def _package_of(relpath):
+        parts = relpath.split("/")
+        parts[-1] = parts[-1][:-3] if parts[-1].endswith(".py") else \
+            parts[-1]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        else:
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def snippet(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule, node_or_line, message, hint=""):
+        line = node_or_line if isinstance(node_or_line, int) \
+            else getattr(node_or_line, "lineno", 1)
+        col = 0 if isinstance(node_or_line, int) \
+            else getattr(node_or_line, "col_offset", 0)
+        return Finding(rule=rule, path=self.path, line=line, col=col,
+                       message=message, hint=hint,
+                       snippet=self.snippet(line))
+
+    def enclosing(self, node, kinds):
+        """Nearest ancestor of the given AST node types, or None."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+@dataclass
+class Context:
+    """Cross-file state shared by the rule modules."""
+    root: str
+    modules: list
+    # populated by reg.collect():
+    knobs: dict = field(default_factory=dict)      # name -> (mod, line, doc)
+    metrics: dict = field(default_factory=dict)    # name -> (mod, line)
+    fault_points: dict = field(default_factory=dict)  # name -> (mod, line)
+    test_strings: set = field(default_factory=set)
+
+    def module(self, relpath):
+        for m in self.modules:
+            if m.path == relpath or m.path.endswith("/" + relpath):
+                return m
+        return None
+
+
+def dotted_path(node):
+    """'self._step' / 'ws' for a Name/Attribute chain, else None.
+    Unlike ImportMap.resolve this keeps local roots — it names *objects*
+    in the current scope, not imported modules."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def iter_files(paths, root):
+    seen = set()
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            if ap not in seen:
+                seen.add(ap)
+                yield ap
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        fp = os.path.join(dirpath, fn)
+                        if fp not in seen:
+                            seen.add(fp)
+                            yield fp
+
+
+def find_repo_root(start=None):
+    cur = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isdir(os.path.join(cur, ".git")) or \
+                os.path.isfile(os.path.join(cur, "ci", "run.sh")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start or os.getcwd())
+        cur = parent
+
+
+# --- baseline ------------------------------------------------------------
+
+def load_baseline(path):
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    counts = {}
+    for e in doc.get("findings", []):
+        k = (e["rule"], e["path"], e.get("snippet", ""))
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def write_baseline(path, findings):
+    doc = {"version": 1,
+           "comment": "pre-existing mxlint findings waived for CI; "
+                      "regenerate with tools/mxlint.py --write-baseline",
+           "findings": [{"rule": f.rule, "path": f.path,
+                         "snippet": f.snippet}
+                        for f in sorted(findings,
+                                        key=lambda f: (f.path, f.line,
+                                                       f.rule))]}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(findings, baseline_counts):
+    """-> (new, waived): each baseline entry absorbs that many matching
+    findings (earliest lines first)."""
+    remaining = dict(baseline_counts)
+    new, waived = [], []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            waived.append(f)
+        else:
+            new.append(f)
+    return new, waived
+
+
+# --- driver --------------------------------------------------------------
+
+_last_summary = None
+
+
+def last_summary():
+    """Rule->count summary of the most recent run_suite() in this
+    process (the telemetry ``analyze`` plane), or None."""
+    return _last_summary
+
+
+def _apply_waivers(findings, modules):
+    by_path = {m.path: m for m in modules}
+    kept = []
+    for f in findings:
+        m = by_path.get(f.path)
+        if m is None:
+            kept.append(f)
+            continue
+        w = m.waivers.get(f.line, {})
+        if f.rule in w:
+            if w[f.rule] is None:
+                kept.append(m.finding(
+                    "WVR001", f.line,
+                    f"waiver for {f.rule} has no reason string",
+                    hint="write # mxlint: disable="
+                         f"{f.rule}(why this is safe)"))
+            # waived with a reason: suppressed
+        else:
+            kept.append(f)
+    return kept
+
+
+def run_suite(paths=None, root=None, rules=None):
+    """Run every rule over the given paths (default: the repo's own
+    source roots).  Returns raw findings with inline waivers already
+    applied; baseline subtraction is the caller's business."""
+    global _last_summary
+    from . import trc, don, lck, reg
+
+    root = os.path.abspath(root or find_repo_root())
+    paths = list(paths) if paths else [p for p in DEFAULT_ROOTS
+                                       if os.path.exists(
+                                           os.path.join(root, p))]
+    modules = []
+    findings = []
+    for fp in iter_files(paths, root):
+        try:
+            modules.append(ModuleInfo(fp, root))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            rel = os.path.relpath(fp, root).replace(os.sep, "/")
+            findings.append(Finding(
+                rule="WVR001", path=rel,
+                line=getattr(e, "lineno", 1) or 1,
+                message=f"file does not parse: {e}",
+                hint="fix the syntax error", snippet=""))
+    ctx = Context(root=root, modules=modules)
+    reg.collect(ctx)
+    for m in modules:
+        findings += trc.check(m, ctx)
+        findings += don.check(m, ctx)
+        findings += lck.check(m, ctx)
+        findings += reg.check(m, ctx)
+    findings += lck.check_global(ctx)
+    findings += reg.check_global(ctx)
+    findings = _apply_waivers(findings, modules)
+    if rules:
+        findings = [f for f in findings
+                    if any(f.rule.startswith(r) for r in rules)]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    _last_summary = {"total": len(findings), "files": len(modules),
+                     "rules": counts}
+    return findings

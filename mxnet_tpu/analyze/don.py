@@ -1,0 +1,154 @@
+"""DON — buffer-donation rules.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffer
+to XLA for reuse: after the call, the Python object still exists but
+its buffer is dead, and touching it raises (or worse, on some backends,
+silently reads garbage).  The serve engine's KV cache and the fused
+trainer update both rely on donation, and both follow the one safe
+idiom: *rebind the donated name from the call's results on the same
+statement* (``self.trainable, ... = self._step(self.trainable, ...)``).
+
+DON001 flags the unsafe shape: an argument passed at a donated position
+whose name is read again later in the same function without having been
+rebound by the donating call itself.  The analysis is function-local and
+straight-line (lineno order); a re-assignment before the next read
+clears the taint.
+"""
+
+import ast
+
+from .core import dotted_path
+
+
+def _donated_indices(call, imports):
+    """Indices from donate_argnums when `call` is jax.jit/pjit, else
+    None."""
+    target = imports.resolve(call.func)
+    if target in ("functools.partial", "partial") and call.args:
+        target = imports.resolve(call.args[0])
+    if target not in ("jax.jit", "jax.pjit",
+                      "jax.experimental.pjit.pjit", "jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out)
+    return None
+
+
+def _collect_donating_callables(module):
+    """Paths ('self._step', 'step_fn') bound to a donating jit, plus
+    direct-call sites jax.jit(f, donate_argnums=...)(args).
+    -> ({path: indices}, {call_node: indices})"""
+    bound, direct = {}, {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        idx = _donated_indices(node, module.imports)
+        if idx is None:
+            continue
+        parent = module.parents.get(node)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                p = dotted_path(t)
+                if p:
+                    bound[p] = idx
+        elif isinstance(parent, ast.Call) and parent.func is node:
+            direct[parent] = idx
+    return bound, direct
+
+
+def _stmt_of(module, node):
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = module.parents.get(cur)
+    return cur
+
+
+def _target_paths(stmt):
+    out = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                       else [t]):
+                p = dotted_path(el)
+                if p:
+                    out.add(p)
+    elif isinstance(stmt, ast.AugAssign):
+        p = dotted_path(stmt.target)
+        if p:
+            out.add(p)
+    return out
+
+
+def check(module, ctx):
+    findings = []
+    bound, direct = _collect_donating_callables(module)
+    if not bound and not direct:
+        return findings
+
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            if call in direct:
+                idx = direct[call]
+            else:
+                p = dotted_path(call.func)
+                idx = bound.get(p) if p else None
+            if idx is None:
+                continue
+            stmt = _stmt_of(module, call)
+            if stmt is None:
+                continue
+            rebound = _target_paths(stmt)
+            donated = []
+            for i in idx:
+                if i < len(call.args) and not isinstance(
+                        call.args[i], ast.Starred):
+                    path = dotted_path(call.args[i])
+                    if path and path not in rebound:
+                        donated.append((i, path))
+            if not donated:
+                continue
+            # straight-line scan: first later event per donated path
+            for i, path in donated:
+                event = None  # ("load"|"store", node)
+                for node in ast.walk(fn):
+                    ln = getattr(node, "lineno", None)
+                    if ln is None or ln <= stmt.lineno:
+                        continue
+                    np_ = dotted_path(node) if isinstance(
+                        node, (ast.Name, ast.Attribute)) else None
+                    if np_ != path:
+                        continue
+                    # only top-level matches: skip when this node is a
+                    # sub-chain of a longer attribute path
+                    par = module.parents.get(node)
+                    if isinstance(par, ast.Attribute) and \
+                            par.value is node:
+                        continue
+                    kind = "store" if isinstance(
+                        getattr(node, "ctx", None), ast.Store) else "load"
+                    if event is None or ln < event[1]:
+                        event = (kind, ln, node)
+                if event and event[0] == "load":
+                    findings.append(module.finding(
+                        "DON001", event[2],
+                        f"{path!r} is read after being donated at "
+                        f"line {stmt.lineno} (argument {i} of a "
+                        "donate_argnums call) — its buffer is dead",
+                        hint="rebind the name from the call's results "
+                             "on the same statement, or drop it from "
+                             "donate_argnums"))
+    return findings

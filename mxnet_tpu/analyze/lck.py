@@ -1,0 +1,254 @@
+"""LCK — lock-order and blocking-under-lock rules.
+
+``mx.pipeline``, the DataLoader, telemetry and trace all guard shared
+rings with plain ``threading.Lock``s.  Two hazards repeat across that
+code:
+
+* **LCK001** — two code paths acquiring the same pair of locks in
+  opposite orders (classic deadlock).  The rule extracts every ``with
+  <lock>:`` nesting (lexically, plus one level of same-module call
+  resolution so ``with self._lock: self._flush()`` sees the locks
+  ``_flush`` takes) into a global acquisition graph and fails on
+  cycles.
+* **LCK002** — a call that can block indefinitely (queue ``get``/
+  ``put``, ``join``, ``sleep``, a collective) while a lock is held:
+  every other thread touching that lock now waits on the slow path.
+  The fault-telemetry deadlock fixed in PR 2 (``record()`` calling
+  ``inc()`` under ``_lock``) is the house example.
+
+Lock objects are recognised by name (a ``with`` target whose final
+path segment contains ``lock`` or ``mutex``) and identified as
+``module.Class.attr`` so distinct classes' ``self._lock`` stay
+distinct nodes in the graph.
+"""
+
+import ast
+
+from .core import dotted_path
+
+_BLOCKING_RESOLVED = {"time.sleep"}
+_BLOCKING_PREFIXES = ("jax.lax.p",)           # psum/pmean/pmax/...
+_BLOCKING_RESOLVED_SUFFIX = (".all_gather", ".all_reduce", ".barrier")
+_QUEUEISH = ("queue",)
+
+
+def _is_lock_path(path):
+    if not path:
+        return False
+    last = path.split(".")[-1].lower()
+    return "lock" in last or "mutex" in last
+
+
+def _queueish(seg):
+    seg = seg.lower()
+    return seg == "q" or seg.endswith("_q") or any(
+        s in seg for s in _QUEUEISH)
+
+
+def _lock_id(module, fn, path):
+    cls = module.enclosing(fn, (ast.ClassDef,))
+    scope = cls.name if cls is not None else fn.name
+    # 'self._lock' and bare '_lock' (module global) normalise so the
+    # same lock referenced both ways is one graph node
+    norm = path[5:] if path.startswith("self.") else path
+    if path.startswith("self."):
+        return f"{module.path}:{scope}.{norm}"
+    return f"{module.path}:{norm}"
+
+
+def _blocking_reason(module, call):
+    """Short description when `call` can block indefinitely, else
+    None."""
+    resolved = module.imports.resolve(call.func)
+    if resolved:
+        if resolved in _BLOCKING_RESOLVED:
+            return resolved
+        if resolved.startswith(_BLOCKING_PREFIXES) or \
+                resolved.endswith(_BLOCKING_RESOLVED_SUFFIX):
+            return f"collective {resolved}"
+        return None
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = dotted_path(call.func.value)
+    recv_seg = recv.split(".")[-1] if recv else ""
+    kwargs = {kw.arg for kw in call.keywords}
+    if attr in ("get", "put"):
+        if _queueish(recv_seg) or {"timeout", "block"} & kwargs:
+            return f"{recv or '?'}.{attr}()"
+    elif attr == "join" and not call.args:
+        # str.join takes a positional arg, thread/queue join takes none
+        return f"{recv or '?'}.join()"
+    return None
+
+
+class _FuncSummary:
+    """Per-function lock behaviour, lexical only."""
+
+    def __init__(self, module, fn):
+        self.module = module
+        self.fn = fn
+        self.acquires = []      # (lock_id, node, held_stack_at_entry)
+        self.calls_under = []   # (held_stack, call_node)
+        self.blocking = []      # (held_stack, call_node, reason)
+        for child in ast.iter_child_nodes(fn):
+            self._walk(child, [])
+
+    def _locks_of(self, with_node):
+        out = []
+        for item in with_node.items:
+            path = dotted_path(item.context_expr)
+            if path is None and isinstance(item.context_expr, ast.Call):
+                path = dotted_path(item.context_expr.func)
+            if _is_lock_path(path):
+                out.append(_lock_id(self.module, self.fn, path))
+        return out
+
+    def _walk(self, node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs summarised separately
+        if isinstance(node, ast.With):
+            new = list(held)
+            for lid in self._locks_of(node):
+                self.acquires.append((lid, node, tuple(new)))
+                new.append(lid)
+            for b in node.body:
+                self._walk(b, new)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                self.calls_under.append((tuple(held), node))
+            reason = _blocking_reason(self.module, node)
+            if reason:
+                # recorded even with no lock held so that a caller
+                # holding one can see this callee blocks
+                self.blocking.append((tuple(held), node, reason))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+def _summaries(module):
+    out = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = module.enclosing(node, (ast.ClassDef,))
+            qual = f"{cls.name}.{node.name}" if cls else node.name
+            out[(module.path, qual)] = _FuncSummary(module, node)
+    return out
+
+
+def _resolve_callee(module, fn_summary, call):
+    """'self.foo(...)' -> same-class method foo; 'bar(...)' -> module
+    function bar.  One level only, same module only."""
+    path = dotted_path(call.func)
+    if not path:
+        return None
+    cls = module.enclosing(fn_summary.fn, (ast.ClassDef,))
+    if path.startswith("self.") and "." not in path[5:] and cls:
+        return (module.path, f"{cls.name}.{path[5:]}")
+    if "." not in path:
+        return (module.path, path)
+    return None
+
+
+def check(module, ctx):
+    """LCK002 per module (lexical + one call level)."""
+    findings = []
+    sums = _summaries(module)
+    module._lck_summaries = sums  # stashed for check_global
+    for key, s in sums.items():
+        for held, node, reason in s.blocking:
+            if not held:
+                continue  # blocking with no lock held is fine
+            findings.append(module.finding(
+                "LCK002", node,
+                f"blocking call {reason} while holding "
+                f"{_short(held[-1])}",
+                hint="release the lock before blocking, or bound the "
+                     "wait and handle timeout"))
+        # one level of call resolution: callee's top-level blocking
+        # calls and acquisitions count as happening under our lock
+        for held, call in s.calls_under:
+            callee_key = _resolve_callee(module, s, call)
+            if callee_key is None or callee_key == key:
+                continue
+            callee = sums.get(callee_key)
+            if callee is None:
+                continue
+            for cheld, cnode, reason in callee.blocking:
+                if cheld:
+                    continue  # counted at its own site
+                findings.append(module.finding(
+                    "LCK002", call,
+                    f"call to {callee_key[1]}() blocks ({reason}) "
+                    f"while holding {_short(held[-1])}",
+                    hint="release the lock before calling into a "
+                         "blocking helper, or bound the wait"))
+    return findings
+
+
+def _short(lock_id):
+    return lock_id.split(":", 1)[-1]
+
+
+def check_global(ctx):
+    """LCK001: cycle detection over the cross-module acquisition
+    graph."""
+    edges = {}   # (a, b) -> (module, node) first witness
+    for m in ctx.modules:
+        sums = getattr(m, "_lck_summaries", None)
+        if not sums:
+            continue
+        for key, s in sums.items():
+            for lid, node, held in s.acquires:
+                for h in held:
+                    if h != lid:
+                        edges.setdefault((h, lid), (m, node))
+            # call-level edges: lock held here -> locks callee takes
+            for held, call in s.calls_under:
+                callee_key = _resolve_callee(m, s, call)
+                if callee_key is None or callee_key == key:
+                    continue
+                callee = sums.get(callee_key)
+                if callee is None:
+                    continue
+                for clid, cnode, cheld in callee.acquires:
+                    if not cheld:
+                        for h in held:
+                            if h != clid:
+                                edges.setdefault((h, clid), (m, call))
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    findings = []
+    seen_cycles = set()
+    for start in sorted(graph):
+        path, on_path = [], set()
+
+        def dfs(node):
+            if node in on_path:
+                cyc = tuple(path[path.index(node):] + [node])
+                canon = frozenset(cyc)
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    a, b = cyc[0], cyc[1]
+                    m, witness = edges[(a, b)]
+                    findings.append(m.finding(
+                        "LCK001", witness,
+                        "lock-order cycle: " + " -> ".join(
+                            _short(x) for x in cyc),
+                        hint="pick one global acquisition order for "
+                             "these locks and stick to it"))
+                return
+            if node in graph:
+                path.append(node)
+                on_path.add(node)
+                for nxt in sorted(graph[node]):
+                    dfs(nxt)
+                path.pop()
+                on_path.remove(node)
+
+        dfs(start)
+    return findings

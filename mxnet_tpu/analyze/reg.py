@@ -1,0 +1,327 @@
+"""REG — string-keyed registry drift rules.
+
+The framework's registries are stringly typed on purpose (env-var
+configuration, Prometheus names, fault-spec strings survive process
+boundaries), which means nothing but convention keeps a call site and
+its declaration in sync.  ``mx.config.get`` raises on an unknown knob
+and ``mx.fault.fire`` *silently returns False* on an unknown point —
+the first fails loudly at runtime, the second never fails at all.
+These rules close the loop statically:
+
+* **REG001** — every ``config.get("k")`` names a knob declared in
+  ``config.py``/``storage.py``.  The receiver is resolved through the
+  import map, so a module-local dict named ``_config`` (profiler.py)
+  is not confused with the registry.
+* **REG002** — every declared knob carries a non-empty ``doc=``.
+* **REG003** — every literally-named metric record (``inc``/
+  ``observe``/``set_gauge``/``timed`` on the telemetry module) is
+  declared via ``declare_metric`` somewhere in the tree.  Dynamic
+  names are skipped; an ``IfExp`` of two literals checks both arms.
+* **REG004** — every ``mx.fault`` point appears in at least one test.
+* **REG005** — ``fire``/``armed`` with a literal name not in POINTS.
+* **REG006** — ci/matrix.yaml stages, ci/run.sh case labels, and the
+  ``all`` chain agree (scheduled stages are exempt from ``all``).
+* **REG007** — every declared metric appears in
+  docs/OBSERVABILITY.md (whose metric table the telemetry module
+  documents as authoritative).
+* **REG008** — every fault point appears in docs/FAULT_TOLERANCE.md's
+  injection-point table (it is how users learn what MXNET_FAULT_SPEC
+  can arm).
+"""
+
+import ast
+import os
+import re
+
+_METRIC_FUNCS = {"inc", "observe", "set_gauge", "timed"}
+_TELEMETRY_MODULES = ("mxnet_tpu.telemetry",)
+_CONFIG_MODULES = ("mxnet_tpu.config",)
+_FAULT_MODULES = ("mxnet_tpu.fault",)
+
+
+def _literal_names(node):
+    """String constants named by an expression: a literal, or both arms
+    of a conditional expression.  Dynamic expressions -> []."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _literal_names(node.body) + _literal_names(node.orelse)
+    return []
+
+
+def _is_module_ref(module, node, canonical_modules):
+    """True when `node` (the receiver of an attribute call) resolves to
+    one of the canonical module paths."""
+    return module.imports.resolve(node) in canonical_modules
+
+
+def collect(ctx):
+    """First pass: build the declared-name tables off the parsed
+    modules (no file re-reads, no imports executed)."""
+    for m in ctx.modules:
+        base = os.path.basename(m.path)
+        # knob declarations: declare("name", ..., doc=...) inside
+        # config.py/storage.py, or config.declare(...) anywhere
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+                in_registry_file = base in ("config.py", "storage.py")
+                is_decl = fname == "declare" and in_registry_file
+            elif isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+                is_decl = fname == "declare" and \
+                    m.imports.resolve(node.func.value) in _CONFIG_MODULES
+            else:
+                continue
+            if is_decl and node.args:
+                for name in _literal_names(node.args[0]):
+                    # declare(name, typ, default, env, doc) — doc is the
+                    # 5th positional in config.py's own style, or doc=
+                    doc = ""
+                    if len(node.args) >= 5 and isinstance(
+                            node.args[4], ast.Constant):
+                        doc = node.args[4].value or ""
+                    for kw in node.keywords:
+                        if kw.arg == "doc" and isinstance(
+                                kw.value, ast.Constant):
+                            doc = kw.value.value or ""
+                    ctx.knobs[name] = (m, node.lineno, doc)
+            if fname == "declare_metric" and node.args:
+                for name in _literal_names(node.args[0]):
+                    ctx.metrics.setdefault(name, (m, node.lineno))
+        # fault points: the POINTS = {...} dict in fault.py
+        if base == "fault.py":
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "POINTS"
+                        for t in node.targets) and \
+                        isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            ctx.fault_points[k.value] = (m, k.lineno)
+        # strings appearing in tests (for REG004); f-string literal
+        # fragments count too — specs like f"{point}:at=2" do not,
+        # which is the conservative direction
+        if "/tests/" in "/" + m.path or m.path.startswith("tests/"):
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    ctx.test_strings.add(node.value)
+
+
+def check(module, ctx):
+    findings = []
+    base = os.path.basename(module.path)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        recv = node.func.value
+        # REG001: config knob reads
+        if attr == "get" and node.args and base not in (
+                "config.py",) and _is_module_ref(
+                    module, recv, _CONFIG_MODULES):
+            for name in _literal_names(node.args[0]):
+                if name not in ctx.knobs:
+                    findings.append(module.finding(
+                        "REG001", node,
+                        f"config knob {name!r} is read but never "
+                        "declared in config.py",
+                        hint="add config.declare(...) with a doc "
+                             "string, or fix the knob name"))
+        # REG003: metric records against the telemetry registry
+        elif attr in _METRIC_FUNCS and node.args and _is_module_ref(
+                module, recv, _TELEMETRY_MODULES):
+            for name in _literal_names(node.args[0]):
+                if name not in ctx.metrics:
+                    findings.append(module.finding(
+                        "REG003", node,
+                        f"metric {name!r} is recorded but never "
+                        "declared via declare_metric",
+                        hint="declare it (name, kind, doc) next to "
+                             "the subsystem's other metrics"))
+        # REG005: fault points
+        elif attr in ("fire", "armed") and node.args and \
+                base != "fault.py" and _is_module_ref(
+                    module, recv, _FAULT_MODULES):
+            for name in _literal_names(node.args[0]):
+                if name not in ctx.fault_points:
+                    findings.append(module.finding(
+                        "REG005", node,
+                        f"fault point {name!r} is not in fault.POINTS "
+                        "— fire() on it silently never fires",
+                        hint="add the point to fault.POINTS or fix "
+                             "the name"))
+    # bare inc("x")/observe("x") inside telemetry.py itself
+    if base == "telemetry.py":
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in _METRIC_FUNCS and node.args:
+                for name in _literal_names(node.args[0]):
+                    if name not in ctx.metrics:
+                        findings.append(module.finding(
+                            "REG003", node,
+                            f"metric {name!r} is recorded but never "
+                            "declared via declare_metric",
+                            hint="declare it in the catalog"))
+    return findings
+
+
+# --- global checks -------------------------------------------------------
+
+_STAGE_RE = re.compile(r"^\s*-\s*stage:\s*(\S+)")
+_SCHED_RE = re.compile(r"^\s*schedule:")
+_CASE_RE = re.compile(r"^\s*([a-z_]+)\)")
+
+
+def _parse_matrix(path):
+    """-> [(stage, lineno, scheduled)] from ci/matrix.yaml (regex — the
+    file is ours and flat; no yaml dependency in the linter)."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    current = None
+    for i, line in enumerate(lines, start=1):
+        m = _STAGE_RE.match(line)
+        if m:
+            current = [m.group(1), i, False]
+            out.append(current)
+        elif current is not None and _SCHED_RE.match(line):
+            current[2] = True
+    return [(s, ln, sched) for s, ln, sched in out]
+
+
+def _parse_run_sh(path):
+    """-> (case_labels {stage: lineno}, all_chain [stages])."""
+    cases, all_chain = {}, []
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    in_case = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        if stripped.startswith("case "):
+            in_case = True
+        if not in_case:
+            continue
+        m = _CASE_RE.match(line)
+        if m and m.group(1) != "all":
+            cases[m.group(1)] = i
+        if stripped.startswith("all)"):
+            body = stripped[len("all)"):].split(";;")[0]
+            all_chain = [p.strip() for p in body.split(";")
+                        if p.strip()]
+    return cases, all_chain
+
+
+def check_global(ctx):
+    findings = []
+
+    # REG002: undocumented knobs (framework declarations only — tests
+    # may declare scratch knobs)
+    for name, (m, line, doc) in sorted(ctx.knobs.items()):
+        if not doc.strip() and m.path.startswith("mxnet_tpu/"):
+            findings.append(m.finding(
+                "REG002", line,
+                f"config knob {name!r} is declared without a doc "
+                "string",
+                hint="knobs are user API: say what it does and which "
+                     "env var sets it"))
+
+    # REG004: fault points no test exercises.  Substring match: fault
+    # specs in tests look like "resilience.preempt:at=3", which counts.
+    for name, (m, line) in sorted(ctx.fault_points.items()):
+        if ctx.test_strings and not any(
+                name in s for s in ctx.test_strings):
+            findings.append(m.finding(
+                "REG004", line,
+                f"fault point {name!r} is not referenced by any test",
+                hint="add a chaos test that arms and fires it (see "
+                     "tests/test_fault_injection.py)"))
+
+    # REG006: CI stage drift
+    matrix_path = os.path.join(ctx.root, "ci", "matrix.yaml")
+    run_path = os.path.join(ctx.root, "ci", "run.sh")
+    if os.path.isfile(matrix_path) and os.path.isfile(run_path):
+        matrix = _parse_matrix(matrix_path)
+        cases, all_chain = _parse_run_sh(run_path)
+        rel_matrix = os.path.relpath(matrix_path, ctx.root)
+        rel_run = os.path.relpath(run_path, ctx.root)
+        for stage, line, scheduled in matrix:
+            if stage not in cases:
+                findings.append(_file_finding(
+                    rel_matrix, line, "REG006",
+                    f"stage {stage!r} is in ci/matrix.yaml but has no "
+                    "case in ci/run.sh",
+                    "add the stage function and case arm to ci/run.sh",
+                    matrix_path))
+            elif not scheduled and stage not in all_chain:
+                findings.append(_file_finding(
+                    rel_matrix, line, "REG006",
+                    f"PR-blocking stage {stage!r} is missing from the "
+                    "'all' chain in ci/run.sh",
+                    "append it to the all) arm (scheduled stages are "
+                    "exempt)", matrix_path))
+        matrix_names = {s for s, _, _ in matrix}
+        for stage, line in sorted(cases.items()):
+            if stage not in matrix_names:
+                findings.append(_file_finding(
+                    rel_run, line, "REG006",
+                    f"stage {stage!r} is in ci/run.sh but absent from "
+                    "ci/matrix.yaml",
+                    "add a matrix row (platform + env) for it",
+                    run_path))
+
+    # REG007: declared metrics missing from the observability doc
+    # (framework declarations only — tests declare scratch metrics)
+    doc_path = os.path.join(ctx.root, "docs", "OBSERVABILITY.md")
+    if os.path.isfile(doc_path) and ctx.metrics:
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+        for name, (m, line) in sorted(ctx.metrics.items()):
+            if name not in doc_text and m.path.startswith("mxnet_tpu/"):
+                findings.append(m.finding(
+                    "REG007", line,
+                    f"declared metric {name!r} is missing from "
+                    "docs/OBSERVABILITY.md",
+                    hint="add a row to the metrics table (the "
+                         "catalog docstring promises the doc tracks "
+                         "it)"))
+
+    # REG008: fault points missing from the fault-tolerance doc — the
+    # injection-point table is how users learn what MXNET_FAULT_SPEC
+    # can arm
+    ft_path = os.path.join(ctx.root, "docs", "FAULT_TOLERANCE.md")
+    if os.path.isfile(ft_path) and ctx.fault_points:
+        with open(ft_path, encoding="utf-8") as f:
+            ft_text = f.read()
+        for name, (m, line) in sorted(ctx.fault_points.items()):
+            if name not in ft_text:
+                findings.append(m.finding(
+                    "REG008", line,
+                    f"fault point {name!r} is missing from "
+                    "docs/FAULT_TOLERANCE.md",
+                    hint="document it in the injection-point list "
+                         "(what it simulates, which knob arms it)"))
+    return findings
+
+
+def _file_finding(relpath, line, rule, message, hint, abspath):
+    from .core import Finding
+    snippet = ""
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        if 1 <= line <= len(lines):
+            snippet = lines[line - 1].strip()
+    except OSError:
+        pass
+    return Finding(rule=rule, path=relpath.replace(os.sep, "/"),
+                   line=line, message=message, hint=hint,
+                   snippet=snippet)

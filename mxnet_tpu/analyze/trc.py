@@ -1,0 +1,452 @@
+"""TRC — trace-safety rules.
+
+The hybridize/CachedOp contract (PAPER.md §2) says a traced graph must
+be pure and replayable: no host syncs, no wall-clock or host-RNG reads,
+no Python control flow on traced values.  The runtime half of that
+contract is the PR 2 ``RecompileWarning`` detector and the PR 4
+``sync_guard``; these rules are the static half, catching violations in
+code paths the sampled runtime probes never execute.
+
+Traced scopes are found, not annotated: any ``hybrid_forward``, any
+function decorated with or passed to ``jax.jit`` / ``shard_map`` /
+``lax.scan`` / ``jax.checkpoint`` (and friends), and anything nested
+inside one.
+
+TRC005 is the odd one out — it covers *host* code that runs once per
+batch (estimator ``batch_end`` handlers and the serve/train step
+methods): a host sync there is legal but stalls the device pipeline
+every single step, which is exactly the bug class sync_guard exists
+for.  Syncs under an emit-interval gate (an ``if`` whose condition
+computes ``step % interval``) pass; a bare None-check does not.
+"""
+
+import ast
+
+from .core import dotted_path
+
+# canonical dotted paths whose function argument becomes a traced scope
+TRACED_WRAPPERS = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.checkpoint", "jax.remat", "jax.ad_checkpoint.checkpoint",
+    "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.maps.xmap",
+}
+
+# .attr() calls that force a device->host transfer
+SYNC_METHODS = {"asnumpy", "item", "tolist", "to_py", "block_until_ready"}
+
+# canonical call targets that materialise a traced value on host
+SYNC_CALLS = {"numpy.asarray", "numpy.array", "numpy.asanyarray",
+              "numpy.copyto"}
+
+# canonical prefixes that are impure inside a trace
+IMPURE_PREFIXES = ("time.", "random.", "numpy.random.")
+
+# attribute reads on a traced value that stay static under tracing
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+# builtins whose result on a traced value is host-static (shape-level)
+STATIC_FUNCS = {"len", "isinstance", "hasattr", "callable", "getattr",
+                "type", "id"}
+
+# host builtins that force a concrete value out of a traced array
+COERCE_FUNCS = {"float", "int", "bool", "complex"}
+
+# per-batch host hot paths checked by TRC005 (Class.method); estimator
+# BatchEnd handlers are detected structurally on top of this list
+HOT_PATHS = {
+    ("ServeEngine", "step"),
+    ("ShardedTrainStep", "__call__"),
+    ("DevicePrefetcher", "__next__"),
+}
+
+
+def _unwrap_partial(call, imports):
+    """functools.partial(jax.jit, ...) -> jax.jit (canonical path)."""
+    target = imports.resolve(call.func)
+    if target in ("functools.partial", "partial"):
+        if call.args:
+            return imports.resolve(call.args[0])
+        return None
+    return target
+
+
+def _param_names(fn):
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _static_param_names(call, fn, target=None):
+    """Parameters of `fn` the wrapper treats as static python values:
+    jit static_argnames/static_argnums, vmap/pmap in_axes=None
+    positions."""
+    out = set()
+    names = _param_names(fn) if fn is not None else []
+    kw = {k.arg: k.value for k in call.keywords}
+    v = kw.get("static_argnames")
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        out.add(v.value)
+    elif isinstance(v, (ast.Tuple, ast.List)):
+        out |= {e.value for e in v.elts
+                if isinstance(e, ast.Constant) and
+                isinstance(e.value, str)}
+    v = kw.get("static_argnums")
+    nums = []
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        nums = [v.value]
+    elif isinstance(v, (ast.Tuple, ast.List)):
+        nums = [e.value for e in v.elts
+                if isinstance(e, ast.Constant) and
+                isinstance(e.value, int)]
+    for i in nums:
+        if 0 <= i < len(names):
+            out.add(names[i])
+    # vmap/pmap: in_axes=None (or a None element) means the argument is
+    # broadcast as-is — a python scalar there stays concrete
+    v = kw.get("in_axes")
+    if v is None and len(call.args) >= 2 and target is not None and \
+            target.split(".")[-1] in ("vmap", "pmap"):
+        v = call.args[1]
+    if isinstance(v, (ast.Tuple, ast.List)):
+        for i, e in enumerate(v.elts):
+            if isinstance(e, ast.Constant) and e.value is None and \
+                    i < len(names):
+                out.add(names[i])
+    return out
+
+
+def _scope_of(module, node):
+    """The function/module that lexically owns a def (for scope-aware
+    name resolution)."""
+    return module.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda, ast.Module)) or module.tree
+
+
+def _in_scope(module, defnode, call):
+    """True when the def's name is visible at the call site: the def's
+    owning scope is the call's own function or one of its ancestors
+    (module-level defs are visible everywhere in the module)."""
+    owner = _scope_of(module, defnode)
+    cur = call
+    while cur is not None:
+        if cur is owner:
+            return True
+        cur = module.parents.get(cur)
+    return owner is module.tree
+
+
+def _traced_functions(module):
+    """-> (traced set of FunctionDef/Lambda, {fn: static param names})."""
+    defs = {}  # name -> [FunctionDef]
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    traced = set()
+    statics = {}
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == "hybrid_forward":
+                traced.add(node)
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    target = _unwrap_partial(dec, module.imports)
+                    if target in TRACED_WRAPPERS:
+                        traced.add(node)
+                        statics.setdefault(node, set()).update(
+                            _static_param_names(dec, node, target))
+                else:
+                    if module.imports.resolve(dec) in TRACED_WRAPPERS:
+                        traced.add(node)
+        elif isinstance(node, ast.Call):
+            target = _unwrap_partial(node, module.imports)
+            if target not in TRACED_WRAPPERS:
+                continue
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    # passed by name: only defs whose scope encloses
+                    # this call (or module level) — same-named defs in
+                    # sibling functions are different objects
+                    for d in defs[arg.id]:
+                        if _in_scope(module, d, node):
+                            traced.add(d)
+                            statics.setdefault(d, set()).update(
+                                _static_param_names(node, d, target))
+
+    # everything nested inside a traced function is traced too
+    out = set(traced)
+    for fn in traced:
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and sub is not fn:
+                out.add(sub)
+    return out, statics
+
+
+def _params_of(fn):
+    args = getattr(fn, "args", None)
+    if args is None:
+        return set()
+    names = [a.arg for a in args.posonlyargs + args.args +
+             args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    # self/cls carry the module, not traced data
+    return {n for n in names if n not in ("self", "cls", "F")}
+
+
+def _taint(fn, static_params=()):
+    """Names in fn plausibly bound to traced values: the parameters
+    (minus declared-static ones), plus anything assigned from an
+    expression reaching one through a dynamic channel (iterated to a
+    fixpoint).  ``c, h, w = img.shape`` does NOT taint c/h/w — shape,
+    dtype, len() etc. are static under tracing."""
+    tainted = _params_of(fn) - set(static_params)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AugAssign):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                value = node.iter
+                targets = [node.target]
+            else:
+                continue
+            if _dynamic_taint_in(value, tainted) is not None:
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and \
+                                n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+    return tainted
+
+
+def _is_static_expr(node, tainted):
+    """True when the expression only touches traced values through
+    static channels (shape/dtype/len/isinstance/`is None`)."""
+    if isinstance(node, ast.Call):
+        fname = node.func.id if isinstance(node.func, ast.Name) else None
+        if fname in STATIC_FUNCS:
+            return True
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in node.ops):
+            return True
+    if isinstance(node, ast.Attribute):
+        # x.shape, x.ndim — and anything hanging off them
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            if cur.attr in STATIC_ATTRS:
+                return True
+            cur = cur.value
+    return False
+
+
+def _dynamic_taint_in(test, tainted):
+    """The first tainted Name reached through a non-static channel in a
+    branch condition, or None."""
+    skip = set()
+    for node in ast.walk(test):
+        if node in skip:
+            continue
+        if _is_static_expr(node, tainted):
+            for sub in ast.walk(node):
+                skip.add(sub)
+            continue
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return node
+    return None
+
+
+def _check_traced_body(module, fn, findings, static_params=()):
+    tainted = _taint(fn, static_params)
+    own_nested = {sub for sub in ast.walk(fn)
+                  if isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                  and sub is not fn}
+    fname = getattr(fn, "name", "<lambda>")
+
+    for node in ast.walk(fn):
+        # nodes belonging to a nested def get their own pass with their
+        # own taint set — skip them here to avoid duplicate findings
+        owner = module.enclosing(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+        if owner is not fn and owner in own_nested:
+            continue
+        if isinstance(node, ast.Call):
+            # host-sync methods: x.asnumpy(), loss.item()
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in SYNC_METHODS and \
+                    module.imports.resolve(node.func) is None:
+                findings.append(module.finding(
+                    "TRC001", node,
+                    f".{node.func.attr}() forces a host sync inside "
+                    f"traced scope {fname!r}",
+                    hint="keep device values on device; move the sync "
+                         "outside the traced function"))
+                continue
+            target = module.imports.resolve(node.func)
+            if target in SYNC_CALLS:
+                findings.append(module.finding(
+                    "TRC001", node,
+                    f"{target}() materialises a traced value on host "
+                    f"inside traced scope {fname!r}",
+                    hint="use jax.numpy inside traced code"))
+            elif target and target.startswith(IMPURE_PREFIXES):
+                findings.append(module.finding(
+                    "TRC002", node,
+                    f"impure call {target}() inside traced scope "
+                    f"{fname!r} bakes one sample into the compiled "
+                    "graph",
+                    hint="thread a jax.random key (or pass the value "
+                         "in as an argument)"))
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in COERCE_FUNCS and node.args:
+                if _dynamic_taint_in(node.args[0], tainted) is not None:
+                    findings.append(module.finding(
+                        "TRC001", node,
+                        f"{node.func.id}() on a traced value inside "
+                        f"traced scope {fname!r} forces a host sync",
+                        hint="return the value and coerce it outside "
+                             "the trace"))
+        elif isinstance(node, (ast.If, ast.While)):
+            hit = _dynamic_taint_in(node.test, tainted)
+            if hit is not None:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(module.finding(
+                    "TRC003", node,
+                    f"Python `{kind}` on traced value {hit.id!r} in "
+                    f"traced scope {fname!r} (concretisation error or "
+                    "silent recompile per branch)",
+                    hint="use jax.lax.cond/select, or branch on "
+                         "x.shape/x.ndim if the decision is static"))
+
+
+def _check_closure_capture(module, fn, traced, findings):
+    """TRC004: a traced nested def reading a variable the enclosing
+    function mutates (step counters and friends) — each new value is a
+    fresh compile-time constant, i.e. one recompile per step."""
+    nested = [sub for sub in ast.walk(fn)
+              if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and sub is not fn and sub in traced]
+    if not nested:
+        return
+    varying = set()
+    for node in ast.walk(fn):
+        owner = module.enclosing(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+        if owner is not fn:
+            continue
+        if isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Name):
+            varying.add(node.target.id)
+        elif isinstance(node, ast.Assign) and \
+                module.enclosing(node, (ast.For, ast.While)) is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    varying.add(t.id)
+    if not varying:
+        return
+    for sub in nested:
+        local = _params_of(sub) | {
+            n.id for n in ast.walk(sub)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+        for node in ast.walk(sub):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in varying and node.id not in local:
+                findings.append(module.finding(
+                    "TRC004", node,
+                    f"traced function {sub.name!r} closes over "
+                    f"{node.id!r}, which {getattr(fn, 'name', '?')!r} "
+                    "mutates per step — every new value recompiles",
+                    hint="pass it as a traced argument, or mark it "
+                         "static on purpose"))
+
+
+def _is_batch_end_handler(module, fn):
+    if fn.name != "batch_end":
+        return False
+    cls = module.enclosing(fn, (ast.ClassDef,))
+    if cls is None:
+        return False
+    bases = {b.id if isinstance(b, ast.Name) else
+             (b.attr if isinstance(b, ast.Attribute) else "")
+             for b in cls.bases}
+    return "BatchEnd" in bases or "EventHandler" in bases
+
+
+def _check_hot_path(module, fn, findings):
+    """TRC005: unconditional per-batch host syncs in host hot paths."""
+    cls = module.enclosing(fn, (ast.ClassDef,))
+    clsname = cls.name if cls is not None else None
+    if not (_is_batch_end_handler(module, fn) or
+            (clsname, fn.name) in HOT_PATHS):
+        return
+    for node in ast.walk(fn):
+        # only the unambiguous sync signals here: in host code there is
+        # no traced-parameter anchor, so float()/int() of an arbitrary
+        # expression is usually a plain host coercion, not a transfer
+        sync = None
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in SYNC_METHODS:
+                sync = f".{node.func.attr}()"
+            elif module.imports.resolve(node.func) in SYNC_CALLS:
+                sync = module.imports.resolve(node.func) + "()"
+        if sync is None:
+            continue
+        # exempt syncs under an emit-interval gate — an ancestor `if`
+        # whose condition computes a modulo (`step % interval == 0`);
+        # a bare None-check does not make a per-batch sync cheaper
+        guard = node
+        gated = False
+        while True:
+            guard = module.enclosing(guard, (ast.If,))
+            if guard is None or module.enclosing(
+                    guard, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    is not fn:
+                break
+            if any(isinstance(sub, ast.BinOp) and
+                   isinstance(sub.op, ast.Mod)
+                   for sub in ast.walk(guard.test)):
+                gated = True
+                break
+        if gated:
+            continue
+        where = f"{clsname}.{fn.name}" if clsname else fn.name
+        findings.append(module.finding(
+            "TRC005", node,
+            f"unconditional host sync {sync} in per-batch hot path "
+            f"{where} stalls the device pipeline every step",
+            hint="gate the sync on the emit/log interval so most "
+                 "steps stay sync-free"))
+
+
+def check(module, ctx):
+    findings = []
+    traced, statics = _traced_functions(module)
+    for fn in traced:
+        if isinstance(fn, ast.Lambda):
+            continue  # lambdas: too small to taint-track usefully
+        _check_traced_body(module, fn, findings,
+                           static_params=statics.get(fn, ()))
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node not in traced:
+                _check_closure_capture(module, node, traced, findings)
+                _check_hot_path(module, node, findings)
+    return findings

@@ -306,6 +306,10 @@ declare("telemetry.http_port", int, 0, "MXNET_TELEMETRY_PORT",
         "GET /metrics (Prometheus exposition), /healthz, /trace?last=N. "
         "mx.telemetry.serve_http(port) starts it at runtime; port 0 "
         "there binds an ephemeral port.")
+declare("analyze.report_path", str, "", "MXNET_ANALYZE_REPORT",
+        "Saved tools/mxlint.py --json document to fold into training-run "
+        "reports as the 'analyze' plane ('' = only in-process "
+        "mx.analyze.run_suite results are reported).")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

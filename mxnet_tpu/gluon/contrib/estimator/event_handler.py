@@ -184,7 +184,11 @@ class TelemetryHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
             return
         fields = {}
         loss = kwargs.get("loss")
-        if loss is not None:
+        # only pay the device->host loss fetch on steps the reporter
+        # will actually emit — it drops the field on every other step,
+        # so fetching per batch stalled the pipeline for nothing
+        if loss is not None and \
+                (self.reporter._steps + 1) % self.reporter._interval == 0:
             if isinstance(loss, (list, tuple)):
                 loss = loss[0] if loss else None
             try:

@@ -414,6 +414,7 @@ class DevicePrefetcher:
                     try:
                         item = next(self._source)
                     except StopIteration:
+                        # mxlint: disable=LCK002(hand-off under the source lock is the stall-recovery contract; _offer bounds each put to 0.1s and rechecks staleness)
                         self._offer(_DONE)
                         return
                     # the offer stays under the lock on purpose: if this
@@ -428,8 +429,10 @@ class DevicePrefetcher:
                     else:
                         payload = self._put_batch(item)
                 except BaseException as exc:  # noqa: BLE001 - to consumer
+                    # mxlint: disable=LCK002(same bounded hand-off as above; the exception must reach the consumer before the thread retires)
                     self._offer(_Raise(exc))
                     return
+                # mxlint: disable=LCK002(the offer stays under the lock on purpose, see comment above; the put is bounded and staleness-checked, so no unbounded block)
                 if not self._offer(payload):
                     return
 

@@ -654,6 +654,30 @@ def stop_http():
 
 # -- structured training run reports ---------------------------------------
 
+def _analyze_summary():
+    """The static-analysis plane for run reports, or None.
+
+    In-process runs of the analyzer (mx.analyze.run_suite) win; otherwise
+    a saved ``tools/mxlint.py --json`` document named by the
+    ``analyze.report_path`` knob is folded in, so CI can attach the lint
+    stage's findings to the training run report it gates.
+    """
+    from . import analyze as _analyze   # lazy: keeps import-time cost at 0
+    plane = _analyze.last_summary()
+    if plane is not None:
+        return plane
+    path = _config.get("analyze.report_path")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.loads(f.read().strip().rsplit("\n", 1)[-1])
+        return {"total": doc.get("total_new", 0),
+                "rules": doc.get("rule_counts", {})}
+    except (OSError, ValueError):
+        return None
+
+
 class TrainingTelemetry:
     """Structured training-run reporter over the registry.
 
@@ -744,6 +768,9 @@ class TrainingTelemetry:
         tuned = _autotune.last_summary()
         if tuned is not None:
             out["autotune"] = tuned
+        linted = _analyze_summary()
+        if linted is not None:
+            out["analyze"] = linted
         return out
 
     def close(self):

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""mxlint — the mx.analyze static-analysis CLI.
+
+Usage:
+    tools/mxlint.py [paths...] [--rule TRC001 --rule REG ...]
+                    [--path SUBSTRING] [--baseline ci/lint_baseline.json]
+                    [--write-baseline] [--assert-clean] [--json]
+                    [--list-rules]
+
+Default paths: the repo's own source roots (mxnet_tpu, tests,
+benchmark, tools, example, bench.py).  With ``--baseline`` the listed
+pre-existing findings are waived and only NEW findings count;
+``--assert-clean`` exits 1 when any new finding remains (the CI gate).
+``--write-baseline`` rewrites the baseline from the current findings.
+
+``--json`` follows the bench.py machine-readability contract: the last
+line on stdout is the one JSON document; everything human goes to
+stderr.
+
+The analyzer is stdlib-only, so this script loads it straight off the
+source tree without importing (or paying for) the rest of mxnet_tpu.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analyze():
+    pkg_dir = os.path.join(ROOT, "mxnet_tpu", "analyze")
+    spec = importlib.util.spec_from_file_location(
+        "_mxlint_analyze", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_mxlint_analyze"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: repo roots)")
+    ap.add_argument("--rule", action="append", default=[],
+                    help="only rules matching this prefix (repeatable, "
+                         "e.g. TRC or REG001)")
+    ap.add_argument("--path", dest="path_filter", default=None,
+                    help="only findings whose path contains this")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of waived pre-existing findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite --baseline from the current findings")
+    ap.add_argument("--assert-clean", action="store_true",
+                    help="exit 1 if any new finding remains")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="one JSON document on stdout, diagnostics on "
+                         "stderr")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    analyze = _load_analyze()
+
+    if args.list_rules:
+        for rule, desc in sorted(analyze.RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    findings = analyze.run_suite(paths=args.paths or None, root=ROOT,
+                                 rules=args.rule or None)
+    if args.path_filter:
+        findings = [f for f in findings if args.path_filter in f.path]
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("--write-baseline requires --baseline", file=sys.stderr)
+            return 2
+        analyze.write_baseline(os.path.join(ROOT, args.baseline)
+                               if not os.path.isabs(args.baseline)
+                               else args.baseline, findings)
+        print(f"wrote {len(findings)} findings to {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    waived = []
+    new = findings
+    if args.baseline:
+        bp = args.baseline if os.path.isabs(args.baseline) else \
+            os.path.join(ROOT, args.baseline)
+        if os.path.isfile(bp):
+            new, waived = analyze.apply_baseline(
+                findings, analyze.load_baseline(bp))
+        else:
+            print(f"baseline {args.baseline} not found; treating all "
+                  "findings as new", file=sys.stderr)
+
+    human = sys.stderr if args.as_json else sys.stdout
+    for f in new:
+        print(f.render(), file=human)
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    summary = (f"mxlint: {len(new)} new finding(s), "
+               f"{len(waived)} baselined")
+    print(summary, file=human if new or waived else human)
+
+    if args.as_json:
+        doc = {"new": [f.to_dict() for f in new],
+               "baselined": len(waived),
+               "rule_counts": counts,
+               "total_new": len(new),
+               "clean": not new}
+        # the contract: last stdout line is the single JSON document
+        print(json.dumps(doc))
+
+    if args.assert_clean and new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
