@@ -469,6 +469,86 @@ def bench_gpt_train(precision: str, on_cpu: bool, peak, bs=8, seq=1024,
     return row
 
 
+def bench_gpt_train_mesh(precision, on_cpu, peak, mesh=None, zero=0,
+                         k_iters=5):
+    """Composed-parallelism GPT training rows (`MeshConfig` tentpole):
+    the same model trained dp-only vs dp x tp vs dp x tp x pp, through
+    the full `ShardedTrainStep` (grads, ZeRO state partitioning,
+    optimizer update in one jitted program).  Each row reports the
+    per-axis collective bytes the layout moved (the zero.* / mesh.*
+    telemetry counters, per step) so the grid reads as throughput vs
+    communication trade-offs.  Rows whose mesh exceeds the device count
+    report "skipped" — run under
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+    from mxnet_tpu.parallel import MeshConfig, ShardedTrainStep
+
+    cfg = MeshConfig(**(mesh or {"dp": 1}))
+    tag = "x".join(f"{a}{s}" for a, s in cfg.shape.items() if s > 1) \
+        or "single"
+    name = f"gpt2_train_mesh_{tag}" + (f"_zero{zero}" if zero else "")
+    if cfg.size() > len(jax.devices()):
+        return {"name": name, "precision": precision,
+                "skipped": f"needs {cfg.size()} devices, "
+                           f"have {len(jax.devices())}"}
+
+    if on_cpu:
+        vocab, units, layers, heads, seq, bs = 1000, 64, 2, 4, 32, 8
+        k_iters = 3
+    else:  # GPT-2 small
+        vocab, units, layers, heads, seq, bs = 50257, 768, 12, 12, 1024, 8
+
+    mx.random.seed(0)
+    net = GPTForCausalLM(vocab_size=vocab, units=units,
+                         hidden_size=units * 4, num_layers=layers,
+                         num_heads=heads, max_length=seq,
+                         dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((2, seq), dtype="int32"))
+    n_params = sum(int(v.data().size)
+                   for v in net.collect_params().values())
+
+    def loss_fn(logits, labels):
+        from mxnet_tpu.ops.xent import sparse_softmax_xent
+        return jnp.mean(sparse_softmax_xent(logits, labels))
+
+    train = ShardedTrainStep(
+        net, loss_fn, mx.optimizer.create("adam", learning_rate=1e-3),
+        cfg, batch_specs=cfg.batch_specs(2, 2), n_labels=1, zero=zero)
+    rs = onp.random.RandomState(0)
+    x = rs.randint(0, vocab, (bs, seq)).astype("int32")
+    y = rs.randint(0, vocab, (bs, seq)).astype("int32")
+    float(train(x, y).asnumpy())  # compile outside the timed window
+
+    telemetry.enable()
+    telemetry.reset()
+    t0 = _t.perf_counter()
+    for _ in range(k_iters):
+        loss = train(x, y)
+    float(loss.asnumpy())  # one host sync closes the chain
+    sec = (_t.perf_counter() - t0) / k_iters
+    bytes_per_step = {
+        k: int(v / k_iters)
+        for prefix in ("zero.", "mesh.")
+        for k, v in telemetry.counters(prefix=prefix, aggregate=True).items()}
+    telemetry.disable()
+
+    flops = 6.0 * n_params * bs * seq
+    row = _row(name, sec, bs, flops, precision, peak)
+    row["mesh"] = cfg.shape
+    row["config"] = _config_dict(bs, 1, zero=zero)
+    row["collective_bytes_per_step"] = bytes_per_step
+    return row
+
+
 def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
                            max_new=48):
     """Online decode through mx.serve continuous batching (gpt2-124m
@@ -727,6 +807,13 @@ def main(argv=None):
         (bench_bert_train, dict(precision="bf16", bs=64)),
         (bench_gpt_train, dict(precision="bf16", bs=8, seq=1024)),
         (bench_gpt_train, dict(precision="bf16", bs=4, seq=2048)),
+        (bench_gpt_train_mesh, dict(precision="fp32", mesh={"dp": 8},
+                                    zero=1)),
+        (bench_gpt_train_mesh, dict(precision="fp32",
+                                    mesh={"dp": 4, "tp": 2}, zero=1)),
+        (bench_gpt_train_mesh, dict(precision="fp32",
+                                    mesh={"dp": 2, "tp": 2, "pp": 2},
+                                    zero=1)),
         (bench_gpt_decode_serve, dict(precision="fp32")),
         (bench_gpt_decode_serve, dict(precision="int8")),
         (bench_gpt_decode_serve, dict(precision="int4")),

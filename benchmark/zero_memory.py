@@ -39,13 +39,13 @@ UNITS = 2048
 BATCH = 16
 
 
-def _make_step(zero, dp):
+def _make_step(zero, dp, tp=1):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import nn
-    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
     from mxnet_tpu.parallel.train import ShardedTrainStep
 
     mx.random.seed(7)
@@ -56,6 +56,14 @@ def _make_step(zero, dp):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], -1))
 
+    if tp > 1:
+        # ZeRO x TP: the weight is column-parallel over tp; zero=1 then
+        # partitions the state's replicated in_units dim over dp
+        cfg = MeshConfig(dp=dp, tp=tp)
+        return ShardedTrainStep(
+            net, loss_fn, mx.optimizer.create("adam", learning_rate=0.01),
+            cfg, batch_specs=(P("dp"), P("dp")), n_labels=1, zero=zero,
+            param_specs={"weight": P("tp", None), "bias": P("tp")})
     return ShardedTrainStep(
         net, loss_fn, mx.optimizer.create("adam", learning_rate=0.01),
         make_mesh({"dp": dp}), batch_specs=(P("dp"), P("dp")),
@@ -79,6 +87,9 @@ def main(argv=None):
     ap.add_argument("--reduction", type=float, default=0.40,
                     help="minimum per-device state-bytes cut (fraction)")
     ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--tp", type=int, default=2,
+                    help="tp size for the ZeRO x TP section (skipped when "
+                         "dp*tp exceeds the device count)")
     ap.add_argument("--steps", type=int, default=2)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
@@ -106,6 +117,20 @@ def main(argv=None):
             "state_bytes_per_device": _state_bytes_on(step, dev0),
             "losses": losses,
         }
+
+    # ZeRO x TP: same gate on a dp x tp mesh (needs dp*tp devices) — the
+    # tensor-sharded weight's state partitions its replicated sub-axis
+    tp = args.tp if len(jax.devices()) >= args.dp * args.tp else 1
+    results_tp = {}
+    if tp > 1:
+        for zero in (0, 1):
+            step = _make_step(zero, args.dp, tp=tp)
+            losses = [float(step(x, y).asnumpy())
+                      for _ in range(args.steps)]
+            results_tp[zero] = {
+                "state_bytes_per_device": _state_bytes_on(step, dev0),
+                "losses": losses,
+            }
     mem = telemetry.record_memory()
     counters = telemetry.counters(prefix="zero.", aggregate=True)
     telemetry.disable()
@@ -126,18 +151,39 @@ def main(argv=None):
         "zero_collective_bytes": counters,
         "memory_stats": mem or None,
     }
+    if results_tp:
+        repl_tp = results_tp[0]["state_bytes_per_device"]
+        shard_tp = results_tp[1]["state_bytes_per_device"]
+        reduction_tp = 1.0 - shard_tp / repl_tp
+        onp.testing.assert_allclose(results_tp[1]["losses"],
+                                    results_tp[0]["losses"],
+                                    rtol=1e-5, atol=1e-6)
+        report["zero_tp"] = {
+            "dp": args.dp, "tp": tp,
+            "replicated_state_bytes_per_device": repl_tp,
+            "zero1_state_bytes_per_device": shard_tp,
+            "reduction": reduction_tp,
+        }
     if args.json:
         print(json.dumps(report, indent=2))
     else:
         print(f"dp={args.dp}  optimizer-state bytes/device: "
               f"replicated={repl:,}  zero=1 {shard:,}  "
               f"(-{reduction:.1%}, bar {args.reduction:.0%})")
+        if results_tp:
+            print(f"dp={args.dp} tp={tp} (ZeRO x TP)  state bytes/device: "
+                  f"zero=0 {repl_tp:,}  zero=1 {shard_tp:,}  "
+                  f"(-{reduction_tp:.1%}, bar {args.reduction:.0%})")
         print(f"zero collective bytes: {counters}")
         print("memory.* (PJRT): "
               + (json.dumps(mem) if mem else "n/a on this backend"))
 
     if reduction < args.reduction:
         print(f"FAIL: reduction {reduction:.1%} < required "
+              f"{args.reduction:.0%}")
+        return 1
+    if results_tp and reduction_tp < args.reduction:
+        print(f"FAIL: ZeRO x TP reduction {reduction_tp:.1%} < required "
               f"{args.reduction:.0%}")
         return 1
     print("OK")

@@ -27,6 +27,12 @@
 #                memory benchmark: zero=1 on a 4-way dp mesh must cut
 #                per-device state bytes >=40% while staying numerically
 #                invisible (docs/PERFORMANCE.md)
+#   mesh       - composed-parallelism suite (MeshConfig dp x tp x pp x
+#                sp): parity oracle vs the single-device run, elastic
+#                (dp,tp,pp)-portable checkpoints, ZeRO x TP state
+#                sharding, pp.gpipe backward, mesh-axis autotune — on
+#                the virtual 8-device CPU mesh (docs/PERFORMANCE.md
+#                "Composing parallelism")
 #   serve      - continuous-batching inference suite + the throughput
 #                benchmark: >=2x tokens/s vs sequential decode under
 #                Poisson arrivals with ZERO post-warmup recompiles
@@ -61,7 +67,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|serve|autotune|quantize|trace|lint|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|lint|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -277,6 +283,13 @@ zero() {
     JAX_PLATFORMS=cpu python benchmark/zero_memory.py
 }
 
+mesh() {
+    echo "== mesh: composed-parallelism suite (docs/PERFORMANCE.md 'Composing parallelism') =="
+    python -m pytest tests/test_mesh_compose.py tests/test_parallel.py -q
+    echo "== mesh: ZeRO x TP optimizer-state gate (>=40% cut at dp=4, tp=2) =="
+    JAX_PLATFORMS=cpu python benchmark/zero_memory.py
+}
+
 serve() {
     echo "== serve: continuous-batching inference suite (docs/SERVING.md) =="
     python -m pytest tests/test_serve.py -q
@@ -323,6 +336,7 @@ case "$stage" in
     resilience) resilience ;;
     pipeline) pipeline ;;
     zero) zero ;;
+    mesh) mesh ;;
     serve) serve ;;
     autotune) autotune ;;
     quantize) quantize ;;
@@ -330,6 +344,6 @@ case "$stage" in
     lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; serve; autotune; quantize; trace; lint ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -7,12 +7,14 @@ cache directory (``compilation_cache_dir`` — "next to the XLA cache", so
 one cache volume carries both the compiled executables and the configs
 that produced them), or ``<mxnet home>/autotune``.
 
-Keys are ``<model fingerprint>|<device_kind>|dp<N>``: the fingerprint
-hashes the parameter inventory (structural name, shape, dtype) plus the
-block/loss/optimizer identities, so any architecture change invalidates
-the entry; device_kind and dp size key the hardware point the
-measurement is only valid for.  Writes are atomic (tmp + rename) — a
-preempted run never leaves a torn winners file.
+Keys are ``<model fingerprint>|<device_kind>|dp<N>[|mesh:<axes>]``: the
+fingerprint hashes the parameter inventory (structural name, shape,
+dtype) plus the block/loss/optimizer identities, so any architecture
+change invalidates the entry; device_kind, dp size and the mesh shape
+(every axis with size > 1, e.g. ``mesh:dp2xtp2``) key the hardware point
+the measurement is only valid for — a winner tuned on one topology never
+loads on another.  Writes are atomic (tmp + rename) — a preempted run
+never leaves a torn winners file.
 """
 from __future__ import annotations
 
@@ -62,8 +64,17 @@ def model_fingerprint(block, loss_fn=None, optimizer=None):
     return h[:16]
 
 
-def winner_key(fingerprint, device_kind, dp):
-    return f"{fingerprint}|{device_kind}|dp{int(dp)}"
+def winner_key(fingerprint, device_kind, dp, mesh=None):
+    """``mesh`` (a MeshConfig, jax Mesh or {axis: size} dict) appends the
+    topology to the key so a winner measured on dp2xtp2 never loads on
+    dp4; omit it for the pre-mesh key format (dp-only searches)."""
+    key = f"{fingerprint}|{device_kind}|dp{int(dp)}"
+    if mesh is not None:
+        shape = dict(getattr(mesh, "shape", mesh))
+        axes = "x".join(f"{a}{int(s)}" for a, s in sorted(shape.items())
+                        if int(s) > 1)
+        key += f"|mesh:{axes or '1'}"
+    return key
 
 
 def load_all(path=None):

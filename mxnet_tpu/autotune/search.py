@@ -232,11 +232,20 @@ def _measure_candidate(candidate, block, loss_fn, optimizer, mesh,
     """One hermetic measured trial -> items/s.  Raises TrialOOM on device
     memory exhaustion (or when the ``autotune.trial_oom`` fault point
     fires — the chaos path CI uses to prove OOM survival)."""
+    from ..parallel.mesh import MeshConfig
     from ..parallel.train import ShardedTrainStep
     if _fault._active and _fault.fire("autotune.trial_oom"):
         raise TrialOOM(f"injected OOM for {candidate!r}")
     c = candidate
     batch = _stacked_batch(sample_batch, c)
+    if c.mesh is not None:
+        # mesh-axis candidate: the trial runs on ITS layout, not the
+        # caller's — batch/param specs re-derive from the MeshConfig
+        # (megatron tp specs auto-apply inside ShardedTrainStep)
+        mesh = MeshConfig(**c.mesh)
+        batch_specs = mesh.batch_specs(*[a.ndim for a in sample_batch])
+        param_specs = None
+        dp_axis = "dp"
     step = ShardedTrainStep(
         block, loss_fn, _clone_optimizer(optimizer), mesh, batch_specs,
         n_labels=n_labels, param_specs=param_specs,
@@ -301,7 +310,10 @@ def search(block, loss_fn, optimizer, mesh, batch_specs, sample_batch,
     import jax
     device_kind = getattr(jax.devices()[0], "device_kind", "cpu")
     fp = model_fingerprint(block, loss_fn, optimizer)
-    key = winner_key(fp, device_kind, dp)
+    # the mesh shape keys the winner — a layout tuned on dp2xtp2 never
+    # loads on dp4 (mesh-axis searches store the winning layout in the
+    # record's config["mesh"])
+    key = winner_key(fp, device_kind, dp, mesh=dict(mesh.shape))
     path = winners_path()
 
     candidates = space.candidates()

@@ -3,15 +3,18 @@
 A ``Candidate`` is one point in the grid the tuner considers:
 
     {batch_size, steps_per_call, grad_accum, zero, remat, prefetch_depth,
-     precision}
+     precision, mesh}
 
 — the knobs ``ShardedTrainStep`` + ``DevicePrefetcher`` accept, plus a
 ``precision`` axis for inference tuning (the numeric format is a config
 dimension like any other per "A Learned Performance Model for TPUs" —
-see PRECISION_VALUES).  Values are JSON-native (remat is
-``False``/``'dots'``/``True``) so winners round-trip through the
-persisted winners file unchanged; configs persisted before the precision
-axis load as ``precision="fp32"``.
+see PRECISION_VALUES) and a ``mesh`` axis searching the device layout
+itself (``parallel.mesh_factorizations`` enumerates the valid
+``(dp, tp, pp, sp)`` factorizations of the device count).  Values are
+JSON-native (remat is ``False``/``'dots'``/``True``, mesh a plain
+``{axis: size}`` dict or None) so winners round-trip through the
+persisted winners file unchanged; configs persisted before the
+precision/mesh axes load as ``precision="fp32"`` / ``mesh=None``.
 """
 from __future__ import annotations
 
@@ -21,6 +24,19 @@ from .. import config as _config
 from ..base import MXNetError
 
 __all__ = ["Candidate", "SearchSpace", "REMAT_VALUES", "PRECISION_VALUES"]
+
+
+def _mesh_value(v):
+    """Normalize one mesh-axis value: None (use the caller's mesh), a
+    ``MeshConfig`` or a ``{axis: size}`` dict -> plain int dict."""
+    if v is None:
+        return None
+    shape = getattr(v, "shape", v)
+    if not isinstance(shape, dict):
+        raise MXNetError(
+            f"mesh axis value {v!r}: expected None, a MeshConfig or a "
+            "{'dp': n, ...} dict")
+    return {str(a): int(s) for a, s in shape.items()}
 
 #: remat axis values, cheapest-compute first (order matters for docs only)
 REMAT_VALUES = (False, "dots", True)
@@ -37,10 +53,11 @@ class Candidate:
     """One grid point; hashable on its config tuple."""
 
     __slots__ = ("batch_size", "steps_per_call", "grad_accum", "zero",
-                 "remat", "prefetch_depth", "precision")
+                 "remat", "prefetch_depth", "precision", "mesh")
 
     def __init__(self, batch_size, steps_per_call=1, grad_accum=1, zero=0,
-                 remat=False, prefetch_depth=None, precision="fp32"):
+                 remat=False, prefetch_depth=None, precision="fp32",
+                 mesh=None):
         self.batch_size = int(batch_size)
         self.steps_per_call = int(steps_per_call)
         self.grad_accum = int(grad_accum)
@@ -49,6 +66,7 @@ class Candidate:
         self.prefetch_depth = (None if prefetch_depth is None
                                else int(prefetch_depth))
         self.precision = str(precision)
+        self.mesh = _mesh_value(mesh)
 
     def config(self):
         """JSON-safe config dict (the shape persisted in winners.json and
@@ -59,19 +77,25 @@ class Candidate:
                 "zero": self.zero,
                 "remat": self.remat,
                 "prefetch_depth": self.prefetch_depth,
-                "precision": self.precision}
+                "precision": self.precision,
+                "mesh": self.mesh}
 
     @classmethod
     def from_config(cls, cfg):
-        # .get keeps winners persisted before the precision axis loading
+        # .get keeps winners persisted before the precision/mesh axes
+        # loading
         return cls(precision=cfg.get("precision", "fp32"),
+                   mesh=cfg.get("mesh"),
                    **{k: cfg[k] for k in
                       ("batch_size", "steps_per_call", "grad_accum", "zero",
                        "remat", "prefetch_depth")})
 
     def key(self):
+        mesh = (tuple(sorted(self.mesh.items()))
+                if self.mesh is not None else None)
         return (self.batch_size, self.steps_per_call, self.grad_accum,
-                self.zero, self.remat, self.prefetch_depth, self.precision)
+                self.zero, self.remat, self.prefetch_depth, self.precision,
+                mesh)
 
     def __eq__(self, other):
         return isinstance(other, Candidate) and self.key() == other.key()
@@ -82,8 +106,8 @@ class Candidate:
     def __repr__(self):
         return ("Candidate(bs={batch_size}, spc={steps_per_call}, "
                 "ga={grad_accum}, zero={zero}, remat={remat}, "
-                "prefetch={prefetch_depth}, prec={precision})"
-                ).format(**self.config())
+                "prefetch={prefetch_depth}, prec={precision}, "
+                "mesh={mesh})").format(**self.config())
 
 
 class SearchSpace:
@@ -99,7 +123,7 @@ class SearchSpace:
 
     def __init__(self, batch_size, steps_per_call=(1, 2, 4),
                  grad_accum=(1, 2), zero=(0, 1, 2), remat=REMAT_VALUES,
-                 prefetch_depth=None, precision="fp32"):
+                 prefetch_depth=None, precision="fp32", mesh=None):
         def _axis(v):
             return tuple(v) if isinstance(v, (tuple, list)) else (v,)
         self.batch_size = _axis(batch_size)
@@ -113,6 +137,9 @@ class SearchSpace:
         # single-value by default so train searches are unchanged; an
         # inference search passes e.g. precision=("bf16", "int8")
         self.precision = _axis(precision)
+        # single-value None by default (trials run on the caller's mesh);
+        # a layout search passes mesh=parallel.mesh_factorizations(8)
+        self.mesh = tuple(_mesh_value(m) for m in _axis(mesh))
         if not self.batch_size:
             raise MXNetError("SearchSpace needs at least one batch size")
         for z in self.zero:
@@ -134,19 +161,21 @@ class SearchSpace:
         return Candidate(self.batch_size[0], steps_per_call=1, grad_accum=1,
                          zero=0, remat=False,
                          prefetch_depth=self.prefetch_depth[0],
-                         precision=self.precision[0])
+                         precision=self.precision[0], mesh=self.mesh[0])
 
     def candidates(self):
         """Enumerate the grid (deterministic order; includes the default
         candidate by construction)."""
         out = []
-        for bs, spc, ga, z, rm, pf, pr in itertools.product(
+        for bs, spc, ga, z, rm, pf, pr, me in itertools.product(
                 self.batch_size, self.steps_per_call, self.grad_accum,
-                self.zero, self.remat, self.prefetch_depth, self.precision):
-            out.append(Candidate(bs, spc, ga, z, rm, pf, pr))
+                self.zero, self.remat, self.prefetch_depth, self.precision,
+                self.mesh):
+            out.append(Candidate(bs, spc, ga, z, rm, pf, pr, me))
         return out
 
     def __len__(self):
         return (len(self.batch_size) * len(self.steps_per_call)
                 * len(self.grad_accum) * len(self.zero) * len(self.remat)
-                * len(self.prefetch_depth) * len(self.precision))
+                * len(self.prefetch_depth) * len(self.precision)
+                * len(self.mesh))

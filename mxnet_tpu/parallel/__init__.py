@@ -8,7 +8,7 @@ ring-attention sequence parallelism (ring_attention.py).
 """
 from .mesh import (  # noqa: F401
     make_mesh, data_parallel_mesh, set_mesh, current_mesh, shard, replicate,
-    activation_sharding,
+    activation_sharding, MeshConfig, mesh_factorizations,
 )
 from .collectives import (  # noqa: F401
     allreduce, allgather, reduce_scatter, ppermute,

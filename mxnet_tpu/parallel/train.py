@@ -13,6 +13,7 @@ overlap re-created at compile time.
 from __future__ import annotations
 
 import functools
+import re
 
 import numpy as onp
 
@@ -25,6 +26,7 @@ from .. import pipeline as _pipeline
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..numpy.multiarray import ndarray, _wrap
+from .mesh import MeshConfig, activation_sharding
 
 _telemetry.declare_metric(
     "zero.reduce_scatter_bytes_total", "counter",
@@ -34,6 +36,57 @@ _telemetry.declare_metric(
     "zero.all_gather_bytes_total", "counter",
     "logical bytes all-gathered over the dp axis re-assembling ZeRO-updated "
     "parameters")
+_telemetry.declare_metric(
+    "mesh.dp_gradient_bytes_total", "counter",
+    "logical gradient bytes reduced over the dp axis per optimizer update "
+    "(total trainable bytes; overlaps the zero.* counters when ZeRO folds "
+    "the reduction into its reduce-scatter)")
+_telemetry.declare_metric(
+    "mesh.tp_allreduce_bytes_total", "counter",
+    "estimated activation bytes allreduced over the tp axis per step "
+    "(row-parallel layer outputs x tokens; logical estimate for "
+    "token-shaped inputs)")
+_telemetry.declare_metric(
+    "mesh.pp_stage_transfer_bytes_total", "counter",
+    "estimated residual-stream bytes handed stage-to-stage over the pp "
+    "axis per step (forward + backward; logical estimate)")
+
+# params whose structural name matches <prefix>layer<i>.<suffix> with
+# identical shapes across i are the pipeline-stackable layer family
+_PP_LAYER_RE = re.compile(r"^(?P<pre>.*\blayer)(?P<idx>\d+)\.(?P<suf>.+)$")
+
+
+def _pp_layer_groups(names):
+    """Group param names by (prefix, suffix) around a 'layerN.' segment:
+    {(pre, suf): {idx: name}}."""
+    groups = {}
+    for n in names:
+        m = _PP_LAYER_RE.match(n)
+        if m:
+            key = (m.group("pre"), m.group("suf"))
+            groups.setdefault(key, {})[int(m.group("idx"))] = n
+    return groups
+
+
+def _insert_dp(spec, shape, dp_axis, dp_n):
+    """Optimizer-state spec for a tensor-sharded param under ZeRO: the
+    param's spec with ``dp_axis`` partitioning its largest free
+    (replicated, evenly divisible) dimension — the reduce-scatter target.
+    None when no dimension can take the dp axis (state then shards like
+    the weight)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    flat = []
+    for e in entries:
+        flat.extend(e if isinstance(e, tuple) else (e,))
+    if dp_axis in flat:
+        return None
+    free = [i for i, e in enumerate(entries)
+            if e is None and shape[i] % dp_n == 0 and shape[i] >= dp_n]
+    if not free:
+        return None
+    best = max(free, key=lambda i: shape[i])
+    entries[best] = dp_axis
+    return P(*entries)
 
 # name-pattern Megatron rules for the transformer family
 # (column-parallel: shard Dense units; row-parallel: shard in_units, psum)
@@ -143,9 +196,12 @@ class ShardedTrainStep:
     block: initialized (Hybrid)Block.
     loss_fn(outputs, *labels) -> scalar (raw jax values).
     optimizer: mxnet_tpu Optimizer instance (or name via opt.create).
-    mesh: jax.sharding.Mesh; dp_axis must exist; tp/sp optional.
+    mesh: a MeshConfig (the composed dp×tp×pp×sp entry point — builds
+        the Mesh, derives activation rules for sp, and turns on layer
+        stacking for pp) or a raw jax.sharding.Mesh; dp_axis must exist
+        for zero>0; tp/pp/sp optional.
     batch_specs: PartitionSpec per batch arg (inputs then labels),
-        e.g. (P('dp', 'sp'), P('dp',)).
+        e.g. (P('dp', 'sp'), P('dp',)) — or ``cfg.batch_specs(...)``.
     param_specs: dict name -> PartitionSpec; defaults to megatron_specs
         when the mesh has a tp axis else fully replicated.
     zero: ZeRO optimizer-state partitioning level over the dp axis.
@@ -153,9 +209,15 @@ class ShardedTrainStep:
         1 — optimizer state lives in 1/dp flat shards; each step
         reduce-scatters grads, updates the local shard, all-gathers the
         new params — all inside the one jitted program so XLA overlaps
-        the collectives with compute.
+        the collectives with compute.  Params that are already tensor-
+        sharded (tp/ep/pp) partition their REPLICATED sub-axis instead:
+        the optimizer state carries the param's spec with 'dp' inserted
+        into a free dimension, grads reduce-scatter onto it, the
+        elementwise update runs on the (tp×dp)-sharded chunk, and the
+        new params gather back to the tp-sharded layout — ZeRO×TP in
+        one program.
         2 — additionally keeps reduced gradients (incl. the grad_accum
-        accumulator) laid out in the same 1/dp shards, so full gradients
+        accumulator) laid out in the same dp shards, so full gradients
         never materialize replicated.
     grad_accum: accumulate gradients over K lax.scan microbatches before
         ONE optimizer update (batch arrays gain a leading K axis).
@@ -176,7 +238,15 @@ class ShardedTrainStep:
             optimizer = opt_mod.create(optimizer)
         self.block = block
         self.loss_fn = loss_fn
+        self.mesh_config = mesh if isinstance(mesh, MeshConfig) else None
+        if self.mesh_config is not None:
+            mesh = self.mesh_config.build()
         self.mesh = mesh
+        # sp flows through the activation_sharding scope: the rules are
+        # installed around every _step call so layer `constrain` hooks and
+        # the ring-attention routing see them at trace time
+        self._act_rules = (self.mesh_config.activation_rules()
+                           if self.mesh_config is not None else {})
         self.n_labels = n_labels
         self.dp_axis = dp_axis
         # per-update specs as given (before the grad_accum/steps_per_call
@@ -202,6 +272,42 @@ class ShardedTrainStep:
                 param_specs = megatron_specs(shapes)
             else:
                 param_specs = {n: P() for n in shapes}
+
+        # -- pipeline stacking: layer families become one (S*k, ...) leaf --
+        # Each repeated `<prefix>layerN.<suffix>` family stacks into a
+        # single leaf whose leading (layer) dim shards over 'pp': every pp
+        # group stores only its contiguous block of layers, and the static
+        # per-layer index in the model's forward loop is the stage handoff
+        # GSPMD lowers to a collective-permute — gpipe's ppermute schedule
+        # expressed as sharding instead of shard_map, so it composes with
+        # dp/tp/sp and the grad_accum microbatch scan.
+        pp_n = int(mesh.shape.get("pp", 1))
+        self._pp_groups = {}
+        if pp_n > 1:
+            param_specs = dict(param_specs)
+            for d in (trainable, aux):
+                for (pre, suf), idx_map in _pp_layer_groups(d).items():
+                    L = len(idx_map)
+                    if sorted(idx_map) != list(range(L)):
+                        continue   # holes in the index range: not a family
+                    members = [idx_map[i] for i in range(L)]
+                    if len({tuple(d[m].shape) for m in members}) != 1:
+                        continue
+                    if L % pp_n:
+                        raise MXNetError(
+                            f"pp={pp_n}: layer family '{pre}N.{suf}' has "
+                            f"{L} layers — not divisible into {pp_n} "
+                            f"pipeline stages")
+                    sname = f"{pre}*.{suf}"
+                    d[sname] = jnp.stack([d.pop(m) for m in members])
+                    base = param_specs.get(members[0], P())
+                    param_specs[sname] = P("pp", *tuple(base))
+                    self._pp_groups[sname] = {"members": members}
+            if not self._pp_groups:
+                raise MXNetError(
+                    f"pp={pp_n} needs repeated 'layerN.' parameter "
+                    "families of identical shape to place on pipeline "
+                    "stages; none found in this block")
         self.param_specs = param_specs
         self.fopt = FunctionalOptimizer(optimizer)
 
@@ -226,14 +332,24 @@ class ShardedTrainStep:
                 "(layer-wise norms / per-tensor RNG); it cannot run on "
                 "ZeRO shards — use zero=0")
         dp_n = int(mesh.shape[dp_axis]) if self.zero else 1
-        # name -> (shape, size, padded_size); only params replicated by
-        # param_specs are partitioned — tp/ep-sharded params keep the
-        # state-shards-like-weight layout
+        # Two ZeRO layouts:
+        #   _zero: name -> (shape, size, padded_size) — fully-replicated
+        #     params partition into flat 1/dp shards (padded ravel).
+        #   _zero_tp: name -> state PartitionSpec — tensor-sharded
+        #     (tp/ep/pp) params partition their REPLICATED sub-axis: the
+        #     state carries the param spec with dp inserted into a free
+        #     dim, grads reduce-scatter onto it, the elementwise update
+        #     runs on the chunk and the new params gather back to the
+        #     tensor-sharded layout (ZeRO x TP).
         self._zero = {}
+        self._zero_tp = {}
         if self.zero:
             for n, v in self.trainable.items():
                 spec = param_specs.get(n, P())
                 if any(e is not None for e in spec):
+                    sspec = _insert_dp(spec, v.shape, dp_axis, dp_n)
+                    if sspec is not None:
+                        self._zero_tp[n] = sspec
                     continue
                 size = int(v.size)
                 padded = -(-size // dp_n) * dp_n
@@ -243,9 +359,20 @@ class ShardedTrainStep:
         for n, v in self.trainable.items():
             zinfo = self._zero.get(n)
             if zinfo is None:
+                tspec = self._zero_tp.get(n)
                 s = self.fopt.init({n: v})[n]
+                if tspec is not None:
+                    bad = [l.shape for l in jax.tree_util.tree_leaves(s)
+                           if l.shape != v.shape]
+                    if bad:
+                        raise MXNetError(
+                            f"{type(self.fopt.opt).__name__} state for "
+                            f"'{n}' is not elementwise (leaf shapes "
+                            f"{bad}); zero>0 unsupported")
                 states[n] = jax.tree_util.tree_map(
-                    lambda x: jax.device_put(x, sh(param_specs.get(n, P())))
+                    lambda x: jax.device_put(
+                        x, sh(tspec if tspec is not None
+                              else param_specs.get(n, P())))
                     if x is not None else None, s,
                     is_leaf=lambda x: x is None)
                 continue
@@ -269,6 +396,7 @@ class ShardedTrainStep:
         state_sh = {
             n: jax.tree_util.tree_map(
                 lambda x: sh(P(dp_axis)) if n in self._zero
+                else sh(self._zero_tp[n]) if n in self._zero_tp
                 else sh(param_specs.get(n, P())),
                 self.states[n], is_leaf=lambda x: x is None)
             for n in self.states}
@@ -287,6 +415,29 @@ class ShardedTrainStep:
                 info[2] * itemsz[n] for n, info in self._zero.items())
         else:
             self._zero_bytes = 0
+        self._zero_tp_bytes = sum(
+            int(self.trainable[n].size)
+            * jnp.dtype(self.trainable[n].dtype).itemsize
+            for n in self._zero_tp)
+        # analytic per-axis traffic (the mesh.* counters __call__ feeds)
+        self._trainable_bytes = sum(
+            int(v.size) * jnp.dtype(v.dtype).itemsize
+            for v in self.trainable.values())
+        self._tp_row_out_units = []
+        if int(mesh.shape.get("tp", 1)) > 1:
+            for n, v in self.trainable.items():
+                if not any(n.endswith(s) for s in _ROW_SUFFIXES):
+                    continue
+                if n in self._pp_groups:
+                    self._tp_row_out_units.append(
+                        (int(v.shape[0]), int(v.shape[1])))
+                else:
+                    self._tp_row_out_units.append((1, int(v.shape[0])))
+        self._pp_width = 0
+        for n, v in self.trainable.items():
+            if n in self._pp_groups and n.endswith("ln.gamma"):
+                self._pp_width = int(v.shape[-1])
+                break
 
         def base_step(trainable, aux, states, rng, lr, t, *batch):
             inputs = batch[:len(batch) - self.n_labels]
@@ -304,16 +455,22 @@ class ShardedTrainStep:
             from jax import lax
             K = self.grad_accum
             zero2 = self._zero if self.zero >= 2 else {}
+            zero2tp = self._zero_tp if self.zero >= 2 else {}
 
             def step(trainable, aux, states, rng, lr, t, *batches):
                 # microbatches carry a leading K axis; ONE update at the end.
                 # At zero>=2 the accumulator holds flat dp shards — the
                 # long-lived gradient memory is 1/dp per device and each
                 # microbatch grad reduce-scatters straight into it.
+                # (tensor-sharded params accumulate in their dp-inserted
+                # state layout instead of the flat one.)
                 def g_init(n, v):
                     if n in zero2:
                         return self._dp_constrain(
                             jnp.zeros((self._zero[n][2],), v.dtype))
+                    if n in zero2tp:
+                        return self._ztp_constrain(
+                            n, jnp.zeros(v.shape, v.dtype))
                     return jnp.zeros(v.shape, v.dtype)
 
                 acc0 = {n: g_init(n, v) for n, v in trainable.items()}
@@ -330,6 +487,8 @@ class ShardedTrainStep:
                         g = grads[n]
                         if n in zero2:
                             g = self._dp_constrain(self._flat_pad(n, g))
+                        elif n in zero2tp:
+                            g = self._ztp_constrain(n, g)
                         return acc[n] + g
 
                     acc = {n: add(n) for n in acc}
@@ -375,12 +534,47 @@ class ShardedTrainStep:
         self._n_step = 0
 
     # -- step internals -----------------------------------------------------
+    def _expand_pp(self, params):
+        """Unstack pipeline families back to per-layer names for the
+        block's forward: each static slice of the pp-sharded stack is one
+        layer's weights, and consuming it on the next stage's microbatch
+        is the stage handoff GSPMD lowers to a collective-permute."""
+        if not self._pp_groups:
+            return params
+        out = dict(params)
+        for sname, g in self._pp_groups.items():
+            if sname not in out:
+                continue
+            stacked = out.pop(sname)
+            for i, member in enumerate(g["members"]):
+                out[member] = stacked[i]
+        return out
+
+    def _collapse_pp(self, updates):
+        """Inverse of _expand_pp for the mutated-aux dict the forward
+        returns (BatchNorm running stats inside pipelined layers)."""
+        if not self._pp_groups or not updates:
+            return updates
+        out = dict(updates)
+        for sname, g in self._pp_groups.items():
+            members = g["members"]
+            hit = [m for m in members if m in out]
+            if not hit:
+                continue
+            if len(hit) != len(members):
+                raise MXNetError(
+                    f"pipeline family {sname}: forward mutated only "
+                    f"{len(hit)}/{len(members)} member layers — stages "
+                    "must update aux state uniformly")
+            out[sname] = jnp.stack([out.pop(m) for m in members])
+        return out
+
     def _loss_and_grad(self, trainable, aux, rng, inputs, labels):
         def lossf(tr):
             out, mutated = functional.functional_call(
-                self.block, {**tr, **aux}, *inputs, train=True,
-                rng_key=rng)
-            return self.loss_fn(out, *labels), mutated
+                self.block, self._expand_pp({**tr, **aux}), *inputs,
+                train=True, rng_key=rng)
+            return self.loss_fn(out, *labels), self._collapse_pp(mutated)
 
         if self._remat_on:
             lossf = jax.checkpoint(lossf, policy=self._remat_policy)
@@ -394,6 +588,17 @@ class ShardedTrainStep:
     def _dp_constrain(self, x):
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(self.dp_axis)))
+
+    def _ztp_constrain(self, n, x):
+        """Pin x to param n's ZeRO x TP optimizer-state layout (the
+        param spec with dp inserted) — on gradients this IS the
+        reduce-scatter over dp of the tensor-sharded leaf."""
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, self._zero_tp[n]))
+
+    def _param_constrain(self, n, x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, self.param_specs.get(n, P())))
 
     def _build_zero_update(self):
         from .._jax_compat import shard_map
@@ -421,18 +626,35 @@ class ShardedTrainStep:
 
     def _apply_updates(self, trainable, grads, states, lr, t,
                        zero_flat_grads=None):
-        """Optimizer update dispatch: ZeRO-partitioned params go through the
-        shard_map path, everything else through the plain fused update."""
-        if not self._zero:
+        """Optimizer update dispatch: flat-ZeRO params go through the
+        shard_map path, ZeRO x TP params through a sharding-constrained
+        elementwise update (reduce-scatter over dp, update the chunk,
+        gather back to the tensor-sharded layout), everything else
+        through the plain fused update."""
+        if not self._zero and not self._zero_tp:
             return self.fopt.update(trainable, grads, states, lr=lr, t=t)
         new_tr, new_st = {}, {}
-        rest = {n: v for n, v in trainable.items() if n not in self._zero}
+        rest = {n: v for n, v in trainable.items()
+                if n not in self._zero and n not in self._zero_tp}
         if rest:
             p, s = self.fopt.update(
                 rest, {n: g for n, g in grads.items() if n in rest},
                 {n: states[n] for n in rest}, lr=lr, t=t)
             new_tr.update(p)
             new_st.update(s)
+        if self._zero_tp:
+            names = list(self._zero_tp)
+            tpw = {n: self._ztp_constrain(n, trainable[n]) for n in names}
+            tpg = {n: self._ztp_constrain(n, grads[n]) for n in names}
+            p, s = self.fopt.update(
+                tpw, tpg, {n: states[n] for n in names}, lr=lr, t=t)
+            # new weights gather back to the tensor-sharded layout; the
+            # state keeps the dp-inserted spec (jit out_shardings pin it)
+            new_tr.update({n: self._param_constrain(n, p[n])
+                           for n in names})
+            new_st.update(s)
+        if not self._zero:
+            return new_tr, new_st
         if zero_flat_grads is None:
             zero_flat_grads = {n: self._flat_pad(n, grads[n])
                                for n in self._zero}
@@ -472,16 +694,43 @@ class ShardedTrainStep:
         lr_val = opt.lr_scheduler(base + 1) if opt.lr_scheduler else opt.lr
         lr = jnp.asarray(lr_val, jnp.float32)
         t = jnp.asarray(base + 1, jnp.float32)
-        self.trainable, self.aux, self.states, loss = self._step(
-            self.trainable, self.aux, self.states, rng, lr, t, *raws)
+        if self._act_rules:
+            # sp: install the activation rules around the call so the
+            # layers' constrain() hooks and the ring-attention routing see
+            # them while jit traces (first call) — no-op afterwards
+            with activation_sharding(self.mesh, **self._act_rules):
+                out = self._step(
+                    self.trainable, self.aux, self.states, rng, lr, t,
+                    *raws)
+        else:
+            out = self._step(
+                self.trainable, self.aux, self.states, rng, lr, t, *raws)
+        self.trainable, self.aux, self.states, loss = out
         self._n_step += self.steps_per_call
-        if self._zero and _telemetry.active():
+        if (self._zero or self._zero_tp) and _telemetry.active():
             rs_per_update = self.grad_accum if self.zero >= 2 else 1
+            zb = self._zero_bytes + self._zero_tp_bytes
             _telemetry.inc("zero.reduce_scatter_bytes_total",
-                           self._zero_bytes * self.steps_per_call
-                           * rs_per_update)
+                           zb * self.steps_per_call * rs_per_update)
             _telemetry.inc("zero.all_gather_bytes_total",
-                           self._zero_bytes * self.steps_per_call)
+                           zb * self.steps_per_call)
+        if _telemetry.active():
+            # analytic per-axis mesh traffic (logical estimates, same
+            # spirit as the zero.* counters) for the bench mesh rows
+            shape = dict(self.mesh.shape)
+            if shape.get(self.dp_axis, 1) > 1:
+                _telemetry.inc("mesh.dp_gradient_bytes_total",
+                               self._trainable_bytes * self.steps_per_call)
+            tokens = int(raws[0].size) if raws else 0
+            if self._tp_row_out_units and tokens:
+                act = sum(L * u for L, u in self._tp_row_out_units)
+                _telemetry.inc("mesh.tp_allreduce_bytes_total",
+                               tokens * act * 4)
+            pp_n = shape.get("pp", 1)
+            if pp_n > 1 and self._pp_width and tokens:
+                _telemetry.inc("mesh.pp_stage_transfer_bytes_total",
+                               tokens * self._pp_width * 4
+                               * (pp_n - 1) * 2)
         return _wrap(loss)
 
     def prefetch(self, batches, depth=None, stall_timeout=None):
@@ -531,13 +780,24 @@ class ShardedTrainStep:
         cfg = result.config
         if cfg is None:  # every trial failed: keep the caller's config
             return self, result
+        mesh = self.mesh_config or self.mesh
+        batch_specs, param_specs, dp_axis = (
+            self.batch_specs, self.param_specs, self.dp_axis)
+        if cfg.get("mesh"):
+            # a mesh-axis search won on a different layout: rebuild the
+            # step around the winning MeshConfig (specs re-derive)
+            mesh = MeshConfig(**cfg["mesh"])
+            batch_specs = mesh.batch_specs(
+                *[len(s) if s is not None else 2 for s in self.batch_specs])
+            param_specs = None
+            dp_axis = "dp"
         tuned = ShardedTrainStep(
-            self.block, self.loss_fn, self.fopt.opt, self.mesh,
-            self.batch_specs, n_labels=self.n_labels,
-            param_specs=self.param_specs,
+            self.block, self.loss_fn, self.fopt.opt, mesh,
+            batch_specs, n_labels=self.n_labels,
+            param_specs=param_specs,
             steps_per_call=cfg["steps_per_call"], zero=cfg["zero"],
             grad_accum=cfg["grad_accum"], remat=cfg["remat"],
-            dp_axis=self.dp_axis)
+            dp_axis=dp_axis)
         tuned._n_step = self._n_step
         return tuned, result
 
@@ -545,7 +805,7 @@ class ShardedTrainStep:
         """Write current sharded weights back into the Block's Parameters
         (for save_parameters / eager eval after training)."""
         params = self.block.collect_params()
-        for n, v in {**self.trainable, **self.aux}.items():
+        for n, v in self._expand_pp({**self.trainable, **self.aux}).items():
             params[n]._data._rebind(v)
 
     # -- checkpoint / resume ------------------------------------------------
@@ -553,43 +813,64 @@ class ShardedTrainStep:
         """Gather weights + optimizer state to host numpy in a CANONICAL
         topology-independent layout: dp-partitioned (zero>0) state leaves
         are all-gathered, un-padded and reshaped back to their weight's
-        shape — a bundle saved at one dp size (or zero level) restores at
-        any other."""
+        shape, tp/sp shards gather to the full weight, and pp-stacked
+        layer families unstack back to their per-layer names — a bundle
+        saved at one (dp, tp, pp) layout restores bitwise at any other."""
         arrays = {}
-        for n, v in self.trainable.items():
+        for n, v in self._expand_pp(dict(self.trainable)).items():
             arrays[f"trainable/{n}"] = onp.asarray(v)
-        for n, v in self.aux.items():
+        for n, v in self._expand_pp(dict(self.aux)).items():
             arrays[f"aux/{n}"] = onp.asarray(v)
         for n, s in self.states.items():
             zinfo = self._zero.get(n)
+            grp = self._pp_groups.get(n)
             for i, leaf in enumerate(jax.tree_util.tree_leaves(s)):
                 a = onp.asarray(leaf)
                 if zinfo is not None:
                     shape, size, _ = zinfo
                     a = a[:size].reshape(shape)
-                arrays[f"state/{n}/{i}"] = a
+                if grp is not None:
+                    for j, member in enumerate(grp["members"]):
+                        arrays[f"state/{member}/{i}"] = a[j]
+                else:
+                    arrays[f"state/{n}/{i}"] = a
         return {"arrays": arrays, "n_step": int(self._n_step)}
 
     def load_state_dict(self, bundle):
         """Restore from ``state_dict()``: values re-shard per THIS step's
-        param_specs / zero layout (which may differ from the saving run's —
-        resume on a different dp size re-pads and re-partitions here)."""
+        param_specs / zero / pipeline layout (which may differ from the
+        saving run's — resume on a different (dp, tp, pp) re-stacks,
+        re-pads and re-partitions the canonical arrays here)."""
         arrays = bundle["arrays"]
 
         def sh(n):
             return NamedSharding(self.mesh, self.param_specs.get(n, P()))
 
+        def gather(prefix, n):
+            # pp-stacked names re-stack from their canonical per-layer
+            # entries; everything else reads directly
+            grp = self._pp_groups.get(n)
+            if grp is not None:
+                return onp.stack([arrays[f"{prefix}/{m}"]
+                                  for m in grp["members"]])
+            return arrays[f"{prefix}/{n}"]
+
         for n in self.trainable:
-            self.trainable[n] = jax.device_put(
-                arrays[f"trainable/{n}"], sh(n))
+            self.trainable[n] = jax.device_put(gather("trainable", n), sh(n))
         for n in self.aux:
-            self.aux[n] = jax.device_put(arrays[f"aux/{n}"], sh(n))
+            self.aux[n] = jax.device_put(gather("aux", n), sh(n))
         for n, s in self.states.items():
             leaves, treedef = jax.tree_util.tree_flatten(s)
             zinfo = self._zero.get(n)
+            grp = self._pp_groups.get(n)
+            tspec = self._zero_tp.get(n)
             new = []
             for i in range(len(leaves)):
-                a = arrays[f"state/{n}/{i}"]
+                if grp is not None:
+                    a = onp.stack([arrays[f"state/{m}/{i}"]
+                                   for m in grp["members"]])
+                else:
+                    a = arrays[f"state/{n}/{i}"]
                 if zinfo is not None:
                     _, size, padded = zinfo
                     flat = onp.ravel(a)
@@ -597,6 +878,9 @@ class ShardedTrainStep:
                         flat = onp.pad(flat, (0, padded - size))
                     new.append(jax.device_put(
                         flat, NamedSharding(self.mesh, P(self.dp_axis))))
+                elif tspec is not None:
+                    new.append(jax.device_put(
+                        a, NamedSharding(self.mesh, tspec)))
                 else:
                     new.append(jax.device_put(a, sh(n)))
             self.states[n] = jax.tree_util.tree_unflatten(treedef, new)
