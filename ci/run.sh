@@ -10,6 +10,9 @@
 #   contracts  - __graft_entry__.py (jit entry + multichip dryrun), bench
 #                smoke on CPU
 #   chaos      - fault-injection suite + a small MXNET_FAULT_SPEC matrix
+#                + the fleet host-loss drill: degrade dp 2 -> 1 with
+#                tp/pp preserved, bitwise bundle restore, loss parity
+#                with the uninterrupted oracle, re-expand on rejoin
 #                (docs/FAULT_TOLERANCE.md)
 #   telemetry  - metrics/observability suite + the disabled-fast-path
 #                overhead budget (docs/OBSERVABILITY.md)
@@ -147,6 +150,84 @@ chaos() {
         MXNET_FAULT_SPEC="$spec" python -m pytest \
             tests/test_fault_injection.py -q -k env_spec
     done
+    echo "== chaos: fleet host-loss drill (degrade -> bitwise restore -> re-expand) =="
+    tmp=$(mktemp -d)
+    cat > "$tmp/drill.py" <<'PY'
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.fleet import FleetSupervisor
+from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+from mxnet_tpu.parallel import MeshConfig, ShardedTrainStep
+
+VOCAB, UNITS, LAYERS, HEADS, SEQ, BATCH = 64, 16, 2, 2, 8, 8
+
+
+def batch(seed):
+    rs = onp.random.RandomState(seed)
+    return (rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32),
+            rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32))
+
+
+def loss_fn(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+
+def make_step(cfg):
+    mx.random.seed(0)
+    net = GPTForCausalLM(vocab_size=VOCAB, units=UNITS, num_layers=LAYERS,
+                         num_heads=HEADS, max_length=SEQ, dropout=0.0,
+                         embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.array(batch(0)[0]))
+    opt = mx.optimizer.create("sgd", learning_rate=0.01)
+    return ShardedTrainStep(net, loss_fn, opt, cfg,
+                            cfg.batch_specs(2, 2), n_labels=1)
+
+
+telemetry.enable()
+cfg = MeshConfig(dp=2, tp=2, pp=2)
+
+oracle_step = make_step(cfg)
+oracle = {s: float(oracle_step(*batch(s))) for s in range(1, 9)}
+
+step = make_step(cfg)
+bundle = os.path.join(os.environ["DRILL_DIR"], "run.bundle")
+state = mx.resilience.TrainState(path=bundle, sharded_step=step)
+sup = FleetSupervisor(step, state, n_hosts=2, host_index=0,
+                      checkpoint_every=1)
+mx.fault.configure("fleet.host_loss:at=4,times=1")
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")      # the 4-device mesh strands 4 of 8
+    losses = sup.run(batch, 6)
+    assert sup.degrades == 1, sup.degrades
+    assert sup.current == MeshConfig(dp=1, tp=2, pp=2), sup.current
+    sup.restore_hosts()
+    losses.update(sup.run(batch, 8))
+assert sup.reexpands == 1 and sup.current == cfg, (sup.reexpands, sup.current)
+assert sorted(losses) == list(range(1, 9)), sorted(losses)
+for s, ref in oracle.items():
+    got = float(losses[s])
+    assert abs(got - ref) < 1e-5, (s, got, ref)
+counts = telemetry.counters(aggregate=True)
+assert counts.get("fleet.degrades_total", 0) >= 1, counts
+assert counts.get("fleet.reexpands_total", 0) >= 1, counts
+print("FLEET_DRILL_OK degrades=%d reexpands=%d" %
+      (sup.degrades, sup.reexpands))
+PY
+    JAX_PLATFORMS=cpu DRILL_DIR="$tmp" \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$tmp/drill.py" | grep "FLEET_DRILL_OK"
+    rm -rf "$tmp"
 }
 
 telemetry() {

@@ -66,6 +66,7 @@ from . import image
 from . import contrib
 from . import serialization
 from . import resilience
+from . import fleet
 from . import serve
 from . import autotune
 from . import storage

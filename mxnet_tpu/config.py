@@ -310,6 +310,52 @@ declare("analyze.report_path", str, "", "MXNET_ANALYZE_REPORT",
         "Saved tools/mxlint.py --json document to fold into training-run "
         "reports as the 'analyze' plane ('' = only in-process "
         "mx.analyze.run_suite results are reported).")
+declare("fleet.lease_dir", str, "", "MXNET_FLEET_LEASE_DIR",
+        "Shared directory for the file-backed heartbeat-lease fallback "
+        "of the mx.fleet health plane ('' = coordination-service only). "
+        "Every host renews host-<rank>.lease there; peers whose lease "
+        "age exceeds fleet.lease_timeout are treated as lost.")
+declare("fleet.lease_interval", float, 1.0, "MXNET_FLEET_LEASE_INTERVAL",
+        "Seconds between heartbeat-lease renewals published by the "
+        "mx.fleet health plane's background thread.")
+declare("fleet.lease_timeout", float, 5.0, "MXNET_FLEET_LEASE_TIMEOUT",
+        "Lease age (seconds) past which a peer host counts as lost: the "
+        "fleet supervisor re-plans the mesh over the survivors. Keep "
+        "comfortably above fleet.lease_interval.")
+declare("fleet.step_deadline", float, 0.0, "MXNET_FLEET_STEP_DEADLINE",
+        "Wall-clock budget (seconds) for one training step before the "
+        "fleet watchdog treats the host as wedged and escalates a "
+        "structured WorkerLost (0 = watchdog off; stragglers are gauged "
+        "at fleet.slow_fraction of the deadline either way).")
+declare("fleet.slow_fraction", float, 0.5, "MXNET_FLEET_SLOW_FRACTION",
+        "Fraction of fleet.step_deadline past which a host counts as a "
+        "straggler (fleet.stragglers gauge) while still making progress "
+        "— slow, not wedged.")
+declare("fleet.min_dp", int, 1, "MXNET_FLEET_MIN_DP",
+        "Floor on the data-parallel axis the degrade planner may shrink "
+        "to after host loss; when no surviving layout reaches it the "
+        "supervisor parks (fleet.parked gauge) and waits for capacity "
+        "instead of training on a uselessly small mesh.")
+declare("resilience.keep_bundles", int, 3, "MXNET_RESILIENCE_KEEP_BUNDLES",
+        "Valid TrainState bundle generations retained by save() as the "
+        "degrade path's fallback chain (<path>.gN history hard-links); "
+        "torn and older generations are deleted at save time. 0 keeps "
+        "only the primary bundle file.")
+declare("resilience.restart_window_steps", int, 1000,
+        "MXNET_RESILIENCE_RESTART_WINDOW",
+        "Healthy-progress window (optimizer steps between WorkerLost "
+        "events) after which mx.resilience.run's restart budget resets, "
+        "so N transient faults spread over a long run don't exhaust "
+        "resilience.max_restarts; 0 keeps the budget monotonic.")
+declare("serve.max_queue", int, 0, "MXNET_SERVE_MAX_QUEUE",
+        "Bound on requests waiting for a decode slot; submit() past it "
+        "raises a structured EngineBusy (counted as "
+        "serve.rejected_total) so callers get backpressure instead of "
+        "an unbounded queue. 0 = unbounded.")
+declare("serve.health_window", float, 30.0, "MXNET_SERVE_HEALTH_WINDOW",
+        "Seconds without a decode step while work is pending before the "
+        "serve engine reports itself unhealthy on the ops /healthz "
+        "endpoint (step-loop liveness, not static OK).")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

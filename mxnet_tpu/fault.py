@@ -75,6 +75,21 @@ POINTS = {
         "per trial, before its step compiles): the candidate is recorded "
         "as oom in autotune.* telemetry and the search continues to the "
         "next grid point",
+    "fleet.host_loss":
+        "a peer host vanishes mid-run (its heartbeat lease expires with "
+        "no clean exit; probed once per step): the fleet supervisor "
+        "re-plans the mesh over the surviving devices, restores the "
+        "last valid bundle bitwise, and continues at a smaller dp",
+    "fleet.slow_host":
+        "a host falls past fleet.slow_fraction of the step deadline but "
+        "keeps making progress (probed once per step): the watchdog "
+        "marks it a straggler (fleet.stragglers gauge) without killing "
+        "it — slow, not wedged",
+    "fleet.lease_lost":
+        "this host's own heartbeat lease cannot be renewed "
+        "(coordination service or lease dir unreachable): renewals are "
+        "counted as failures, /healthz turns red, and the heartbeat "
+        "keeps retrying",
 }
 
 _lock = threading.Lock()

@@ -1,0 +1,546 @@
+"""mx.fleet — health-plane-driven elastic mesh degradation.
+
+Reference parity: the reference's kvstore layer treats worker failure as
+a first-class event (SURVEY §4 — the parameter-server backends exist so a
+job outlives a node).  Our TPU-native stack restarts at the *same* world
+size: PR 3's ``resilience.run`` restores a bundle and re-enters, and
+PR 11 proved TrainState bundles restore bitwise across layouts — but
+nothing connected "a host died" to "pick a smaller layout and keep
+going".  This module is that composition:
+
+- :class:`HealthPlane` — per-host heartbeat lease (file-backed directory
+  for the CI harness, best-effort coordination-service mirror on real
+  fleets), a step-deadline watchdog that distinguishes *slow* (straggler
+  gauge) from *wedged* (structured :class:`~mxnet_tpu.resilience.
+  WorkerLost`), and a /healthz provider so the PR 9 ops endpoint turns
+  red when the local step loop or a peer's lease goes stale.
+- :func:`plan_layout` — pick the best :class:`MeshConfig` over the
+  surviving devices via ``mesh_factorizations``: preserve tp and pp
+  (their sharding is what the model was sized for), shrink dp, and park
+  below the ``fleet.min_dp`` floor rather than thrash.
+- :class:`FleetSupervisor` — the degrade/re-expand loop: on host loss it
+  re-plans the layout, rebuilds the :class:`ShardedTrainStep` around the
+  new mesh, restores the last *valid* bundle bitwise through the
+  topology-independent checkpoint path (``TrainState.load_latest_valid``
+  — a host can die mid-save and tear the primary), and keeps training;
+  when the host rejoins, it re-expands at the next checkpoint boundary.
+
+Chaos surface: the ``fleet.host_loss`` / ``fleet.slow_host`` /
+``fleet.lease_lost`` injection points drive the end-to-end drill (see
+tests/test_fleet.py and the ci/run.sh chaos stage): kill one host
+mid-epoch → survivors degrade dp → losses stay on the uninterrupted
+oracle trajectory → host returns → mesh re-expands.  Every transition is
+visible as ``fleet.*`` metrics and ``fleet``-category trace spans.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import config as _config
+from . import fault as _fault
+from . import resilience as _resilience
+from . import telemetry as _telemetry
+from . import trace as _trace
+from .base import MXNetError
+from .parallel.mesh import MeshConfig, mesh_factorizations
+
+__all__ = ["HealthPlane", "FleetSupervisor", "plan_layout"]
+
+_telemetry.declare_metric(
+    "fleet.peers_expected", "gauge",
+    "hosts the fleet supervisor expects in the mesh at full strength")
+_telemetry.declare_metric(
+    "fleet.peers_alive", "gauge",
+    "hosts currently holding a fresh heartbeat lease (or assumed alive "
+    "in single-process drills)")
+_telemetry.declare_metric(
+    "fleet.stragglers", "gauge",
+    "hosts past fleet.slow_fraction of the step deadline but still "
+    "making progress — slow, not wedged")
+_telemetry.declare_metric(
+    "fleet.parked", "gauge",
+    "1 while the supervisor is parked: too few devices survive to "
+    "satisfy fleet.min_dp, so it waits for hosts instead of thrashing")
+_telemetry.declare_metric(
+    "fleet.dp_size", "gauge",
+    "dp extent of the layout currently training (shrinks on degrade, "
+    "returns to the target on re-expand)")
+_telemetry.declare_metric(
+    "fleet.degrades_total", "counter",
+    "elastic degrades: host loss -> re-planned smaller layout -> "
+    "bitwise bundle restore -> training continues")
+_telemetry.declare_metric(
+    "fleet.reexpands_total", "counter",
+    "re-expansions back to the target layout after lost hosts rejoined "
+    "(applied at a checkpoint boundary)")
+_telemetry.declare_metric(
+    "fleet.heartbeats_total", "counter",
+    "heartbeat lease renewals published by this host")
+_telemetry.declare_metric(
+    "fleet.lease_renew_failures_total", "counter",
+    "failed attempts to renew this host's own lease (fleet.lease_lost "
+    "injection or an unreachable lease store)")
+_telemetry.declare_metric(
+    "fleet.lease_expiries_total", "counter",
+    "peer leases observed stale past fleet.lease_timeout — each one is "
+    "a detected host loss")
+
+
+def _gauge(name, value):
+    if _telemetry._active:
+        _telemetry.set_gauge(name, value)
+
+
+def _count(name, n=1, **labels):
+    if _telemetry._active:
+        _telemetry.inc(name, n, **labels)
+
+
+# ---------------------------------------------------------------------------
+# layout re-planning
+# ---------------------------------------------------------------------------
+
+def plan_layout(current, n_devices, min_dp=None):
+    """Pick the best :class:`MeshConfig` over ``n_devices`` surviving
+    devices, derived from the ``current`` (target) layout.
+
+    Preference order (lexicographic): keep BOTH tp and pp, then keep tp
+    (its sharding divides the weight matrices the model was sized for),
+    then keep pp, then maximize dp.  The sp extent is always preserved —
+    ring-attention geometry is part of the model's math, not capacity.
+    Returns ``None`` (park) when no exact-cover factorization exists or
+    the best one falls below the ``fleet.min_dp`` floor.
+    """
+    if min_dp is None:
+        min_dp = _config.get("fleet.min_dp")
+    candidates = [c for c in mesh_factorizations(n_devices,
+                                                 max_sp=current.sp)
+                  if c.sp == current.sp]
+    if not candidates:
+        return None
+    best = max(candidates, key=lambda c: (
+        c.tp == current.tp and c.pp == current.pp,
+        c.tp == current.tp,
+        c.pp == current.pp,
+        c.dp))
+    if best.dp < max(1, int(min_dp)):
+        return None
+    return best
+
+
+# ---------------------------------------------------------------------------
+# health plane
+# ---------------------------------------------------------------------------
+
+class HealthPlane:
+    """Per-host heartbeat lease + step-deadline watchdog.
+
+    Leases are JSON files ``host-<rank>.lease`` in ``fleet.lease_dir``
+    (a directory every host can reach — the 2-process CI harness points
+    it at a tmpdir), renewed every ``fleet.lease_interval`` seconds by
+    :meth:`beat` (or the :meth:`start` daemon thread).  When a jax
+    coordination service is up, each renewal is also mirrored into its
+    key-value store best-effort — the file store stays authoritative so
+    the plane works with no collective runtime at all.
+
+    :meth:`check_peers` classifies every peer:
+
+    - lease stale past ``fleet.lease_timeout`` → the host is LOST:
+      ``fleet.lease_expiries_total`` ticks and a structured
+      :class:`~mxnet_tpu.resilience.WorkerLost` (``op="lease"``) raises —
+      the same escalation the dist kvstore uses for dead collectives.
+    - lease fresh but its step counter stuck past ``fleet.step_deadline``
+      seconds → WEDGED: ``WorkerLost(op="step_deadline")``.
+    - step stuck past ``fleet.slow_fraction`` of the deadline → SLOW:
+      the ``fleet.stragglers`` gauge rises, nothing is killed.
+
+    The plane registers itself as the ``fleet`` /healthz provider: the
+    ops endpoint turns red (503) when this host's own renewals fail,
+    its local step loop is past the deadline, or a peer lease is stale.
+    """
+
+    def __init__(self, rank=0, nprocs=1, lease_dir=None, interval=None,
+                 timeout=None):
+        self.rank = int(rank)
+        self.nprocs = int(nprocs)
+        self.lease_dir = (lease_dir if lease_dir is not None
+                          else _config.get("fleet.lease_dir"))
+        self.interval = (float(interval) if interval is not None
+                         else _config.get("fleet.lease_interval"))
+        self.timeout = (float(timeout) if timeout is not None
+                        else _config.get("fleet.lease_timeout"))
+        self._step = 0
+        self._step_mono = time.monotonic()
+        self._renew_failing = False
+        self._seen: set[int] = set()
+        #: rank -> (last observed step, monotonic time it last advanced)
+        self._peer_progress: dict[int, tuple[int, float]] = {}
+        self._stragglers: set[int] = set()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- lease publication ----------------------------------------------
+
+    def _lease_path(self, rank):
+        return os.path.join(self.lease_dir, f"host-{int(rank)}.lease")
+
+    def beat(self, step=None):
+        """Publish one lease renewal.  Returns True on success; a failed
+        renewal (the ``fleet.lease_lost`` injection, or an unreachable
+        store) is counted and flips this host's /healthz check red while
+        the heartbeat keeps retrying."""
+        if step is not None:
+            self.note_step(step)
+        payload = {"rank": self.rank, "pid": os.getpid(),
+                   "step": int(self._step), "time": time.time()}
+        if _fault._active and _fault.fire("fleet.lease_lost",
+                                          step=step):
+            self._renew_failing = True
+            _count("fleet.lease_renew_failures_total")
+            _fault.record("fleet.lease_renew_failure")
+            return False
+        try:
+            if self.lease_dir:
+                os.makedirs(self.lease_dir, exist_ok=True)
+                path = self._lease_path(self.rank)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(json.dumps(payload))
+                os.replace(tmp, path)
+            self._publish_coord(payload)
+        except OSError:
+            self._renew_failing = True
+            _count("fleet.lease_renew_failures_total")
+            _fault.record("fleet.lease_renew_failure")
+            return False
+        self._renew_failing = False
+        _count("fleet.heartbeats_total")
+        return True
+
+    def _publish_coord(self, payload):
+        """Best-effort mirror into the jax coordination service (present
+        only under jax.distributed); the file store stays authoritative."""
+        try:
+            from jax._src import distributed as _dist
+            client = getattr(_dist.global_state, "client", None)
+            if client is None:
+                return
+            client.key_value_set(
+                f"mx.fleet/lease/{self.rank}/{payload['step']}",
+                json.dumps(payload))
+        except Exception:   # noqa: BLE001 - strictly best-effort
+            pass
+
+    def note_step(self, step):
+        """Record local training-loop progress (feeds the local watchdog
+        and the step number published in the lease)."""
+        step = int(step)
+        if step != self._step:
+            self._step = step
+            self._step_mono = time.monotonic()
+
+    # -- peer observation -----------------------------------------------
+
+    def peers(self):
+        """{rank: {"age": seconds since renewal, "step": last step}} for
+        every peer lease currently on disk (own rank excluded)."""
+        out = {}
+        if not self.lease_dir or not os.path.isdir(self.lease_dir):
+            return out
+        now = time.time()
+        for rank in range(self.nprocs):
+            if rank == self.rank:
+                continue
+            try:
+                with open(self._lease_path(rank)) as f:
+                    lease = json.loads(f.read())
+            except (OSError, ValueError):
+                continue
+            out[rank] = {"age": max(0.0, now - lease.get("time", 0.0)),
+                         "step": int(lease.get("step", 0))}
+            self._seen.add(rank)
+        return out
+
+    def check_peers(self):
+        """Classify every previously-seen peer; raises
+        :class:`~mxnet_tpu.resilience.WorkerLost` for the first LOST or
+        WEDGED one, updates the ``fleet.stragglers`` gauge for SLOW
+        ones.  Returns the ranks currently alive."""
+        leases = self.peers()
+        deadline = _config.get("fleet.step_deadline")
+        slow_at = deadline * _config.get("fleet.slow_fraction")
+        now = time.monotonic()
+        alive = []
+        self._stragglers.clear()
+        for rank in sorted(self._seen):
+            lease = leases.get(rank)
+            if lease is None or lease["age"] > self.timeout:
+                age = lease["age"] if lease else float("inf")
+                _count("fleet.lease_expiries_total")
+                _fault.record("fleet.lease_expiry")
+                raise _resilience.WorkerLost(
+                    op="lease", key=f"host-{rank}", rank=self.rank,
+                    nprocs=self.nprocs, attempts=1,
+                    last=f"lease age {age:.1f}s > fleet.lease_timeout "
+                         f"{self.timeout:.1f}s")
+            alive.append(rank)
+            if deadline > 0:
+                prev = self._peer_progress.get(rank)
+                if prev is None or prev[0] != lease["step"]:
+                    self._peer_progress[rank] = (lease["step"], now)
+                    continue
+                stuck = now - prev[1]
+                if stuck > deadline:
+                    raise _resilience.WorkerLost(
+                        op="step_deadline", key=f"host-{rank}",
+                        rank=self.rank, nprocs=self.nprocs, attempts=1,
+                        last=f"peer step {lease['step']} stuck "
+                             f"{stuck:.1f}s > fleet.step_deadline "
+                             f"{deadline:.1f}s (wedged)")
+                if stuck > slow_at > 0:
+                    self._stragglers.add(rank)
+        _gauge("fleet.stragglers", len(self._stragglers))
+        _gauge("fleet.peers_alive", len(alive) + 1)   # peers + self
+        return alive
+
+    # -- liveness (/healthz) --------------------------------------------
+
+    def healthz(self):
+        """The ``fleet`` /healthz provider (registered by :meth:`start`):
+        red when own renewals fail, the local step loop is past
+        ``fleet.step_deadline``, or a peer lease is stale."""
+        detail = {"rank": self.rank, "step": self._step,
+                  "renewing": not self._renew_failing}
+        ok = not self._renew_failing
+        deadline = _config.get("fleet.step_deadline")
+        if deadline > 0:
+            age = time.monotonic() - self._step_mono
+            detail["step_age_s"] = round(age, 3)
+            if age > deadline:
+                ok, detail["local"] = False, "wedged"
+        stale = [r for r, p in self.peers().items()
+                 if p["age"] > self.timeout]
+        if stale:
+            ok, detail["stale_peers"] = False, stale
+        detail["ok"] = ok
+        return detail
+
+    def start(self):
+        """Register the /healthz provider and start the daemon renewal
+        thread (one :meth:`beat` per ``fleet.lease_interval``)."""
+        _telemetry.register_health("fleet", self.healthz)
+        if self._thread is None:
+            self._stop.clear()
+
+            def _loop():
+                while not self._stop.is_set():
+                    self.beat()
+                    self._stop.wait(self.interval)
+
+            self._thread = threading.Thread(
+                target=_loop, name="mx-fleet-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Clean exit: stop renewing, withdraw the lease file (so peers
+        see a departure, not a loss), unregister from /healthz."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        _telemetry.unregister_health("fleet")
+        if self.lease_dir:
+            try:
+                os.remove(self._lease_path(self.rank))
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor
+# ---------------------------------------------------------------------------
+
+class FleetSupervisor:
+    """Elastic degrade/re-expand driver around ONE
+    :class:`~mxnet_tpu.parallel.ShardedTrainStep` and its
+    :class:`~mxnet_tpu.resilience.TrainState` bundle.
+
+    The device fleet is modeled as ``n_hosts`` equal shares of the
+    target layout's devices.  A host is lost either through the health
+    plane (a peer's lease expired → :class:`WorkerLost`) or through the
+    deterministic ``fleet.host_loss`` injection point (probed once per
+    step, single-process drills).  On loss::
+
+        plan_layout(target, surviving_devices)   # prefer tp/pp, shrink dp
+        step.rebuild(plan, sync=False)           # new mesh, same math
+        state.load_latest_valid()                # bitwise, torn-safe
+        ... training continues ...
+
+    Below the ``fleet.min_dp`` floor the supervisor PARKS (gauge
+    ``fleet.parked``) instead of thrashing; :meth:`restore_hosts`
+    unparks it.  Re-expansion back to the target layout happens at the
+    next checkpoint boundary after every lost host rejoined — the bundle
+    written there restores bitwise into the full mesh.  Each transition
+    emits ``fleet``-category trace spans and ``fleet.*`` counters.
+    """
+
+    def __init__(self, step, state, n_hosts=1, host_index=0, min_dp=None,
+                 checkpoint_every=1, health=None):
+        if step.mesh_config is None:
+            raise MXNetError(
+                "FleetSupervisor needs a ShardedTrainStep built from a "
+                "MeshConfig (elastic re-planning re-factorizes its axes)")
+        self.step = step
+        self.state = state
+        state.sharded_step = step
+        self.target = step.mesh_config
+        self.current = step.mesh_config
+        self.n_hosts = int(n_hosts)
+        self.host_index = int(host_index)
+        if self.n_hosts < 1 or self.target.size() % self.n_hosts:
+            raise MXNetError(
+                f"n_hosts={n_hosts} must divide the target layout's "
+                f"{self.target.size()} devices")
+        self._dev_per_host = self.target.size() // self.n_hosts
+        self.min_dp = (int(min_dp) if min_dp is not None
+                       else _config.get("fleet.min_dp"))
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.health = health
+        self._lost: set[int] = set()
+        self.parked = False
+        self.degrades = 0
+        self.reexpands = 0
+        _gauge("fleet.peers_expected", self.n_hosts)
+        _gauge("fleet.peers_alive", self.n_hosts)
+        _gauge("fleet.dp_size", self.current.dp)
+        _gauge("fleet.parked", 0)
+
+    # -- fleet membership ------------------------------------------------
+
+    def alive_hosts(self):
+        return [h for h in range(self.n_hosts) if h not in self._lost]
+
+    def lose_host(self, host):
+        """Mark ``host`` lost and re-plan immediately (the path both the
+        health plane and the ``fleet.host_loss`` injection drive)."""
+        if host in self._lost or host == self.host_index:
+            return
+        self._lost.add(host)
+        _fault.record("fleet.host_lost")
+        _gauge("fleet.peers_alive", self.n_hosts - len(self._lost))
+        self._replan()
+
+    def restore_hosts(self, *hosts):
+        """Mark lost hosts as rejoined (all of them by default).  The
+        mesh does NOT re-expand here — that happens at the next
+        checkpoint boundary, where a fresh bundle is guaranteed."""
+        if hosts:
+            self._lost.difference_update(int(h) for h in hosts)
+        else:
+            self._lost.clear()
+        _gauge("fleet.peers_alive", self.n_hosts - len(self._lost))
+        if self.parked:
+            self.parked = False
+            _gauge("fleet.parked", 0)
+
+    # -- plan / apply ----------------------------------------------------
+
+    def _replan(self):
+        avail = self._dev_per_host * (self.n_hosts - len(self._lost))
+        plan = (plan_layout(self.target, avail, min_dp=self.min_dp)
+                if avail else None)
+        if plan is None:
+            self.parked = True
+            _gauge("fleet.parked", 1)
+            _fault.record("fleet.park")
+            with _trace.span("fleet.park", category="fleet",
+                             devices=avail, min_dp=self.min_dp):
+                pass
+            return None
+        if plan != self.current:
+            self._apply(plan, kind="degrade")
+        return plan
+
+    def _apply(self, cfg, kind):
+        """Rebuild the step around ``cfg`` and restore the newest valid
+        bundle bitwise into it (step counter, RNG, optimizer state ride
+        along — the run resumes exactly at the last checkpoint)."""
+        with _trace.span(f"fleet.{kind}", category="fleet", dp=cfg.dp,
+                         tp=cfg.tp, pp=cfg.pp, devices=cfg.size()):
+            with _trace.span("fleet.rebuild", category="fleet"):
+                # sync=False: the dying layout's buffers may be gone;
+                # all state transfers through the canonical bundle
+                new_step = self.step.rebuild(cfg, sync=False)
+            self.step = new_step
+            self.state.sharded_step = new_step
+            if self.state.exists():
+                self.state.load_latest_valid()
+        self.current = cfg
+        _gauge("fleet.dp_size", cfg.dp)
+        if kind == "degrade":
+            self.degrades += 1
+            _count("fleet.degrades_total")
+            _fault.record("fleet.degrade")
+        else:
+            self.reexpands += 1
+            _count("fleet.reexpands_total")
+            _fault.record("fleet.reexpand")
+
+    def _maybe_reexpand(self):
+        if (self._lost or self.parked or self.current == self.target
+                or self.state.step % self.checkpoint_every):
+            return
+        self._apply(self.target, kind="reexpand")
+
+    # -- the per-step probe and the drill driver -------------------------
+
+    def probe(self, step_no=None):
+        """Run once per training step: advance the heartbeat, scrape the
+        health plane, and evaluate the deterministic fault points.
+        Returns False while parked."""
+        if self.health is not None:
+            self.health.beat(step=step_no)
+            try:
+                self.health.check_peers()
+            except _resilience.WorkerLost as e:
+                # map the dead peer's rank onto its host share
+                rank = int(str(e.key).rsplit("-", 1)[-1]) \
+                    if "-" in str(e.key) else 0
+                self.lose_host(rank)
+        if _fault._active and _fault.fire("fleet.slow_host", step=step_no):
+            _fault.record("fleet.straggler")
+            _gauge("fleet.stragglers", 1)
+        if _fault._active and _fault.fire("fleet.host_loss", step=step_no):
+            survivors = [h for h in self.alive_hosts()
+                         if h != self.host_index]
+            if survivors:   # nobody left to lose -> ignore the probe
+                self.lose_host(max(survivors))
+        self._maybe_reexpand()
+        return not self.parked
+
+    def run(self, batch_fn, total_steps):
+        """Drive training to ``total_steps``: probe, pull the batch FOR
+        THE STEP BEING (RE)COMPUTED via ``batch_fn(step_number)``, step,
+        checkpoint every ``checkpoint_every`` steps.  A degrade rolls the
+        step counter back to the last checkpoint, and ``batch_fn`` being
+        keyed by step number replays exactly the batches the oracle run
+        sees.  Returns {step: loss} for every step computed last (the
+        authoritative value per step — recomputed steps overwrite).
+        Parking breaks the loop; call :meth:`restore_hosts` then
+        ``run`` again to continue."""
+        losses = {}
+        while self.state.step < total_steps:
+            self.probe(self.state.step + 1)
+            if self.parked:
+                break
+            s = self.state.step + 1   # a degrade may have rolled us back
+            loss = self.step(*batch_fn(s))
+            losses[s] = loss
+            self.state.step = s
+            if s % self.checkpoint_every == 0 and self.state.path:
+                self.state.save()
+        return losses

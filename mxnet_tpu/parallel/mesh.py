@@ -132,6 +132,19 @@ class MeshConfig:
             return {"residual": P("dp", "sp", None)}
         return {}
 
+    def replace(self, **axes):
+        """A copy with the named axis sizes substituted, e.g.
+        ``cfg.replace(dp=1)`` — how the fleet supervisor derives a
+        degraded layout from the target one."""
+        shape = self.shape
+        for name in axes:
+            if name not in shape:
+                raise MXNetError(
+                    f"MeshConfig.replace: unknown axis {name!r}; "
+                    f"axes are {self.AXES}")
+        shape.update(axes)
+        return MeshConfig(**shape)
+
     def __repr__(self):
         return (f"MeshConfig(dp={self.dp}, tp={self.tp}, pp={self.pp}, "
                 f"sp={self.sp})")

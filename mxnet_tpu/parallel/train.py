@@ -262,6 +262,10 @@ class ShardedTrainStep:
             raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
         if remat is None and isinstance(getattr(block, "_flags", None), dict):
             remat = block._flags.get("remat")
+        # kept as given so rebuild() can re-construct an equivalent step
+        # around a different MeshConfig (fleet degrade/re-expand)
+        self._donate = bool(donate)
+        self._remat_arg = remat
         self._remat_policy = resolve_remat_policy(remat)
         self._remat_on = self._remat_policy is not _REMAT_OFF
         trainable, aux = functional.split_params(block)
@@ -800,6 +804,45 @@ class ShardedTrainStep:
             dp_axis=dp_axis)
         tuned._n_step = self._n_step
         return tuned, result
+
+    def rebuild(self, mesh, sync=True):
+        """Re-construct this step around a different :class:`MeshConfig`
+        (same block / loss / optimizer / zero / grad_accum / remat) — the
+        fleet supervisor's degrade/re-expand primitive.  Batch and param
+        specs re-derive from the new layout, so the result accepts the
+        same per-update batches at a different dp size.
+
+        ``sync=True`` writes the current sharded weights back into the
+        block first, so the rebuilt step starts from this step's live
+        training state; the fleet path passes ``sync=False`` because a
+        bitwise bundle restore immediately follows and the dying layout's
+        device buffers may no longer be gatherable.
+        """
+        if not isinstance(mesh, MeshConfig):
+            raise MXNetError(
+                f"rebuild needs a MeshConfig, got {type(mesh).__name__}")
+        if sync:
+            self.sync_to_block()
+        else:
+            # the block may still hold buffers the old step donated away;
+            # revive them as zeros of the right shape — the bundle restore
+            # that follows supplies the real values
+            for p in self.block.collect_params().values():
+                if p._data is None:
+                    continue
+                raw = p._data._data
+                if getattr(raw, "is_deleted", lambda: False)():
+                    p._data._rebind(jnp.zeros(raw.shape, raw.dtype))
+        batch_specs = mesh.batch_specs(
+            *[len(s) if s is not None else 2 for s in self.batch_specs])
+        rebuilt = ShardedTrainStep(
+            self.block, self.loss_fn, self.fopt.opt, mesh,
+            batch_specs, n_labels=self.n_labels, param_specs=None,
+            donate=self._donate, steps_per_call=self.steps_per_call,
+            zero=self.zero, grad_accum=self.grad_accum,
+            remat=self._remat_arg, dp_axis="dp")
+        rebuilt._n_step = self._n_step
+        return rebuilt
 
     def sync_to_block(self):
         """Write current sharded weights back into the Block's Parameters

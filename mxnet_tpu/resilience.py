@@ -227,7 +227,60 @@ class TrainState:
         _serialization.atomic_write_bytes(path, blob)
         _serialization.write_checksum(path)
         _event("bundle_save")
+        self._gc(path)
         return path
+
+    # -- retention ---------------------------------------------------------
+    @staticmethod
+    def _history(path):
+        """Existing ``<path>.gN`` generation bundles, oldest step first
+        (the zero-padded step number in the name makes lexical order
+        chronological)."""
+        import glob as _glob
+        suffix = _serialization.CHECKSUM_SUFFIX
+        return sorted(p for p in _glob.glob(_glob.escape(path) + ".g*")
+                      if not p.endswith(suffix))
+
+    def _gc(self, path):
+        """Retention GC, run after every successful ``save``: hard-link the
+        fresh primary into a ``<path>.gN`` generation (N = step), then
+        delete torn generations and everything older than the newest
+        ``resilience.keep_bundles`` — the guaranteed-valid fallback chain
+        :meth:`load_latest_valid` walks.  ``keep_bundles=0`` keeps the
+        primary only (pre-GC behaviour)."""
+        keep = _config.get("resilience.keep_bundles")
+        if keep <= 0:
+            return
+        suffix = _serialization.CHECKSUM_SUFFIX
+        gen = f"{path}.g{int(self.step):08d}"
+        for src, dst in ((path, gen), (path + suffix, gen + suffix)):
+            if os.path.exists(dst):
+                os.remove(dst)
+            try:
+                os.link(src, dst)
+            except OSError:                # filesystem without hard links
+                import shutil
+                shutil.copyfile(src, dst)
+        survivors = []
+        for p in self._history(path):
+            try:
+                _serialization.verify_checksum(p, required=True)
+            except MXNetError:
+                self._unlink_gen(p, suffix)
+                _event("bundle_gc", reason="torn")
+                continue
+            survivors.append(p)
+        for p in survivors[:-keep]:
+            self._unlink_gen(p, suffix)
+            _event("bundle_gc", reason="retention")
+
+    @staticmethod
+    def _unlink_gen(p, suffix):
+        for stale in (p, p + suffix):
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
 
     # -- restore -----------------------------------------------------------
     def load(self, path=None):
@@ -246,6 +299,36 @@ class TrainState:
                     f"{path}: corrupt TrainState bundle ({e})") from e
         self.restore(bundle)
         return bundle
+
+    def load_latest_valid(self, path=None):
+        """Restore from the newest bundle that passes validation: the
+        primary first, then the retention history (``<path>.gN``,
+        newest first).  The fleet degrade path uses this — a host can die
+        mid-``save`` and leave the primary torn, and the survivors must
+        fall back to the previous generation instead of dying on it.
+        Plain :meth:`load` keeps its strict raise-on-torn contract.
+        Returns the path actually restored."""
+        path = path or self.path
+        if path is None:
+            raise MXNetError(
+                "TrainState.load_latest_valid: no bundle path configured")
+        candidates = [path] + list(reversed(self._history(path)))
+        last_err = None
+        for p in candidates:
+            if not os.path.exists(p):
+                continue
+            try:
+                _serialization.verify_checksum(p)
+                with open(p, "rb") as f:
+                    bundle = pickle.loads(f.read())
+            except Exception as e:   # noqa: BLE001 - torn: try the next gen
+                last_err = e
+                continue
+            self.restore(bundle)
+            return p
+        raise MXNetError(
+            f"TrainState.load_latest_valid: no valid bundle at {path!r} "
+            f"or its history; last error: {last_err}")
 
     def restore(self, bundle):
         """Apply an already-deserialized bundle to the live objects."""
@@ -305,7 +388,9 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
     """
     budget = (max_restarts if max_restarts is not None
               else _config.get("resilience.max_restarts"))
+    window = _config.get("resilience.restart_window_steps")
     restarts = 0
+    prev_step = None
     while True:
         try:
             return train_fn()
@@ -315,6 +400,14 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
                 raise SystemExit(RESUME_EXIT_CODE)
             raise
         except WorkerLost as e:
+            # a healthy-progress window between faults forgives the budget:
+            # N transient faults spread over days should not add up to the
+            # same death sentence as N faults in a tight crash loop
+            cur = state.step if state is not None else None
+            if (window > 0 and cur is not None and prev_step is not None
+                    and cur - prev_step >= window):
+                restarts = 0
+                _event("restart_budget_reset")
             if restarts >= budget:
                 _event("restart_budget_exhausted")
                 raise
@@ -322,5 +415,6 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
             _event("worker_lost", op=e.op)
             if state is not None and state.exists():
                 state.load()
+                prev_step = state.step
             _event("restart")
             clear_preempt()

@@ -47,7 +47,7 @@ from .. import trace as _trace
 from ..base import MXNetError
 from . import quantize as _quantize
 
-__all__ = ["Request", "ServeEngine", "load"]
+__all__ = ["Request", "ServeEngine", "EngineBusy", "load"]
 
 _telemetry.declare_metric(
     "serve.requests_total", "counter",
@@ -82,6 +82,11 @@ _telemetry.declare_metric(
 _telemetry.declare_metric(
     "serve.queue_depth", "gauge",
     "requests waiting for a free slot")
+_telemetry.declare_metric(
+    "serve.rejected_total", "counter",
+    "requests rejected by submit() (engine stopping, or the bounded "
+    "serve.max_queue backpressure) or discarded queued by "
+    "stop(drain=False)")
 _telemetry.declare_metric(
     "serve.slot_occupancy", "gauge",
     "slots holding a live request")
@@ -118,6 +123,22 @@ def _parse_quantize(quantize):
         raise MXNetError(f"conflicting weight modes in {quantize!r}")
     return ",".join(dict.fromkeys(modes)), \
         (weight[0] if weight else None), "int8_kv" in modes
+
+
+class EngineBusy(MXNetError):
+    """:meth:`ServeEngine.submit` rejected the request — the engine is
+    stopping, or the bounded queue (``serve.max_queue``) is full.
+    Structured so callers can backpressure instead of string-matching:
+    ``reason`` ("stopping" / "queue_full"), ``queued`` (depth at
+    rejection), ``max_queue`` (the bound; 0 = unbounded)."""
+
+    def __init__(self, reason, queued, max_queue):
+        self.reason = reason
+        self.queued = queued
+        self.max_queue = max_queue
+        bound = f", bound {max_queue} (serve.max_queue)" if max_queue else ""
+        super().__init__(
+            f"serve engine busy ({reason}): {queued} queued{bound}")
 
 
 class Request:
@@ -302,6 +323,24 @@ class ServeEngine:
         self._next_id = 0
         self._steps = 0
         self._completed = []
+        self._stopping = False
+        self._max_queue = int(_config.get("serve.max_queue"))
+        self._last_step_time = None
+        self._created = time.monotonic()
+        # the ops endpoint's /healthz reflects THIS engine's step-loop
+        # liveness (a process hosts one serving engine; the newest wins).
+        # Bound weakly: a collected engine must not pin a stale check.
+        import weakref
+        ref = weakref.ref(self)
+
+        def _check():
+            eng = ref()
+            if eng is None:
+                _telemetry.unregister_health("serve")
+                return True
+            return eng._health()
+
+        self._health_name = _telemetry.register_health("serve", _check)
 
     # -- model/param plumbing -------------------------------------------
 
@@ -444,6 +483,14 @@ class ServeEngine:
         if not prompt:
             raise MXNetError("empty prompt")
         self.bucket_for(len(prompt))  # validate now, not at admission
+        if self._stopping:
+            if _telemetry._active:
+                _telemetry.inc("serve.rejected_total", reason="stopping")
+            raise EngineBusy("stopping", len(self._queue), self._max_queue)
+        if self._max_queue and len(self._queue) >= self._max_queue:
+            if _telemetry._active:
+                _telemetry.inc("serve.rejected_total", reason="queue_full")
+            raise EngineBusy("queue_full", len(self._queue), self._max_queue)
         req = Request(self._next_id, prompt, max_new_tokens,
                       self.eos_id if eos_id == "engine" else eos_id)
         self._next_id += 1
@@ -563,6 +610,7 @@ class ServeEngine:
         drain when the queue is starved, admit, dispatch ONE decode step
         for every live slot, defer the result. Returns False when fully
         idle (nothing queued, running, or pending drain)."""
+        self._last_step_time = time.monotonic()
         if self._queue and not self._free and len(self._window):
             # starved for slots: reclaim just enough, oldest first
             self._window.drain_oldest(1)
@@ -622,6 +670,60 @@ class ServeEngine:
                 break
         self.drain()
         return self
+
+    # -- shutdown / liveness ---------------------------------------------
+
+    def stop(self, drain=True):
+        """Graceful shutdown.  From the moment this is called,
+        :meth:`submit` raises :class:`EngineBusy` ("stopping").
+
+        ``drain=True`` finishes every in-flight AND queued request (runs
+        the step loop to completion) before returning; ``drain=False``
+        discards still-queued requests (each counted in
+        ``serve.rejected_total``) and only fetches the already-dispatched
+        deferred emits, leaving in-flight slots unfinished.  Either way
+        the engine's /healthz provider is unregistered.  Idempotent."""
+        if self._stopping:
+            return self
+        self._stopping = True
+        try:
+            if drain:
+                self.run()
+            else:
+                while self._queue:
+                    self._reject(self._queue.popleft(), "stopping")
+                self.drain()
+        finally:
+            _telemetry.unregister_health(self._health_name)
+        return self
+
+    def _reject(self, req, reason):
+        """Account a queued request discarded by stop(drain=False): its
+        spans close (rejected=True) and it never reaches a slot."""
+        if req._enq is not None:
+            req._enq.end()
+            req._enq = None
+        if req._span is not None:
+            req._span.end(rejected=True)
+            req._span = None
+        if _telemetry._active:
+            _telemetry.inc("serve.rejected_total", reason=reason)
+
+    def _health(self):
+        """/healthz provider: red while stopping, and red when the engine
+        has pending work but the step loop has not dispatched within
+        ``serve.health_window`` seconds (a wedged or abandoned loop — the
+        condition a static-OK healthz could never see)."""
+        if self._stopping:
+            return {"ok": False, "state": "stopping"}
+        if not self.pending:
+            return {"ok": True, "state": "idle", "steps": self._steps}
+        last = (self._last_step_time if self._last_step_time is not None
+                else self._created)
+        age = time.monotonic() - last
+        window = _config.get("serve.health_window")
+        return {"ok": age < window, "state": "serving",
+                "steps": self._steps, "last_step_age_s": round(age, 3)}
 
     # -- reporting -------------------------------------------------------
 

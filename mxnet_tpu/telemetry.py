@@ -56,7 +56,8 @@ __all__ = ["enable", "disable", "configure", "active", "inc", "set_gauge",
            "observe", "timed", "declare_metric", "note_compile", "counters",
            "summary_line", "snapshot", "exposition", "serve_http",
            "stop_http", "reset", "RecompileWarning", "TrainingTelemetry",
-           "CATALOG", "EXPOSITION_CONTENT_TYPE"]
+           "CATALOG", "EXPOSITION_CONTENT_TYPE", "register_health",
+           "unregister_health", "health"]
 
 _lock = threading.Lock()
 #: hot-path gate — instrumentation sites read this one attribute; False
@@ -160,6 +161,13 @@ declare_metric("resilience.preempt_signal_total", "counter",
                "preemption signals observed, by signal")
 declare_metric("resilience.restart_total", "counter",
                "supervised train-fn restarts after WorkerLost")
+declare_metric("resilience.restart_budget_reset_total", "counter",
+               "restart budgets reset after a healthy-progress window "
+               "(resilience.restart_window_steps) between WorkerLost "
+               "events")
+declare_metric("resilience.bundle_gc_total", "counter",
+               "TrainState bundle generations deleted by retention GC "
+               "(torn, or older than resilience.keep_bundles)")
 declare_metric("fault.events_total", "counter",
                "mx.fault injections and recovery events, by event")
 declare_metric("train.iter_seconds", "histogram",
@@ -562,6 +570,45 @@ EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _http_server = None
 
+#: liveness providers consulted by /healthz: name -> zero-arg callable
+#: returning a bool or a dict with an "ok" key. The fleet health plane
+#: and the serve engine register here so the endpoint reflects step-loop
+#: and lease liveness instead of a static OK.
+_health_providers: dict[str, object] = {}
+
+
+def register_health(name, provider):
+    """Register a liveness check under ``name`` (replaces a previous
+    one).  ``provider()`` -> bool or {"ok": bool, ...detail}; any check
+    that is falsy (or raises) turns /healthz red (HTTP 503)."""
+    with _lock:
+        _health_providers[name] = provider
+    return name
+
+
+def unregister_health(name):
+    with _lock:
+        _health_providers.pop(name, None)
+
+
+def health():
+    """Aggregate every registered liveness check.  Returns
+    ``(ok, checks)`` where checks is {name: {"ok": bool, ...}}."""
+    with _lock:
+        providers = dict(_health_providers)
+    ok, checks = True, {}
+    for name, fn in sorted(providers.items()):
+        try:
+            res = fn()
+        except Exception as e:   # noqa: BLE001 - a dead check is a red check
+            res = {"ok": False, "error": str(e)}
+        if not isinstance(res, dict):
+            res = {"ok": bool(res)}
+        res.setdefault("ok", True)
+        checks[name] = res
+        ok = ok and bool(res["ok"])
+    return ok, checks
+
 
 def serve_http(port=None):
     """Start the in-process ops endpoint (stdlib ``http.server``, daemon
@@ -606,10 +653,13 @@ def serve_http(port=None):
                 self._send(200, exposition(), EXPOSITION_CONTENT_TYPE)
             elif url.path == "/healthz":
                 from . import trace as _trace
-                self._send(200, json.dumps(
-                    {"status": "ok", "pid": os.getpid(),
+                ok, checks = health()
+                self._send(200 if ok else 503, json.dumps(
+                    {"status": "ok" if ok else "unhealthy",
+                     "pid": os.getpid(),
                      "telemetry_active": _active,
-                     "trace": _trace.stats()}), "application/json")
+                     "trace": _trace.stats(),
+                     "checks": checks}), "application/json")
             elif url.path == "/trace":
                 from . import trace as _trace
                 query = urllib.parse.parse_qs(url.query)

@@ -1,0 +1,362 @@
+"""mx.fleet — health-plane-driven elastic mesh degradation.
+
+Oracles: layout re-planning against hand-computed factorization
+preferences; the end-to-end chaos drill against an uninterrupted
+same-layout run (per-step loss parity after a degrade + bitwise bundle
+equality right after the rebuild); a real 2-process lease-expiry drill
+via subprocess (tests/fleet_worker.py).
+
+Chaos spec literals exercised here: "fleet.host_loss:at=4,times=1",
+"fleet.slow_host:at=1", "fleet.lease_lost:at=1".
+"""
+import glob
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.fleet import FleetSupervisor, HealthPlane, plan_layout
+from mxnet_tpu.parallel import ShardedTrainStep
+from mxnet_tpu.parallel.mesh import MeshConfig
+from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_state():
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    yield
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    telemetry.unregister_health("fleet")
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+# -- layout re-planning ------------------------------------------------------
+
+def test_plan_layout_preserves_tp_and_pp():
+    cur = MeshConfig(dp=2, tp=2, pp=2)
+    assert plan_layout(cur, 4) == MeshConfig(dp=1, tp=2, pp=2)
+    assert plan_layout(cur, 8) == cur
+
+
+def test_plan_layout_prefers_tp_over_pp_then_max_dp():
+    cur = MeshConfig(dp=2, tp=2, pp=2)
+    # 6 devices: tp=2 and pp=2 can't both survive (4 does not divide 6);
+    # tp survives, and among {dp=1 pp=3, dp=3 pp=1} the larger dp wins
+    assert plan_layout(cur, 6) == MeshConfig(dp=3, tp=2, pp=1)
+
+
+def test_plan_layout_preserves_sp():
+    cur = MeshConfig(dp=4, sp=2)
+    planned = plan_layout(cur, 4)
+    assert planned == MeshConfig(dp=2, sp=1).replace(sp=2)
+    assert planned.sp == cur.sp
+
+
+def test_plan_layout_parks_below_min_dp():
+    cur = MeshConfig(dp=2, tp=2, pp=2)
+    assert plan_layout(cur, 4, min_dp=2) is None
+    # odd device counts with no sp-compatible factorization park too
+    assert plan_layout(MeshConfig(dp=4, sp=2), 3) is None
+
+
+def test_plan_layout_min_dp_defaults_to_config_knob():
+    prev = mx.config.set("fleet.min_dp", 2)
+    try:
+        assert plan_layout(MeshConfig(dp=2, tp=2, pp=2), 4) is None
+    finally:
+        mx.config.set("fleet.min_dp", prev)
+
+
+def test_meshconfig_replace():
+    cfg = MeshConfig(dp=4, tp=2)
+    assert cfg.replace(dp=1) == MeshConfig(dp=1, tp=2)
+    assert cfg.replace(dp=1) is not cfg and cfg.dp == 4
+    with pytest.raises(mx.base.MXNetError, match="unknown axis"):
+        cfg.replace(ep=2)
+
+
+# -- supervisor state machine (no real mesh needed) -------------------------
+
+class _FakeStep:
+    mesh_config = MeshConfig(dp=2)
+
+
+def test_supervisor_parks_below_min_dp_and_unparks():
+    state = mx.resilience.TrainState()
+    sup = FleetSupervisor(_FakeStep(), state, n_hosts=2, min_dp=2)
+    mx.fault.configure("fleet.host_loss:at=1")
+    assert sup.probe(1) is False and sup.parked
+    assert mx.fault.stats().get("fleet.park") == 1
+    sup.restore_hosts()
+    assert not sup.parked and sup.alive_hosts() == [0, 1]
+
+
+def test_supervisor_marks_straggler_without_killing():
+    state = mx.resilience.TrainState()
+    sup = FleetSupervisor(_FakeStep(), state, n_hosts=2)
+    mx.fault.configure("fleet.slow_host:at=1")
+    assert sup.probe(1) is True          # slow, not wedged: nothing dies
+    assert sup.alive_hosts() == [0, 1] and sup.degrades == 0
+    assert mx.fault.stats().get("fleet.straggler") == 1
+
+
+def test_supervisor_ignores_host_loss_with_nobody_to_lose():
+    state = mx.resilience.TrainState()
+    sup = FleetSupervisor(_FakeStep(), state, n_hosts=1)
+    mx.fault.configure("fleet.host_loss:at=1")
+    assert sup.probe(1) is True and not sup._lost
+
+
+# -- health plane ------------------------------------------------------------
+
+def test_lease_lost_turns_healthz_red_then_recovers(tmp_path):
+    hp = HealthPlane(rank=0, nprocs=1, lease_dir=str(tmp_path))
+    mx.fault.configure("fleet.lease_lost:at=1")
+    assert hp.beat(step=1) is False      # renewal failed
+    assert hp.healthz()["ok"] is False
+    assert mx.fault.stats().get("fleet.lease_renew_failure") == 1
+    assert hp.beat(step=2) is True       # the heartbeat keeps retrying
+    assert hp.healthz()["ok"] is True
+
+
+def test_health_plane_detects_stale_peer(tmp_path):
+    a = HealthPlane(rank=0, nprocs=2, lease_dir=str(tmp_path),
+                    timeout=0.2)
+    b = HealthPlane(rank=1, nprocs=2, lease_dir=str(tmp_path))
+    a.beat(step=1)
+    b.beat(step=1)
+    assert a.check_peers() == [1]
+    time.sleep(0.3)                      # b stops renewing: lease rots
+    with pytest.raises(mx.resilience.WorkerLost) as ei:
+        a.check_peers()
+    assert ei.value.op == "lease" and "host-1" in str(ei.value.key)
+    assert a.healthz()["ok"] is False    # stale peer turns /healthz red
+
+
+def test_health_plane_clean_stop_is_departure_not_loss(tmp_path):
+    a = HealthPlane(rank=0, nprocs=2, lease_dir=str(tmp_path),
+                    timeout=0.2)
+    b = HealthPlane(rank=1, nprocs=2, lease_dir=str(tmp_path))
+    b.beat(step=1)
+    a.beat(step=1)
+    assert a.peers()
+    b.stop()                             # withdraws the lease file
+    assert a.peers() == {}
+
+
+def test_healthz_endpoint_surfaces_provider_state():
+    telemetry.register_health("fleet", lambda: {"ok": False, "why": "x"})
+    ok, checks = telemetry.health()
+    assert ok is False and checks["fleet"]["why"] == "x"
+    telemetry.unregister_health("fleet")
+    assert telemetry.health()[0] is True
+
+
+# -- resilience satellites ---------------------------------------------------
+
+def test_bundle_retention_gc_keeps_last_k(tmp_path):
+    path = str(tmp_path / "t.bundle")
+    state = mx.resilience.TrainState(path=path)
+    for s in range(1, 6):
+        state.step = s
+        state.save()
+    gens = [os.path.basename(p) for p in state._history(path)]
+    assert gens == ["t.bundle.g00000003", "t.bundle.g00000004",
+                    "t.bundle.g00000005"]
+    assert mx.fault.stats().get("resilience.bundle_gc") == 2
+
+
+def test_load_latest_valid_falls_back_past_torn_primary(tmp_path):
+    path = str(tmp_path / "t.bundle")
+    state = mx.resilience.TrainState(path=path)
+    for s in (1, 2):
+        state.step = s
+        state.save()
+    # tear the primary the way a mid-save death does: bytes that no
+    # longer match the sidecar (new inode, so the .g2 hard link survives)
+    os.remove(path)
+    with open(path, "wb") as f:
+        f.write(b"torn")
+    fresh = mx.resilience.TrainState(path=path)
+    with pytest.raises(mx.base.MXNetError, match="checksum|corrupt"):
+        fresh.load()                     # strict load still refuses
+    restored = fresh.load_latest_valid()
+    assert restored.endswith(".g00000002") and fresh.step == 2
+
+
+def test_restart_budget_resets_after_healthy_window(tmp_path):
+    prev = mx.config.set("resilience.restart_window_steps", 10)
+    try:
+        state = mx.resilience.TrainState(path=str(tmp_path / "b.bundle"))
+        state.save()
+        calls = []
+
+        def train():
+            calls.append(state.step)
+            if len(calls) < 4:
+                state.step += 100        # healthy progress, then a fault
+                raise mx.resilience.WorkerLost(
+                    "allreduce", "w", 0, 2, 3, "transient")
+            return "done"
+
+        # budget 1, but three spread-out faults: each restart is forgiven
+        # because >= 10 steps of progress separated the losses
+        assert mx.resilience.run(train, state=state,
+                                 max_restarts=1) == "done"
+        assert len(calls) == 4
+        assert mx.fault.stats().get("resilience.restart_budget_reset") == 2
+    finally:
+        mx.config.set("resilience.restart_window_steps", prev)
+        mx.resilience.clear_preempt()
+
+
+def test_restart_budget_still_exhausts_in_a_tight_loop(tmp_path):
+    prev = mx.config.set("resilience.restart_window_steps", 10)
+    try:
+        state = mx.resilience.TrainState(path=str(tmp_path / "b.bundle"))
+        state.save()
+
+        def train():                     # no progress between faults
+            raise mx.resilience.WorkerLost("allreduce", "w", 0, 2, 3, "x")
+
+        with pytest.raises(mx.resilience.WorkerLost):
+            mx.resilience.run(train, state=state, max_restarts=1)
+    finally:
+        mx.config.set("resilience.restart_window_steps", prev)
+        mx.resilience.clear_preempt()
+
+
+# -- the end-to-end degrade drill (8 virtual devices) ------------------------
+
+VOCAB, UNITS, LAYERS, HEADS, SEQ, BATCH = 64, 16, 2, 2, 8, 8
+
+eight = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs 8 devices (XLA_FLAGS=--xla_force_host_platform_"
+           "device_count=8)")
+
+
+def _batch(seed=0):
+    rs = onp.random.RandomState(seed)
+    x = rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32)
+    y = rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32)
+    return x, y
+
+
+def _loss_fn(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+
+def _gpt_step(cfg, x, lr=0.01):
+    mx.random.seed(0)
+    net = GPTForCausalLM(vocab_size=VOCAB, units=UNITS, num_layers=LAYERS,
+                         num_heads=HEADS, max_length=SEQ, dropout=0.0,
+                         embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.array(x))                  # materialize deferred params
+    opt = mx.optimizer.create("sgd", learning_rate=lr)
+    return ShardedTrainStep(net, _loss_fn, opt, cfg,
+                            cfg.batch_specs(2, 2), n_labels=1)
+
+
+def _assert_bitwise(sd_a, sd_b):
+    assert sd_a["n_step"] == sd_b["n_step"]
+    assert set(sd_a["arrays"]) == set(sd_b["arrays"])
+    for k, a in sd_a["arrays"].items():
+        b = sd_b["arrays"][k]
+        assert onp.asarray(a).shape == onp.asarray(b).shape, k
+        assert onp.array_equal(onp.asarray(a), onp.asarray(b)), k
+
+
+@eight
+def test_degrade_drill_bitwise_and_loss_parity(tmp_path, metrics):
+    """The tentpole drill: host loss at step 4 -> dp shrinks 2 -> 1 with
+    tp/pp preserved -> bundle restores bitwise into the smaller mesh ->
+    per-step losses stay on the uninterrupted oracle trajectory -> the
+    host returns -> the mesh re-expands at the next checkpoint."""
+    import warnings
+    cfg = MeshConfig(dp=2, tp=2, pp=2)
+    x0, _ = _batch(0)
+
+    step_o = _gpt_step(cfg, x0)
+    oracle = {}
+    for s in range(1, 9):
+        oracle[s] = float(step_o(*_batch(s)))
+
+    step = _gpt_step(cfg, x0)
+    state = mx.resilience.TrainState(path=str(tmp_path / "run.bundle"),
+                                     sharded_step=step)
+    sup = FleetSupervisor(step, state, n_hosts=2, host_index=0,
+                          checkpoint_every=1)
+    # times=1: a degrade rolls the step counter back, and the replayed
+    # probe of step 4 must not kill a second host
+    mx.fault.configure("fleet.host_loss:at=4,times=1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # 4-device mesh strands 4 of 8
+        losses = sup.run(_batch, 6)
+        assert sup.degrades == 1
+        assert sup.current == MeshConfig(dp=1, tp=2, pp=2)
+        # bitwise: the rebuilt step's canonical state == the bundle it
+        # restored from (step counter, RNG and optimizer state included)
+        import pickle
+        bundle = pickle.loads(open(state.path, "rb").read())
+        _assert_bitwise(sup.step.state_dict(), bundle["sharded_step"])
+
+        sup.restore_hosts()              # the host rejoins
+        losses.update(sup.run(_batch, 8))
+    assert sup.reexpands == 1 and sup.current == cfg
+    assert sorted(losses) == list(range(1, 9))
+    for s, ref in oracle.items():
+        assert abs(float(losses[s]) - ref) < 1e-5, (s, float(losses[s]), ref)
+    counts = telemetry.counters(aggregate=True)
+    assert counts.get("fleet.degrades_total", 0) >= 1
+    assert counts.get("fleet.reexpands_total", 0) >= 1
+
+
+# -- the 2-process lease drill ----------------------------------------------
+
+def test_multiprocess_lease_expiry_raises_worker_lost(tmp_path):
+    """Two real processes share a lease dir; rank 1 heartbeats, then
+    vanishes without a clean stop.  Rank 0's health plane must observe
+    the rotting lease and escalate the structured WorkerLost."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    worker = os.path.join(REPO, "tests", "fleet_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(tmp_path), str(rank), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    assert procs[1].returncode == 0 and "FLEET_BEAT 1" in outs[1], outs[1]
+    assert procs[0].returncode == 0, outs[0]
+    assert "FLEET_LOST 0 lease host-1" in outs[0], outs[0]

@@ -15,7 +15,7 @@ import mxnet_tpu as mx
 from mxnet_tpu import telemetry
 from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
 from mxnet_tpu.serve import quantize as squant
-from mxnet_tpu.serve.engine import _parse_buckets
+from mxnet_tpu.serve.engine import EngineBusy, _parse_buckets
 
 
 def _tiny(**kw):
@@ -552,3 +552,62 @@ def test_quantiles_ride_jsonl_reports(metrics, tmp_path):
     final = [r for r in records if r.get("type") == "run_report"][-1]
     hists = final["metrics"]["histograms"]
     assert "quantiles" in hists["q.y"]
+
+
+# -- graceful drain, backpressure, /healthz ---------------------------------
+
+def test_submit_backpressure_bounded_queue(metrics):
+    prev = mx.config.set("serve.max_queue", 2)
+    try:
+        eng = _engine()
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.submit([4, 5], max_new_tokens=2)
+        with pytest.raises(EngineBusy) as ei:
+            eng.submit([6], max_new_tokens=2)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.queued == 2 and ei.value.max_queue == 2
+        assert telemetry.counters(aggregate=True).get(
+            "serve.rejected_total") == 1
+        eng.run()                        # queue drains: admission reopens
+        assert eng.submit([7], max_new_tokens=1) is not None
+        eng.stop()
+    finally:
+        mx.config.set("serve.max_queue", prev)
+
+
+def test_stop_drain_finishes_in_flight_and_rejects_new(metrics):
+    eng = _engine()
+    reqs = [eng.submit([1, 2, 3], max_new_tokens=3) for _ in range(3)]
+    eng.stop(drain=True)
+    assert all(r.finished for r in reqs)
+    with pytest.raises(EngineBusy) as ei:
+        eng.submit([4], max_new_tokens=1)
+    assert ei.value.reason == "stopping"
+    eng.stop()                           # idempotent
+
+
+def test_stop_no_drain_discards_queued(metrics):
+    eng = _engine(max_slots=1)
+    a = eng.submit([1, 2], max_new_tokens=2)
+    b = eng.submit([3, 4], max_new_tokens=2)
+    eng.stop(drain=False)
+    assert not a.finished and not b.finished and not eng.pending
+    assert telemetry.counters(aggregate=True).get(
+        "serve.rejected_total") == 2
+
+
+def test_engine_healthz_tracks_step_loop(metrics):
+    eng = _engine()
+    _, checks = telemetry.health()
+    assert checks["serve"]["state"] == "idle" and checks["serve"]["ok"]
+    eng.submit([1, 2], max_new_tokens=2)
+    prev = mx.config.set("serve.health_window", 0.0)
+    try:
+        ok, checks = telemetry.health()
+        assert ok is False and checks["serve"]["state"] == "serving"
+    finally:
+        mx.config.set("serve.health_window", prev)
+    eng.run()
+    assert telemetry.health()[1]["serve"]["ok"] is True
+    eng.stop()
+    assert "serve" not in telemetry.health()[1]
