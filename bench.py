@@ -107,22 +107,17 @@ def _measure(step, args, n_state: int, target_s: float = 1.2,
 
 
 def _compile(jitted, *abstract_args):
-    """Compile once; return (callable, xla_flops) so the timed path reuses
-    the same executable instead of paying a second trace+compile."""
-    flops = None
+    """Compile once; return (callable, cost) so the timed path reuses
+    the same executable instead of paying a second trace+compile.
+    ``cost`` is mx.insight's normalised cost_analysis capture
+    ({"flops", "bytes_accessed", ...}; {} when the backend reports
+    none) — the same analysis basis as the live /insight plane."""
+    from mxnet_tpu import insight as _insight
     try:
         comp = jitted.lower(*abstract_args).compile()
     except Exception:
-        return jitted, flops
-    try:
-        ca = comp.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        if ca and "flops" in ca:
-            flops = float(ca["flops"])
-    except Exception:
-        pass
-    return comp, flops
+        return jitted, {}
+    return comp, _insight.capture_cost(comp)
 
 
 def _cast_tree(tree, dtype):
@@ -133,12 +128,20 @@ def _cast_tree(tree, dtype):
 
 
 def _row(name, sec_per_step, items_per_step, model_flops_per_step,
-         precision, peak, xla_flops=None):
+         precision, peak, cost=None):
     row = {"name": name, "items_per_s": items_per_step / sec_per_step,
            "ms_per_step": sec_per_step * 1e3, "precision": precision,
            "model_flops_per_step": model_flops_per_step}
+    cost = cost or {}
+    xla_flops = cost.get("flops")
     if xla_flops:
         row["xla_flops_per_step"] = xla_flops
+    xla_bytes = cost.get("bytes_accessed")
+    if xla_bytes:
+        row["xla_bytes_accessed_per_step"] = xla_bytes
+    if xla_flops and xla_bytes:
+        from mxnet_tpu import insight as _insight
+        row["bound"] = _insight.roofline_verdict(xla_flops, xla_bytes)
     if peak:
         eff = model_flops_per_step / sec_per_step
         row["effective_tflops"] = round(eff / 1e12, 2)
@@ -224,7 +227,7 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
     kx, ky = jax.random.split(jax.random.PRNGKey(0))
     xs = jax.random.normal(kx, (k_steps, bs, 3, size, size), jnp.float32)
     ys = jax.random.randint(ky, (k_steps, bs), 0, nclass)
-    step, xla_flops = _compile(
+    step, cost = _compile(
         step, tvec, tbig, aux_pk, mom,
         jax.ShapeDtypeStruct(xs.shape, xs.dtype),
         jax.ShapeDtypeStruct(ys.shape, ys.dtype))
@@ -232,7 +235,7 @@ def _bench_cnn_train(model_ctor, name, macs_per_img, native_size,
     sec /= k_steps
     flops = bs * 3 * 2 * macs_per_img * (size / native_size) ** 2
     row = _row(f"{name}_train_bs{bs}_{precision}", sec, bs, flops,
-               precision, peak, xla_flops=xla_flops)
+               precision, peak, cost=cost)
     row["steps_per_call"] = k_steps
     row["config"] = _config_dict(bs, k_steps)
     from mxnet_tpu import config as _cfg
@@ -308,13 +311,13 @@ def bench_resnet50_infer(precision: str, on_cpu: bool, peak, k_steps=16,
     step = jax.jit(scan_steps(fwd, n_state=1))
     xs = jax.random.normal(jax.random.PRNGKey(0),
                            (k_steps, bs, 3, size, size), cdtype)
-    step, xla_flops = _compile(step, jax.ShapeDtypeStruct((), jnp.float32),
-                       jax.ShapeDtypeStruct(xs.shape, xs.dtype))
+    step, cost = _compile(step, jax.ShapeDtypeStruct((), jnp.float32),
+                          jax.ShapeDtypeStruct(xs.shape, xs.dtype))
     sec, _ = _measure(step, (jnp.zeros(()), xs), n_state=1)
     sec /= k_steps
     flops = bs * RESNET50_INFER_FLOPS_PER_IMG * (size / 224.0) ** 2
     row = _row(f"resnet50_infer_bs{bs}_{precision}", sec, bs, flops,
-               precision, peak, xla_flops=xla_flops)
+               precision, peak, cost=cost)
     row["steps_per_call"] = k_steps
     row["config"] = _config_dict(bs, k_steps)
     # every inference row names its peak basis so cross-precision MFU
@@ -381,16 +384,16 @@ def bench_bert_train(precision: str, on_cpu: bool, peak, bs=32, k_steps=16,
     step = jax.jit(loop, donate_argnums=(0, 1))
     ids = jnp.asarray(onp.random.randint(0, vocab, (k_steps, bs, seq)),
                       jnp.int32)
-    step, xla_flops = _compile(step, trainable, opt_m,
-                       jax.ShapeDtypeStruct(ids.shape, ids.dtype),
-                       jax.ShapeDtypeStruct(ids.shape, ids.dtype))
+    step, cost = _compile(step, trainable, opt_m,
+                          jax.ShapeDtypeStruct(ids.shape, ids.dtype),
+                          jax.ShapeDtypeStruct(ids.shape, ids.dtype))
     sec, _ = _measure(step, (trainable, opt_m, ids, ids), n_state=2)
     sec /= k_steps
     flops = 6.0 * n_params * bs * seq   # 6ND training rule
     drop_tag = f"_drop{dropout}" if dropout else ""
     row = _row(f"bert_base_pretrain_bs{bs}_seq{seq}{drop_tag}_{precision}",
                sec, bs,
-               flops, precision, peak, xla_flops=xla_flops)
+               flops, precision, peak, cost=cost)
     row["steps_per_call"] = k_steps
     row["config"] = _config_dict(bs, k_steps)
     row["params_m"] = round(n_params / 1e6, 1)
@@ -453,13 +456,13 @@ def bench_gpt_train(precision: str, on_cpu: bool, peak, bs=8, seq=1024,
     step = jax.jit(loop, donate_argnums=(0, 1))
     ids = jnp.asarray(onp.random.randint(0, vocab, (k_steps, bs, seq + 1)),
                       jnp.int32)
-    step, xla_flops = _compile(step, trainable, opt_m,
-                               jax.ShapeDtypeStruct(ids.shape, ids.dtype))
+    step, cost = _compile(step, trainable, opt_m,
+                          jax.ShapeDtypeStruct(ids.shape, ids.dtype))
     sec, _ = _measure(step, (trainable, opt_m, ids), n_state=2)
     sec /= k_steps
     flops = 6.0 * n_params * bs * seq  # 6ND training rule
     row = _row(f"gpt2_124m_pretrain_bs{bs}_seq{seq}_{precision}", sec, bs,
-               flops, precision, peak, xla_flops=xla_flops)
+               flops, precision, peak, cost=cost)
     row["steps_per_call"] = k_steps
     row["config"] = _config_dict(bs, k_steps)
     row["params_m"] = round(n_params / 1e6, 1)

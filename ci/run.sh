@@ -57,6 +57,13 @@
 #                the XLA fallback, int4 weight bytes <=0.15x fp32, zero
 #                post-warmup recompiles with quantization enabled
 #                (docs/PERFORMANCE.md "Low-bit inference")
+#   insight    - performance-attribution suite: XLA cost-capture
+#                registry, EWMA+MAD drift-detector oracles, 2-host
+#                fleet-snapshot merge, /insight endpoint + drift
+#                chaos drill; the disabled-fast-path budget (<2%) is
+#                re-enforced with insight compiled in
+#                (docs/OBSERVABILITY.md "Performance attribution,
+#                fleet view & drift")
 #   lint       - framework-aware static analysis (tools/mxlint.py):
 #                trace-safety, donated-buffer, lock-order and registry
 #                drift rules over the whole tree, gated on ZERO new
@@ -70,7 +77,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|lint|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|insight|lint|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -378,6 +385,13 @@ serve() {
     JAX_PLATFORMS=cpu python benchmark/serve_throughput.py --assert
 }
 
+insight() {
+    echo "== insight: performance attribution / fleet merge / drift suite (docs/OBSERVABILITY.md) =="
+    python -m pytest tests/test_insight.py -q
+    echo "== insight: disabled fast-path overhead budget (<2%) with insight compiled in =="
+    JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
+}
+
 lint() {
     echo "== lint: static-analysis suite (docs/STATIC_ANALYSIS.md) =="
     python -m pytest tests/test_analyze.py -q
@@ -422,9 +436,10 @@ case "$stage" in
     autotune) autotune ;;
     quantize) quantize ;;
     trace) trace ;;
+    insight) insight ;;
     lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; lint ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -258,6 +258,11 @@ def _measure_candidate(candidate, block, loss_fn, optimizer, mesh,
     import jax.numpy as jnp
     step.trainable = {n: jnp.copy(v) for n, v in step.trainable.items()}
     step.aux = {n: jnp.copy(v) for n, v in step.aux.items()}
+    # mx.insight attribution label: each measured trial registers its
+    # own cost-analysis entry instead of masquerading as the train step
+    step._insight_label = (f"autotune.trial[bs{c.batch_size}"
+                           f"x{c.steps_per_call},ga{c.grad_accum},"
+                           f"zero{c.zero}]")
     # first call = trace + compile; account it through the detector so
     # the trial-scoped limit governs it like any hybridized compile
     t0 = time.perf_counter()

@@ -336,6 +336,31 @@ declare("fleet.min_dp", int, 1, "MXNET_FLEET_MIN_DP",
         "to after host loss; when no surviving layout reaches it the "
         "supervisor parks (fleet.parked gauge) and waits for capacity "
         "instead of training on a uselessly small mesh.")
+declare("insight.enable", bool, False, "MXNET_INSIGHT",
+        "Master switch for the mx.insight attribution plane (XLA cost "
+        "capture, live MFU/roofline gauges, step-time drift detection, "
+        "fleet snapshots). Disabled, every insight hook costs one "
+        "attribute read.")
+declare("insight.drift_window", int, 32, "MXNET_INSIGHT_DRIFT_WINDOW",
+        "Samples anchoring the drift detector's robust baseline "
+        "(median + MAD) and setting the EWMA half-life over step-time "
+        "sources; an injected slowdown must alarm within this many "
+        "samples.")
+declare("insight.drift_sigma", float, 3.0, "MXNET_INSIGHT_DRIFT_SIGMA",
+        "Robust z-score (MAD-scaled) the step-time EWMA must exceed "
+        "above baseline, two samples running, before insight.drift "
+        "fires — the false-positive vs time-to-detect dial.")
+declare("insight.snapshot_interval", float, 5.0,
+        "MXNET_INSIGHT_SNAPSHOT_INTERVAL",
+        "Seconds between atomic insight-<rank>.json fleet snapshots "
+        "published next to the heartbeat leases (riding the "
+        "HealthPlane.beat cadence, so no extra thread).")
+declare("insight.straggler_ratio", float, 1.5,
+        "MXNET_INSIGHT_STRAGGLER_RATIO",
+        "A host whose step-time EWMA (from its fleet snapshot) exceeds "
+        "this multiple of the fleet median is marked a straggler by "
+        "check_peers, independent of the fixed fleet.slow_fraction "
+        "deadline cutoff.")
 declare("resilience.keep_bundles", int, 3, "MXNET_RESILIENCE_KEEP_BUNDLES",
         "Valid TrainState bundle generations retained by save() as the "
         "degrade path's fallback chain (<path>.gN history hard-links); "

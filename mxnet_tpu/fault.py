@@ -90,6 +90,12 @@ POINTS = {
         "(coordination service or lease dir unreachable): renewals are "
         "counted as failures, /healthz turns red, and the heartbeat "
         "keeps retrying",
+    "insight.drift":
+        "one observed step-time sample is stretched 3x (probed at "
+        "every insight drift-feed sample): the EWMA+MAD detector must "
+        "raise an insight.drift event within insight.drift_window "
+        "samples, count insight.drift_events_total, and flip the "
+        "/healthz insight provider to degraded",
 }
 
 _lock = threading.Lock()

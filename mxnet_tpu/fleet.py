@@ -41,6 +41,7 @@ import time
 
 from . import config as _config
 from . import fault as _fault
+from . import insight as _insight
 from . import resilience as _resilience
 from . import telemetry as _telemetry
 from . import trace as _trace
@@ -218,6 +219,10 @@ class HealthPlane:
             return False
         self._renew_failing = False
         _count("fleet.heartbeats_total")
+        if _insight._active and self.lease_dir:
+            # piggyback the insight fleet snapshot on the heartbeat
+            # cadence (rate-limited by insight.snapshot_interval)
+            _insight.maybe_snapshot(self.lease_dir, self.rank)
         return True
 
     def _publish_coord(self, payload):
@@ -301,6 +306,16 @@ class HealthPlane:
                              f"{stuck:.1f}s > fleet.step_deadline "
                              f"{deadline:.1f}s (wedged)")
                 if stuck > slow_at > 0:
+                    self._stragglers.add(rank)
+        if _insight._active and self.lease_dir:
+            # insight relative-slowness: a host whose step-time EWMA
+            # (published in its fleet snapshot) sits past
+            # insight.straggler_ratio x the fleet median is a straggler
+            # even without a fleet.step_deadline configured
+            ratio = _config.get("insight.straggler_ratio")
+            for rank, rel in _insight.relative_slowness(
+                    self.lease_dir).items():
+                if rank != self.rank and rel > ratio:
                     self._stragglers.add(rank)
         _gauge("fleet.stragglers", len(self._stragglers))
         _gauge("fleet.peers_alive", len(alive) + 1)   # peers + self

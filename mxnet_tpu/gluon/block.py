@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import insight as _insight
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..numpy.multiarray import ndarray, _wrap
@@ -544,6 +545,15 @@ class _CachedGraph:
                             self.block, type(self.block).__name__,
                             time.perf_counter() - _t_trace,
                             signatures=len(self._signatures))
+                    if _insight._active and acquired_write:
+                        # attribution for the fresh signature: trace-only
+                        # re-lower (HLO cost analysis), no second backend
+                        # compile and no note_compile
+                        _insight.capture_jit(
+                            f"cached_graph.{type(self.block).__name__}",
+                            self._jit,
+                            (trainable_raws, aux_raws, input_raws, rng),
+                            kind="cached_graph", sig_key=sig_key)
                     break
                 except _SignatureEvicted:
                     if _attempt:

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import functional
+from .. import insight as _insight
 from .. import pipeline as _pipeline
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -698,6 +699,21 @@ class ShardedTrainStep:
         lr_val = opt.lr_scheduler(base + 1) if opt.lr_scheduler else opt.lr
         lr = jnp.asarray(lr_val, jnp.float32)
         t = jnp.asarray(base + 1, jnp.float32)
+        if _insight._active and not getattr(self, "_insight_done", False):
+            # one-time attribution capture BEFORE dispatch (donation
+            # deletes the input buffers): trace-only .lower(), no
+            # backend compile and no note_compile, so the recompile
+            # detector and compile counters stay untouched
+            self._insight_done = True
+            label = getattr(self, "_insight_label", "parallel.train_step")
+            cap = (self.trainable, self.aux, self.states, rng, lr, t,
+                   *raws)
+            if self._act_rules:
+                with activation_sharding(self.mesh, **self._act_rules):
+                    _insight.capture_jit(label, self._step, cap,
+                                         kind="train")
+            else:
+                _insight.capture_jit(label, self._step, cap, kind="train")
         if self._act_rules:
             # sp: install the activation rules around the call so the
             # layers' constrain() hooks and the ring-attention routing see
@@ -735,6 +751,11 @@ class ShardedTrainStep:
                 _telemetry.inc("mesh.pp_stage_transfer_bytes_total",
                                tokens * self._pp_width * 4
                                * (pp_n - 1) * 2)
+        if _insight._active:
+            # steady-state loop time from call inter-arrival: measured
+            # on wall clocks the caller already pays, no device sync
+            _insight.note_step(
+                getattr(self, "_insight_label", "parallel.train_step"))
         return _wrap(loss)
 
     def prefetch(self, batches, depth=None, stall_timeout=None):

@@ -40,6 +40,7 @@ import numpy as onp
 
 from .. import config as _config
 from .. import functional as _functional
+from .. import insight as _insight
 from .. import pipeline as _pipeline
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
@@ -382,6 +383,11 @@ class ServeEngine:
                 _telemetry.inc("serve.post_warmup_compiles_total")
         _telemetry.note_compile(self, f"serve.{kind}", dt,
                                 signatures=len(self._exe) + 1)
+        if _insight._active:
+            # attribution capture from the AOT executable we already
+            # paid for (args are the abstract ShapeDtypeStructs)
+            _insight.register_executable(f"serve.{kind}", compiled=exe,
+                                         args=args, kind="serve")
         return exe
 
     def _decode_fn(self, params, cache, state):

@@ -281,6 +281,23 @@ class _Hist:
         self.count += 1
 
 
+#: raw-sample listeners (mx.insight's drift feed): histogram name -> one
+#: callable receiving each observed value.  Consulted only while the
+#: registry is enabled, after the bucket update and OUTSIDE _lock, so a
+#: listener may record metrics of its own.
+_sample_listeners: dict[str, object] = {}
+
+
+def add_sample_listener(name, fn):
+    """Register ``fn(value)`` to receive every raw :func:`observe`
+    sample for histogram ``name`` (one listener per name; replaces)."""
+    _sample_listeners[name] = fn
+
+
+def remove_sample_listener(name):
+    _sample_listeners.pop(name, None)
+
+
 def observe(name, value, **labels):
     """Record one sample into a bucketed histogram (no-op while
     disabled).  Buckets come from the catalog declaration; undeclared
@@ -294,6 +311,9 @@ def observe(name, value, **labels):
         if h is None:
             h = _hists[key] = _Hist(spec[2] or TIME_BUCKETS)
         h.observe(value)
+    fn = _sample_listeners.get(name)
+    if fn is not None:
+        fn(value)
 
 
 @contextlib.contextmanager
@@ -618,7 +638,10 @@ def serve_http(port=None):
       ``Content-Type: text/plain; version=0.0.4`` header; each scrape
       sets the ``telemetry.scrape_duration_seconds`` gauge.
     - ``GET /healthz``  — liveness JSON (pid, telemetry/trace state).
-    - ``GET /trace?last=N`` — the newest N ``mx.trace`` spans as JSON.
+    - ``GET /trace?last=N&category=C`` — the newest N ``mx.trace``
+      spans as JSON, optionally filtered to one category.
+    - ``GET /insight``  — the mx.insight attribution report (local +
+      merged fleet view) as JSON.
 
     ``port=None`` reads the ``telemetry.http_port`` knob
     (``MXNET_TELEMETRY_PORT``); 0 binds an ephemeral port — read it back
@@ -650,7 +673,16 @@ def serve_http(port=None):
                 set_gauge("telemetry.scrape_duration_seconds",
                           time.perf_counter() - t0)
                 # render again so the gauge is visible in THIS scrape
-                self._send(200, exposition(), EXPOSITION_CONTENT_TYPE)
+                body = exposition()
+                from . import insight as _insight
+                if _insight._active:
+                    try:
+                        # host-labelled fleet series merged from the
+                        # lease-dir snapshots (mx.insight fleet view)
+                        body += _insight.fleet_exposition()
+                    except Exception:   # noqa: BLE001
+                        pass            # a torn snapshot can't 500 a scrape
+                self._send(200, body, EXPOSITION_CONTENT_TYPE)
             elif url.path == "/healthz":
                 from . import trace as _trace
                 ok, checks = health()
@@ -672,14 +704,21 @@ def serve_http(port=None):
                             {"error": "last must be an integer"}),
                             "application/json")
                         return
+                category = query["category"][0] \
+                    if "category" in query else None
                 self._send(200, json.dumps(
-                    {"spans": _trace.spans(last),
+                    {"spans": _trace.spans(last, category=category),
                      "dropped": _trace.stats()["dropped"]}),
                     "application/json")
+            elif url.path == "/insight":
+                from . import insight as _insight
+                self._send(200, json.dumps(_insight.endpoint_report()),
+                           "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown path {url.path!r}",
-                     "paths": ["/metrics", "/healthz", "/trace?last=N"]}),
+                     "paths": ["/metrics", "/healthz", "/insight",
+                               "/trace?last=N&category=C"]}),
                     "application/json")
 
     if port is None:
@@ -821,6 +860,10 @@ class TrainingTelemetry:
         linted = _analyze_summary()
         if linted is not None:
             out["analyze"] = linted
+        from . import insight as _insight
+        observed = _insight.last_summary()
+        if observed is not None:
+            out["insight"] = observed
         return out
 
     def close(self):

@@ -314,11 +314,14 @@ def ingest(spans_):
     return len(spans_)
 
 
-def spans(last=None):
-    """Snapshot of recorded spans, oldest first (``last=N`` keeps the
-    newest N) — the reader behind ``/trace?last=N``."""
+def spans(last=None, category=None):
+    """Snapshot of recorded spans, oldest first.  ``last=N`` keeps the
+    newest N; ``category=`` filters on the span category first — the
+    reader behind ``/trace?last=N&category=C``."""
     with _lock:
         out = list(_events)
+    if category is not None:
+        out = [ev for ev in out if ev.get("cat") == category]
     if last is not None and last >= 0:
         out = out[len(out) - min(last, len(out)):]
     return out

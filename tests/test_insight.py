@@ -1,0 +1,527 @@
+"""mx.insight — live performance attribution, fleet-wide metric
+aggregation, and step-time drift detection (docs/OBSERVABILITY.md
+"Performance attribution, fleet view & drift").
+
+Oracles: the EWMA+MAD drift detector against synthetic series (a step
+change and a slow ramp must fire, a noisy-but-stable series must not);
+the fleet merge against two hand-written host snapshots (counters
+summed, gauges maxed, host-labelled /metrics lines); XLA cost capture
+against a known matmul; the GPT train loop must land a nonzero MFU and
+a roofline verdict on the live /insight endpoint without adding
+recompiles or host syncs.
+
+Chaos spec literals exercised here: "insight.drift:prob=1".
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import insight, telemetry, trace
+from mxnet_tpu.fleet import HealthPlane
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+from mxnet_tpu.parallel import ShardedTrainStep
+from mxnet_tpu.parallel.mesh import MeshConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_insight_state():
+    insight.disable()
+    insight.reset()
+    telemetry.stop_http()
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    yield
+    insight.disable()
+    insight.reset()
+    telemetry.stop_http()
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    mx.config.reset()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# disabled fast path
+# ---------------------------------------------------------------------------
+
+def test_disabled_hooks_are_noops():
+    assert not insight.active()
+    assert insight.register_executable("x", cost={"flops": 1.0}) is None
+    insight.note_step("x")
+    insight.note_step("x")
+    assert insight.maybe_snapshot() is None
+    assert insight.attribution()["executables"] == {}
+    assert insight.last_summary() is None
+    assert insight.drift_events() == []
+    assert insight.healthz()["ok"] is True
+    # raw samples flow through telemetry without waking a detector
+    telemetry.enable()
+    telemetry.observe("trainer.step_seconds", 0.1)
+    assert insight.attribution()["drift"] == {}
+
+
+# ---------------------------------------------------------------------------
+# cost capture & roofline
+# ---------------------------------------------------------------------------
+
+def _matmul_jit():
+    @jax.jit
+    def f(a):
+        return (a @ a).sum()
+    return f, jnp.ones((64, 64), jnp.float32)
+
+
+def test_capture_cost_from_lowered_matmul():
+    f, x = _matmul_jit()
+    cost = insight.capture_cost(f.lower(x))
+    # 64x64x64 matmul: ~2*64^3 = 524288 flops, plus the reduction
+    assert cost["flops"] >= 2 * 64 ** 3
+    assert cost["bytes_accessed"] >= 64 * 64 * 4
+    assert insight.capture_cost(object()) == {}  # no analysis -> best-effort
+
+
+def test_roofline_verdict_ridge_point():
+    # machine balance 2 flops/byte: intensity 1000 vs 1e-8
+    assert insight.roofline_verdict(
+        1e9, 1e6, peak_flops=1e11, peak_bytes_per_s=5e10) == "compute"
+    assert insight.roofline_verdict(
+        10.0, 1e9, peak_flops=1e11, peak_bytes_per_s=5e10) == "memory"
+    assert insight.roofline_verdict(None, 1e6) is None
+    assert insight.roofline_verdict(1e9, 0) is None
+
+
+def test_capture_jit_registers_signature_and_mfu():
+    insight.enable()
+    f, x = _matmul_jit()
+    entry = insight.capture_jit("demo.matmul", f, (x,))
+    assert entry["flops"] > 0 and entry["args"] == ["float32[64,64]"]
+    assert entry["bound"] in ("compute", "memory")
+    insight.note_step("demo.matmul", seconds=0.001)
+    e = insight.attribution()["executables"]["demo.matmul"]
+    assert e["steps"] == 1 and e["last_seconds"] == pytest.approx(0.001)
+    assert e["achieved_flops_per_s"] == pytest.approx(e["flops"] / 0.001)
+    assert 0 < e["mfu"] < 1
+
+
+def test_note_step_inter_arrival_timing():
+    insight.enable()
+    insight.register_executable("loop", cost={"flops": 1e6})
+    insight.note_step("loop")            # arms the clock, no sample yet
+    e = insight.attribution()["executables"]["loop"]
+    assert e["steps"] == 0
+    time.sleep(0.01)
+    insight.note_step("loop")            # interval since the previous call
+    e = insight.attribution()["executables"]["loop"]
+    assert e["steps"] == 1 and e["last_seconds"] >= 0.005
+
+
+# ---------------------------------------------------------------------------
+# drift detector oracles (synthetic series)
+# ---------------------------------------------------------------------------
+
+def test_drift_fires_on_step_change_within_window():
+    det = insight.DriftDetector("t", window=8, sigma=3.0)
+    for _ in range(20):
+        assert det.update(0.1) is False  # anchor + steady state: quiet
+    fired_at = None
+    for i in range(8):                   # 3x slowdown at "step 20"
+        if det.update(0.3):
+            fired_at = i + 1
+            break
+    assert fired_at is not None and fired_at <= 8
+    assert det.degraded and det.events == 1
+    st = det.state()
+    assert st["baseline"] == pytest.approx(0.1)
+    assert st["ewma"] > st["baseline"]
+
+
+def test_drift_fires_on_slow_ramp():
+    det = insight.DriftDetector("t", window=8, sigma=3.0)
+    fired = False
+    for i in range(60):                  # ~2%/step creep
+        fired = det.update(0.1 * 1.02 ** i) or fired
+    assert fired and det.events >= 1 and det.degraded
+
+
+def test_drift_quiet_on_noisy_stable_series():
+    rs = onp.random.RandomState(7)
+    det = insight.DriftDetector("t", window=32, sigma=3.0)
+    for _ in range(500):                 # 5% noise around a flat mean
+        det.update(0.1 * (1.0 + 0.05 * rs.randn()))
+    assert det.events == 0 and not det.degraded
+
+
+def test_drift_degraded_clears_on_recovery():
+    det = insight.DriftDetector("t", window=8, sigma=3.0)
+    for _ in range(12):
+        det.update(0.1)
+    for _ in range(10):
+        det.update(0.4)
+    assert det.degraded
+    for _ in range(40):                  # the EWMA decays back under
+        det.update(0.1)
+    assert not det.degraded and det.events == 1  # no re-fire on the way down
+
+
+def test_single_spike_is_winsorised_away():
+    det = insight.DriftDetector("t", window=8, sigma=3.0)
+    for _ in range(12):
+        det.update(0.1)
+    assert det.update(5.0) is False      # one outlier cannot drag the EWMA
+    for _ in range(3):
+        assert det.update(0.1) is False
+    assert det.events == 0 and not det.degraded
+
+
+# ---------------------------------------------------------------------------
+# the injected-slowdown drill (chaos point -> events -> /healthz 503)
+# ---------------------------------------------------------------------------
+
+def test_injected_slowdown_raises_drift_and_flips_healthz():
+    mx.config.set("insight.drift_window", 8)
+    telemetry.enable()
+    insight.enable()
+    for _ in range(8):                   # anchor the baseline at 0.1s
+        telemetry.observe("trainer.step_seconds", 0.1)
+    assert insight.healthz()["ok"] is True
+    mx.fault.configure("insight.drift:prob=1")   # stretch every sample 3x
+    fired_after = None
+    for i in range(8):                   # must fire within the window
+        telemetry.observe("trainer.step_seconds", 0.1)
+        if insight.drift_events():
+            fired_after = i + 1
+            break
+    assert fired_after is not None and fired_after <= 8
+    hz = insight.healthz()
+    assert hz["ok"] is False and "trainer.step" in hz["degraded"]
+    ev = insight.drift_events()[0]
+    assert ev["source"] == "trainer.step" and ev["ewma"] > ev["baseline"]
+    flat = telemetry.counters()
+    assert flat['insight.drift_events_total{source="trainer.step"}'] >= 1
+    assert mx.fault.stats().get("insight.drift") >= 1
+    snap = telemetry.snapshot()
+    assert snap["gauges"]["insight.degraded_sources"] >= 1
+    # the ops endpoint reports the degradation as HTTP 503
+    srv = telemetry.serve_http(port=0)
+    port = srv.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(port, "/healthz")
+        assert e.value.code == 503
+        body = json.loads(e.value.read().decode())
+        assert body["checks"]["insight"]["ok"] is False
+    finally:
+        telemetry.stop_http()
+
+
+def test_drift_event_lands_as_insight_trace_span():
+    mx.config.set("insight.drift_window", 8)
+    telemetry.enable()
+    trace.enable()
+    insight.enable()
+    for _ in range(8):
+        telemetry.observe("trainer.step_seconds", 0.1)
+    mx.fault.configure("insight.drift:prob=1")
+    for _ in range(8):
+        telemetry.observe("trainer.step_seconds", 0.1)
+    trace.emit("unrelated", 0, 1, category="app")
+    ins = trace.spans(category="insight")
+    assert ins and all(s["cat"] == "insight" for s in ins)
+    assert any(s["name"] == "insight.drift" for s in ins)
+    # and the endpoint filter mirrors the reader
+    srv = telemetry.serve_http(port=0)
+    port = srv.server_address[1]
+    try:
+        status, ctype, body = _get(port, "/trace?category=insight")
+        assert status == 200 and ctype == "application/json"
+        got = json.loads(body)
+        assert got["spans"] and all(
+            s["cat"] == "insight" for s in got["spans"])
+    finally:
+        telemetry.stop_http()
+
+
+# ---------------------------------------------------------------------------
+# fleet snapshots & merge oracle
+# ---------------------------------------------------------------------------
+
+def _fake_snapshot(lease_dir, rank, ewma, last_seconds, steps, peers,
+                   degraded=False, events=0, drift_events=()):
+    payload = {
+        "rank": rank, "pid": 1000 + rank, "time": time.time(),
+        "counters": {"trainer.steps_total": steps,
+                     'fault.events_total{event="x"}': 1},
+        "gauges": {"fleet.peers_alive": peers},
+        "insight": {
+            "executables": {"parallel.train_step": {
+                "name": "parallel.train_step", "flops": 1e9,
+                "last_seconds": last_seconds, "mfu": 0.1}},
+            "drift": {"trainer.step": {"source": "trainer.step",
+                                       "ewma": ewma, "degraded": degraded,
+                                       "events": events}},
+            "drift_events": list(drift_events)}}
+    path = os.path.join(lease_dir, f"insight-{rank}.json")
+    with open(path, "w") as f:
+        f.write(json.dumps(payload))
+    return path
+
+
+def test_merge_snapshots_two_host_oracle(tmp_path):
+    telemetry.enable()
+    insight.enable()
+    d = str(tmp_path)
+    _fake_snapshot(d, 0, ewma=0.1, last_seconds=0.10, steps=5, peers=2)
+    _fake_snapshot(d, 1, ewma=0.5, last_seconds=0.25, steps=7, peers=3,
+                   degraded=True, events=2,
+                   drift_events=[{"source": "trainer.step", "time": 12.0}])
+    m = insight.merge_snapshots(d)
+    assert m["hosts"] == [0, 1]
+    assert m["counters"]["trainer.steps_total"] == 12          # summed
+    assert m["counters"]['fault.events_total{event="x"}'] == 2
+    assert m["gauges"]["fleet.peers_alive"] == 3               # maxed
+    assert m["per_host"]["0"]["counters"]["trainer.steps_total"] == 5
+    # the slowest host's measurement bounds the fleet's step time
+    e = m["executables"]["parallel.train_step"]
+    assert e["last_seconds"] == 0.25 and sorted(e["hosts"]) == [0, 1]
+    # drift: degraded if ANY host is, events summed, per-host kept
+    dr = m["drift"]["trainer.step"]
+    assert dr["degraded"] is True and dr["events"] == 2
+    assert dr["per_host"]["0"]["ewma"] == 0.1
+    assert m["drift_events"] == [
+        {"source": "trainer.step", "time": 12.0, "host": 1}]
+    # staleness gauge refreshed per host
+    assert set(m["snapshot_age_seconds"]) == {"0", "1"}
+    gauges = telemetry.snapshot()["gauges"]
+    assert 'insight.fleet_snapshot_age_seconds{host="0"}' in gauges
+    assert 'insight.fleet_snapshot_age_seconds{host="1"}' in gauges
+
+
+def test_fleet_exposition_host_labelled_lines(tmp_path):
+    insight.enable()
+    d = str(tmp_path)
+    _fake_snapshot(d, 0, ewma=0.1, last_seconds=0.10, steps=5, peers=2)
+    _fake_snapshot(d, 1, ewma=0.5, last_seconds=0.25, steps=7, peers=3)
+    text = insight.fleet_exposition(d)
+    assert 'mxnet_trainer_steps_total{host="0"} 5' in text
+    assert 'mxnet_trainer_steps_total{host="1"} 7' in text
+    assert 'mxnet_trainer_steps_total{host="fleet"} 12' in text
+    assert 'mxnet_fleet_peers_alive{host="fleet"} 3' in text
+    # existing labels survive next to the spliced host label
+    assert 'mxnet_fault_events_total{host="0",event="x"} 1' in text
+    assert 'mxnet_insight_fleet_snapshot_age_seconds{host="0"}' in text
+    assert insight.fleet_exposition(str(tmp_path / "empty")) == ""
+
+
+def test_torn_snapshot_is_skipped(tmp_path):
+    insight.enable()
+    d = str(tmp_path)
+    _fake_snapshot(d, 0, ewma=0.1, last_seconds=0.10, steps=5, peers=2)
+    with open(os.path.join(d, "insight-1.json"), "w") as f:
+        f.write('{"rank": 1, "cou')     # a mid-write death
+    snaps = insight.read_snapshots(d)
+    assert sorted(snaps) == [0]
+    assert insight.merge_snapshots(d)["hosts"] == [0]
+
+
+def test_relative_slowness_and_straggler_marking(tmp_path):
+    insight.enable()
+    d = str(tmp_path)
+    a = HealthPlane(rank=0, nprocs=2, lease_dir=d)
+    b = HealthPlane(rank=1, nprocs=2, lease_dir=d)
+    a.beat(step=1)
+    b.beat(step=1)
+    # overwrite the beat-published snapshots with a known slow host 1
+    _fake_snapshot(d, 0, ewma=0.1, last_seconds=0.10, steps=5, peers=2)
+    _fake_snapshot(d, 1, ewma=0.5, last_seconds=0.25, steps=5, peers=2)
+    rel = insight.relative_slowness(d)
+    assert rel[0] == pytest.approx(0.1 / 0.3)   # vs the fleet median
+    assert rel[1] == pytest.approx(0.5 / 0.3)
+    assert rel[1] > float(mx.config.get("insight.straggler_ratio"))
+    assert a.check_peers() == [1]
+    assert 1 in a._stragglers           # slow, not dead: marked, kept
+    a.stop()
+    b.stop()
+
+
+def test_relative_slowness_needs_two_reporting_hosts(tmp_path):
+    insight.enable()
+    d = str(tmp_path)
+    _fake_snapshot(d, 0, ewma=0.1, last_seconds=0.10, steps=5, peers=2)
+    assert insight.relative_slowness(d) == {}
+
+
+def test_heartbeat_publishes_rate_limited_snapshot(tmp_path):
+    telemetry.enable()
+    insight.enable()
+    d = str(tmp_path)
+    hp = HealthPlane(rank=0, nprocs=1, lease_dir=d)
+    assert hp.beat(step=1) is True
+    assert os.path.exists(os.path.join(d, "insight-0.json"))
+    assert 0 in insight.read_snapshots(d)
+    agg = telemetry.counters(aggregate=True)
+    assert agg.get("insight.snapshots_written_total", 0) == 1
+    assert hp.beat(step=2) is True       # inside insight.snapshot_interval
+    agg = telemetry.counters(aggregate=True)
+    assert agg.get("insight.snapshots_written_total", 0) == 1  # rate-limited
+    hp.stop()
+
+
+# ---------------------------------------------------------------------------
+# wired surfaces: cached graphs, run reports, /insight endpoint
+# ---------------------------------------------------------------------------
+
+def test_cached_graph_compile_lands_in_registry():
+    insight.enable()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.np.ones((4, 16))
+    net(x)                               # eager deferred-init pass
+    net(x)                               # first compiled call: captured
+    net(x)                               # cache hit: no re-registration
+    exes = insight.attribution()["executables"]
+    e = exes["cached_graph.HybridSequential"]
+    assert e["kind"] == "cached_graph" and e["flops"] > 0
+    assert e["bound"] in ("compute", "memory")
+    assert any("float32[4,16]" in s for s in e["args"])
+
+
+def test_training_telemetry_report_gains_insight_plane(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    insight.enable()
+    with telemetry.TrainingTelemetry(path=path, interval=2,
+                                     run_id="ins") as rep:
+        insight.register_executable(
+            "demo", cost={"flops": 1e9, "bytes_accessed": 1e6})
+        insight.note_step("demo", seconds=0.01)
+        for _ in range(2):
+            rep.step(loss=0.1)
+    report = telemetry.TrainingTelemetry.read(path)[-1]
+    assert report["type"] == "run_report"
+    plane = report["insight"]
+    assert plane["executables"]["demo"]["mfu"] > 0
+    assert plane["executables"]["demo"]["bound"] == "compute"
+    assert plane["machine_balance_flops_per_byte"] > 0
+
+
+def test_insight_endpoint_json_content_type():
+    telemetry.enable()
+    insight.enable()
+    insight.register_executable(
+        "demo", cost={"flops": 1e9, "bytes_accessed": 1e6})
+    srv = telemetry.serve_http(port=0)
+    port = srv.server_address[1]
+    try:
+        status, ctype, body = _get(port, "/insight")
+        assert status == 200 and ctype == "application/json"
+        got = json.loads(body)
+        assert got["enabled"] is True and got["fleet"] is None
+        assert got["local"]["executables"]["demo"]["bound"] == "compute"
+        # /healthz is JSON too
+        status, ctype, _ = _get(port, "/healthz")
+        assert status == 200 and ctype == "application/json"
+    finally:
+        telemetry.stop_http()
+
+
+# ---------------------------------------------------------------------------
+# the e2e GPT train-loop drill (8 virtual devices)
+# ---------------------------------------------------------------------------
+
+VOCAB, UNITS, LAYERS, HEADS, SEQ, BATCH = 64, 16, 2, 2, 8, 8
+
+eight = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs 8 devices (XLA_FLAGS=--xla_force_host_platform_"
+           "device_count=8)")
+
+
+def _batch(seed=0):
+    rs = onp.random.RandomState(seed)
+    x = rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32)
+    y = rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32)
+    return x, y
+
+
+def _loss_fn(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+
+def _gpt_step(cfg, x, lr=0.01):
+    mx.random.seed(0)
+    net = GPTForCausalLM(vocab_size=VOCAB, units=UNITS, num_layers=LAYERS,
+                         num_heads=HEADS, max_length=SEQ, dropout=0.0,
+                         embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.array(x))                  # materialize deferred params
+    opt = mx.optimizer.create("sgd", learning_rate=lr)
+    return ShardedTrainStep(net, _loss_fn, opt, cfg,
+                            cfg.batch_specs(2, 2), n_labels=1)
+
+
+@eight
+def test_gpt_train_loop_attribution_on_insight_endpoint():
+    """The acceptance drill: a live GPT loop lands nonzero MFU and a
+    roofline verdict for the train-step executable on /insight, with
+    zero new recompiles and an unchanged host-sync count."""
+    telemetry.enable()
+    cfg = MeshConfig(dp=2, tp=2, pp=2)
+    x0, _ = _batch(0)
+    step = _gpt_step(cfg, x0)
+    step(*_batch(1))                     # compile, insight still off
+    with mx.pipeline.sync_guard() as g_off:
+        for s in (2, 3):
+            step(*_batch(s))
+    insight.enable()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        step(*_batch(4))                 # registers via .lower(): no compile
+        with mx.pipeline.sync_guard() as g_on:
+            for s in (5, 6):
+                step(*_batch(s))
+    assert not [w for w in caught
+                if issubclass(w.category, telemetry.RecompileWarning)]
+    assert g_on.count == g_off.count     # attribution adds no host syncs
+    e = insight.attribution()["executables"]["parallel.train_step"]
+    assert e["flops"] and e["flops"] > 0
+    assert e["bytes_accessed"] and e["bytes_accessed"] > 0
+    assert e["mfu"] and e["mfu"] > 0
+    assert e["bound"] in ("compute", "memory")
+    assert e["steps"] >= 2 and e["args"]
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges['insight.mfu{executable="parallel.train_step"}'] > 0
+    srv = telemetry.serve_http(port=0)
+    port = srv.server_address[1]
+    try:
+        status, ctype, body = _get(port, "/insight")
+        assert status == 200 and ctype == "application/json"
+        ex = json.loads(body)["local"]["executables"]["parallel.train_step"]
+        assert ex["mfu"] > 0 and ex["bound"] in ("compute", "memory")
+    finally:
+        telemetry.stop_http()
