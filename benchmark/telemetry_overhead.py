@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Disabled-observability fast-path overhead budget (CI stages).
 
-The contract (mxnet_tpu/telemetry.py and mxnet_tpu/trace.py, mirroring
-fault.py): with the registry/recorder off, every instrumentation hook in
-the stack is ONE module attribute read + branch.  This benchmark
+The contract (mxnet_tpu/telemetry.py, mxnet_tpu/trace.py and
+mxnet_tpu/blackbox.py, mirroring fault.py): with the registry/recorder
+off, every instrumentation hook in the stack is ONE module attribute
+read + branch.  This benchmark
 measures that cost against a tight eager-op loop and fails if the probes
 add more than the budget (default 2%) — the guard that keeps future
 instrumentation honest.  The trace-enabled path is also measured and
@@ -64,6 +65,21 @@ def _trace_loop(a, n, probes_per_op, trace):
     return time.perf_counter() - t0
 
 
+def _blackbox_loop(a, n, probes_per_op, blackbox):
+    """Same shape, probing the mx.blackbox disabled gate instead (the
+    pattern every flight-recorder trigger site uses)."""
+    t0 = time.perf_counter()
+    out = a
+    probe = range(probes_per_op)
+    for _ in range(n):
+        out = out + a
+        for _ in probe:
+            if blackbox._active:  # the hook pattern under test
+                blackbox.dump(trigger="manual", reason="bench.never")
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
 def _trace_enabled_loop(a, n, trace):
     """Eager loop with one real recorded span per op (tracing ON)."""
     t0 = time.perf_counter()
@@ -77,18 +93,21 @@ def _trace_enabled_loop(a, n, trace):
 
 def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     import mxnet_tpu as mx
-    from mxnet_tpu import telemetry, trace
+    from mxnet_tpu import blackbox, telemetry, trace
 
     telemetry.disable()
     trace.disable()
-    assert not telemetry.active() and not trace.active()
+    blackbox.disable()
+    assert not telemetry.active() and not trace.active() \
+        and not blackbox.active()
     a = mx.np.ones((8, 8))
     _loop(a, 200, 0, telemetry)          # warmup: compile + caches hot
-    base_s, probed_s, tprobed_s, ton_s = [], [], [], []
+    base_s, probed_s, tprobed_s, bprobed_s, ton_s = [], [], [], [], []
     for _ in range(repeats):
         base_s.append(_loop(a, n, 0, telemetry))
         probed_s.append(_loop(a, n, probes_per_op, telemetry))
         tprobed_s.append(_trace_loop(a, n, probes_per_op, trace))
+        bprobed_s.append(_blackbox_loop(a, n, probes_per_op, blackbox))
         trace.enable(buffer=max(1024, n))
         ton_s.append(_trace_enabled_loop(a, n, trace))
         trace.disable()
@@ -96,24 +115,32 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     base = statistics.median(base_s)
     probed = statistics.median(probed_s)
     tprobed = statistics.median(tprobed_s)
+    bprobed = statistics.median(bprobed_s)
     ton = statistics.median(ton_s)
     # cost of the K probes, scaled to the ~1 probe a real dispatch adds
     per_probe = max(0.0, probed - base) / probes_per_op
     per_trace_probe = max(0.0, tprobed - base) / probes_per_op
+    per_blackbox_probe = max(0.0, bprobed - base) / probes_per_op
     ratio = per_probe / base
     trace_ratio = per_trace_probe / base
+    blackbox_ratio = per_blackbox_probe / base
     return {"ops": n, "probes_per_op": probes_per_op, "repeats": repeats,
             "baseline_s": round(base, 6), "probed_s": round(probed, 6),
             "trace_probed_s": round(tprobed, 6),
+            "blackbox_probed_s": round(bprobed, 6),
             "trace_enabled_s": round(ton, 6),
             "per_op_probe_overhead_ns": round(per_probe / n * 1e9, 2),
             "per_op_trace_probe_overhead_ns":
                 round(per_trace_probe / n * 1e9, 2),
+            "per_op_blackbox_probe_overhead_ns":
+                round(per_blackbox_probe / n * 1e9, 2),
             "overhead_ratio": round(ratio, 6),
             "trace_overhead_ratio": round(trace_ratio, 6),
+            "blackbox_overhead_ratio": round(blackbox_ratio, 6),
             "trace_enabled_ratio": round(max(0.0, ton - base) / base, 6),
             "budget": budget,
-            "ok": ratio < budget and trace_ratio < budget}
+            "ok": ratio < budget and trace_ratio < budget
+                  and blackbox_ratio < budget}
 
 
 def main(argv=None):
@@ -136,6 +163,8 @@ def main(argv=None):
               f"{r['probed_s'] * 1e3:9.2f} ms")
         print(f"with {r['probes_per_op']}x disabled trace probes/op "
               f"{r['trace_probed_s'] * 1e3:9.2f} ms")
+        print(f"with {r['probes_per_op']}x disabled blackbox probes/op "
+              f"{r['blackbox_probed_s'] * 1e3:9.2f} ms")
         print(f"with tracing ENABLED (1 span/op) "
               f"{r['trace_enabled_s'] * 1e3:9.2f} ms "
               f"(+{r['trace_enabled_ratio'] * 100:.2f}%, informational)")
@@ -144,11 +173,15 @@ def main(argv=None):
         print(f"trace overhead ratio     "
               f"{r['trace_overhead_ratio'] * 100:9.4f} % "
               f"(budget {r['budget'] * 100:g}%)")
+        print(f"blackbox overhead ratio  "
+              f"{r['blackbox_overhead_ratio'] * 100:9.4f} % "
+              f"(budget {r['budget'] * 100:g}%)")
     if not r["ok"]:
         print("FAIL: a disabled observability fast path exceeds the "
               "overhead budget", file=sys.stderr)
         return 1
-    print("OK: disabled telemetry + trace fast paths within budget")
+    print("OK: disabled telemetry + trace + blackbox fast paths within "
+          "budget")
     return 0
 
 

@@ -64,6 +64,17 @@
 #                re-enforced with insight compiled in
 #                (docs/OBSERVABILITY.md "Performance attribution,
 #                fleet view & drift")
+#   blackbox   - flight-recorder suite: one drill per trigger class
+#                (fault-injected worker crash, SIGTERM/exit-75 preempt,
+#                loader-thread exception, fleet WorkerLost, torn
+#                bundle) + the e2e fleet crash drill: an injected host
+#                loss on the 8-device mesh leaves a valid checksummed
+#                postmortem bundle for the dead rank, the supervisor
+#                attaches it to the degrade span, and
+#                tools/postmortem.py merge names that rank as the
+#                first-anomaly host; the disabled-fast-path budget
+#                (<2%) is re-enforced with the recorder compiled in
+#                (docs/OBSERVABILITY.md "Postmortem forensics")
 #   lint       - framework-aware static analysis (tools/mxlint.py):
 #                trace-safety, donated-buffer, lock-order and registry
 #                drift rules over the whole tree, gated on ZERO new
@@ -392,6 +403,106 @@ insight() {
     JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
 }
 
+blackbox() {
+    echo "== blackbox: flight-recorder suite (docs/OBSERVABILITY.md \"Postmortem forensics\") =="
+    python -m pytest tests/test_blackbox.py -q
+    echo "== blackbox: fleet crash -> postmortem bundle -> merge drill =="
+    tmp=$(mktemp -d)
+    cat > "$tmp/drill.py" <<'PY'
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import blackbox, trace
+from mxnet_tpu.fleet import FleetSupervisor
+from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+from mxnet_tpu.parallel import MeshConfig, ShardedTrainStep
+
+VOCAB, UNITS, LAYERS, HEADS, SEQ, BATCH = 64, 16, 2, 2, 8, 8
+
+
+def batch(seed):
+    rs = onp.random.RandomState(seed)
+    return (rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32),
+            rs.randint(0, VOCAB, size=(BATCH, SEQ)).astype(onp.int32))
+
+
+def loss_fn(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+
+
+mx.config.set("blackbox.dir", os.environ["DRILL_DIR"])
+blackbox.enable()
+trace.enable(buffer=4096)
+
+mx.random.seed(0)
+cfg = MeshConfig(dp=2, tp=2, pp=2)
+net = GPTForCausalLM(vocab_size=VOCAB, units=UNITS, num_layers=LAYERS,
+                     num_heads=HEADS, max_length=SEQ, dropout=0.0,
+                     embed_dropout=0.0)
+net.initialize()
+net(mx.np.array(batch(0)[0]))
+opt = mx.optimizer.create("sgd", learning_rate=0.01)
+step = ShardedTrainStep(net, loss_fn, opt, cfg, cfg.batch_specs(2, 2),
+                        n_labels=1)
+bundle = os.path.join(os.environ["DRILL_DIR"], "run.bundle")
+state = mx.resilience.TrainState(path=bundle, sharded_step=step)
+sup = FleetSupervisor(step, state, n_hosts=2, host_index=0,
+                      checkpoint_every=1)
+
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")      # the 4-device mesh strands 4 of 8
+    # healthy steps first: both hosts' recorders shadow-checkpoint, so
+    # the soon-to-die host has evidence on shared storage before it dies
+    losses = sup.run(batch, 3)
+    for r in (0, 1):
+        assert blackbox.dump(trigger="shadow", shadow=True, rank=r, step=3)
+    # host 1 crashes: its excepthook leaves a terminal bundle (what the
+    # real process would write on its way down) ...
+    try:
+        raise RuntimeError("XLA device lost (drill)")
+    except RuntimeError as e:
+        assert blackbox.dump(trigger="excepthook",
+                             reason="uncaught RuntimeError (drill)",
+                             exc=e, rank=1, step=4)
+    # ... and the supervisor observes the loss at step 4
+    mx.fault.configure("fleet.host_loss:at=4,times=1")
+    losses.update(sup.run(batch, 6))
+
+assert sup.degrades == 1, sup.degrades
+assert sup.current == MeshConfig(dp=1, tp=2, pp=2), sup.current
+dead = sup.postmortems.get(1)
+assert dead and os.path.basename(dead) == "blackbox-1-00000004.json", dead
+doc = blackbox.read_bundle(dead)         # checksum + schema verified
+assert doc["meta"]["trigger"] == "excepthook", doc["meta"]
+assert doc["exception"]["type"] == "RuntimeError", doc["exception"]
+degrades = [s for s in trace.spans(category="fleet")
+            if s["name"] == "fleet.degrade"]
+assert degrades and degrades[-1]["args"]["postmortem"] == dead
+assert degrades[-1]["args"]["postmortem_host"] == 1
+print("BLACKBOX_DRILL_OK dead_bundle=%s" % os.path.basename(dead))
+PY
+    JAX_PLATFORMS=cpu DRILL_DIR="$tmp" \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python "$tmp/drill.py" | grep "BLACKBOX_DRILL_OK"
+    echo "== blackbox: dead rank's bundle validates + merge names it first-anomaly =="
+    dead=$(ls "$tmp"/blackbox-1-*.json | tail -n 1)
+    JAX_PLATFORMS=cpu python tools/postmortem.py validate "$dead" \
+        --expect excepthook
+    JAX_PLATFORMS=cpu python tools/postmortem.py merge "$tmp" \
+        | grep '"first_anomaly_host": 1'
+    rm -rf "$tmp"
+    echo "== blackbox: disabled fast-path overhead budget (<2%) with the recorder compiled in =="
+    JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
+}
+
 lint() {
     echo "== lint: static-analysis suite (docs/STATIC_ANALYSIS.md) =="
     python -m pytest tests/test_analyze.py -q
@@ -437,9 +548,10 @@ case "$stage" in
     quantize) quantize ;;
     trace) trace ;;
     insight) insight ;;
+    blackbox) blackbox ;;
     lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; lint ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; blackbox; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

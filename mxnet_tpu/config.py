@@ -372,6 +372,46 @@ declare("resilience.restart_window_steps", int, 1000,
         "events) after which mx.resilience.run's restart budget resets, "
         "so N transient faults spread over a long run don't exhaust "
         "resilience.max_restarts; 0 keeps the budget monotonic.")
+declare("telemetry.report_max_bytes", int, 0,
+        "MXNET_TELEMETRY_REPORT_MAX_BYTES",
+        "Size cap (bytes) for a TrainingTelemetry JSONL report file; when "
+        "the next record would cross it the file rotates to the next free "
+        "<path>.gNNNN generation (whole records only, never truncated "
+        "mid-line) so ROADMAP item 5 keeps every generation discoverable "
+        "via TrainingTelemetry.generations(). 0 = unbounded.")
+declare("telemetry.event_ring", int, 256, "MXNET_TELEMETRY_EVENT_RING",
+        "Capacity of the bounded telemetry event ring that captures "
+        "python warnings (RecompileWarning et al.) and framework log "
+        "records >= WARNING once mx.blackbox arms its capture hooks; "
+        "postmortem bundles embed this ring so crashes carry the "
+        "warnings that preceded them.")
+declare("blackbox.enable", bool, False, "MXNET_BLACKBOX",
+        "Arm the mx.blackbox flight recorder: sys/threading excepthooks, "
+        "warning/log capture into the telemetry event ring, and shadow "
+        "snapshots riding HealthPlane.beat; terminal triggers (uncaught "
+        "exception, preemption, WorkerLost, non-finite escalation, "
+        "insight drift) then write one crash-atomic checksummed "
+        "postmortem bundle. Disabled, every hook costs one module-"
+        "attribute read.")
+declare("blackbox.dir", str, "", "MXNET_BLACKBOX_DIR",
+        "Directory for blackbox-<rank>-<step>.json postmortem bundles "
+        "('' = fall back to fleet.lease_dir at dump time so surviving "
+        "hosts can read a dead peer's bundle; if that is also unset, "
+        "dumps are skipped).")
+declare("blackbox.window", int, 256, "MXNET_BLACKBOX_WINDOW",
+        "Last-N evidence window a postmortem bundle embeds: newest N "
+        "trace spans and newest N telemetry events (the metric snapshot "
+        "and knob dump are always whole).")
+declare("blackbox.checkpoint_interval", float, 10.0,
+        "MXNET_BLACKBOX_CHECKPOINT_INTERVAL",
+        "Seconds between shadow bundle snapshots riding HealthPlane.beat "
+        "(no extra thread) so SIGKILL/OOM — where no excepthook runs — "
+        "still leaves a <=interval-stale bundle per host; 0 disables "
+        "shadow snapshots.")
+declare("blackbox.keep", int, 3, "MXNET_BLACKBOX_KEEP",
+        "Newest postmortem bundles retained per rank by dump()'s "
+        "retention sweep (older bundle + .sha256 sidecar pairs are "
+        "deleted); 0 keeps every bundle.")
 declare("serve.max_queue", int, 0, "MXNET_SERVE_MAX_QUEUE",
         "Bound on requests waiting for a decode slot; submit() past it "
         "raises a structured EngineBusy (counted as "

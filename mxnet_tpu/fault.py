@@ -90,6 +90,12 @@ POINTS = {
         "(coordination service or lease dir unreachable): renewals are "
         "counted as failures, /healthz turns red, and the heartbeat "
         "keeps retrying",
+    "blackbox.torn_bundle":
+        "the host dies mid-write of a postmortem bundle (the just-"
+        "written blackbox-<rank>-<step>.json is truncated after its "
+        "checksum landed): verify_checksum rejects it, latest_bundle "
+        "and tools/postmortem.py skip it, and the fleet merge proceeds "
+        "on the surviving bundles",
     "insight.drift":
         "one observed step-time sample is stretched 3x (probed at "
         "every insight drift-feed sample): the EWMA+MAD detector must "

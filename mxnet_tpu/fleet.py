@@ -39,6 +39,7 @@ import os
 import threading
 import time
 
+from . import blackbox as _blackbox
 from . import config as _config
 from . import fault as _fault
 from . import insight as _insight
@@ -223,6 +224,12 @@ class HealthPlane:
             # piggyback the insight fleet snapshot on the heartbeat
             # cadence (rate-limited by insight.snapshot_interval)
             _insight.maybe_snapshot(self.lease_dir, self.rank)
+        if _blackbox._active and self.lease_dir:
+            # shadow postmortem on the same cadence (rate-limited by
+            # blackbox.checkpoint_interval): SIGKILL/OOM run no hook,
+            # so the fleet always holds a recent bundle for this host
+            _blackbox.maybe_checkpoint(self.lease_dir, self.rank,
+                                       step=self._step)
         return True
 
     def _publish_coord(self, payload):
@@ -426,6 +433,10 @@ class FleetSupervisor:
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.health = health
         self._lost: set[int] = set()
+        #: host -> path of the dead host's latest valid postmortem
+        #: bundle (attached to the fleet.degrade decision)
+        self.postmortems: dict[int, str] = {}
+        self._last_lost: int | None = None
         self.parked = False
         self.degrades = 0
         self.reexpands = 0
@@ -445,8 +456,19 @@ class FleetSupervisor:
         if host in self._lost or host == self.host_index:
             return
         self._lost.add(host)
+        self._last_lost = int(host)
         _fault.record("fleet.host_lost")
         _gauge("fleet.peers_alive", self.n_hosts - len(self._lost))
+        # the dead host can't speak for itself: pick up its latest valid
+        # postmortem bundle (terminal or <=interval-stale shadow) from
+        # the shared bundle dir and carry it into the degrade decision
+        bdir = _config.get("blackbox.dir") \
+            or (self.health.lease_dir if self.health is not None else "") \
+            or _config.get("fleet.lease_dir")
+        if bdir:
+            bundle = _blackbox.latest_bundle(bdir, rank=host)
+            if bundle:
+                self.postmortems[int(host)] = bundle
         self._replan()
 
     def restore_hosts(self, *hosts):
@@ -485,7 +507,11 @@ class FleetSupervisor:
         bundle bitwise into it (step counter, RNG, optimizer state ride
         along — the run resumes exactly at the last checkpoint)."""
         with _trace.span(f"fleet.{kind}", category="fleet", dp=cfg.dp,
-                         tp=cfg.tp, pp=cfg.pp, devices=cfg.size()):
+                         tp=cfg.tp, pp=cfg.pp, devices=cfg.size()) as sp:
+            if kind == "degrade" and self._last_lost is not None:
+                pm = self.postmortems.get(self._last_lost)
+                if pm:
+                    sp.set(postmortem=pm, postmortem_host=self._last_lost)
             with _trace.span("fleet.rebuild", category="fleet"):
                 # sync=False: the dying layout's buffers may be gone;
                 # all state transfers through the canonical bundle

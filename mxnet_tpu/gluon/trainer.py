@@ -317,6 +317,13 @@ class Trainer:
         fault.record("trainer.nonfinite_skip")
         if _telemetry._active:
             _telemetry.inc("trainer.nonfinite_total")
+        from .. import blackbox as _blackbox
+        if _blackbox._active:
+            # non-finite escalation is a terminal-class anomaly: freeze
+            # the evidence window while the poisoned state is still live
+            _blackbox.dump(trigger="nonfinite",
+                           reason=f"non-finite gradients skipped "
+                                  f"(count={self.nonfinite_steps})")
         scaler = getattr(self, "_amp_loss_scaler", None)
         if scaler is not None:
             scaler.update_scale(True)

@@ -462,6 +462,13 @@ def _record_drift(source, event):
                     seconds=round(event["seconds"], 6),
                     baseline=round(event["baseline"], 6),
                     ewma=round(event["ewma"], 6))
+    from . import blackbox as _blackbox
+    if _blackbox._active:
+        # a sustained slowdown is a terminal-class anomaly: freeze the
+        # evidence window now, while the degraded state is still live
+        _blackbox.dump(trigger="drift",
+                       reason=f"insight.drift: {source}",
+                       step=event.get("step"))
 
 
 def drift_events():

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import blackbox as _blackbox
 from .. import functional
 from .. import insight as _insight
 from .. import pipeline as _pipeline
@@ -248,6 +249,9 @@ class ShardedTrainStep:
         # the ring-attention routing see them at trace time
         self._act_rules = (self.mesh_config.activation_rules()
                            if self.mesh_config is not None else {})
+        if _blackbox._active and self.mesh_config is not None:
+            # postmortems answer "what mesh was this host running?"
+            _blackbox.note_mesh(self.mesh_config)
         self.n_labels = n_labels
         self.dp_axis = dp_axis
         # per-update specs as given (before the grad_accum/steps_per_call
@@ -696,6 +700,10 @@ class ShardedTrainStep:
         # jitted step as traced scalars, so no retrace
         base = opt.num_update
         opt.num_update = base + self.steps_per_call
+        if _blackbox._active:
+            # keep the flight recorder's step current so a crash bundle
+            # is named for (and attributes evidence to) the right step
+            _blackbox.set_context(step=int(base) + self.steps_per_call)
         lr_val = opt.lr_scheduler(base + 1) if opt.lr_scheduler else opt.lr
         lr = jnp.asarray(lr_val, jnp.float32)
         t = jnp.asarray(base + 1, jnp.float32)

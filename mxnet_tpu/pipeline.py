@@ -60,7 +60,8 @@ from . import trace as _trace
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device", "DeferredWindow",
            "maybe_device_put", "ensure_sharded", "sync_guard",
-           "note_host_sync", "SyncGuard", "take"]
+           "note_host_sync", "SyncGuard", "take", "arm_site_counts",
+           "sync_site_counts"]
 
 
 def take(source, n):
@@ -105,6 +106,10 @@ _telemetry.declare_metric(
 _telemetry.declare_metric(
     "pipeline.deferred_evictions_total", "counter",
     "DeferredWindow overflows forced to fetch on the hot path")
+_telemetry.declare_metric(
+    "pipeline.host_syncs_total", "counter",
+    "host syncs observed by the instrumented sync sites, by site "
+    "(recorded once mx.telemetry or mx.blackbox arms the site counter)")
 
 
 # ---------------------------------------------------------------------------
@@ -163,14 +168,57 @@ class sync_guard:
         return False
 
 
+#: process-lifetime host syncs by call site, fed by note_host_sync; read
+#: via sync_site_counts() (telemetry.snapshot()["sync_sites"] and
+#: blackbox bundles).  Only populated while some owner holds the arm
+#: sentinel below or a guard keeps _guard_depth nonzero.
+_site_totals: dict[str, int] = {}
+#: owners (mx.telemetry, mx.blackbox) currently biasing _guard_depth so
+#: sync sites report with no user guard on the thread
+_armed_owners: set = set()
+
+
+def arm_site_counts(owner, on=True):
+    """Idempotently bias ``_guard_depth`` by one while any ``owner``
+    (telemetry / blackbox) wants process-lifetime per-site sync counts,
+    so the instrumented call sites report without a :func:`sync_guard`
+    active on the thread.  :class:`SyncGuard` semantics are untouched —
+    only guards on the calling thread's stack accumulate into guard
+    objects.  Returns True while armed."""
+    global _guard_depth
+    with _guard_lock:
+        had = bool(_armed_owners)
+        if on:
+            _armed_owners.add(owner)
+        else:
+            _armed_owners.discard(owner)
+        have = bool(_armed_owners)
+        if have and not had:
+            _guard_depth += 1
+        elif had and not have:
+            _guard_depth -= 1
+    return bool(_armed_owners)
+
+
+def sync_site_counts():
+    """Process-lifetime host-sync counts by call site (sorted copy)."""
+    with _guard_lock:
+        return dict(sorted(_site_totals.items()))
+
+
 def note_host_sync(site):
-    """Report one host sync into every guard active on this thread.
-    Call sites gate on ``pipeline._guard_depth`` first so the disabled
-    cost is one attribute read."""
+    """Report one host sync into every guard active on this thread and
+    into the process-lifetime per-site totals.  Call sites gate on
+    ``pipeline._guard_depth`` first so the disabled cost is one
+    attribute read."""
     stack = getattr(_tls, "stack", None)
     if stack:
         for g in stack:
             g._note(site)
+    with _guard_lock:
+        _site_totals[site] = _site_totals.get(site, 0) + 1
+    if _telemetry._active:
+        _telemetry.inc("pipeline.host_syncs_total", site=site)
 
 
 # ---------------------------------------------------------------------------

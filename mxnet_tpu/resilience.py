@@ -228,6 +228,13 @@ class TrainState:
         _serialization.write_checksum(path)
         _event("bundle_save")
         self._gc(path)
+        from . import blackbox as _blackbox
+        if _blackbox._active:
+            # the postmortem names the exact checkpoint generation a
+            # replacement host will restore
+            _blackbox.note_checkpoint(
+                path, self.step,
+                generation=f"{path}.g{int(self.step):08d}")
         return path
 
     # -- retention ---------------------------------------------------------
@@ -394,12 +401,24 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
     while True:
         try:
             return train_fn()
-        except Preempted:
+        except Preempted as e:
+            # SystemExit never reaches sys.excepthook, so the exit-75
+            # path must freeze its evidence here, before the bundle of
+            # record is the only artifact the host leaves behind
+            from . import blackbox as _blackbox
+            if _blackbox._active:
+                _blackbox.dump(trigger="preempt",
+                               reason=f"preempted ({e.origin}) at step "
+                                      f"{e.step}", step=e.step)
             if exit_on_preempt:
                 _event("preempt_exit")
                 raise SystemExit(RESUME_EXIT_CODE)
             raise
         except WorkerLost as e:
+            from . import blackbox as _blackbox
+            if _blackbox._active:
+                _blackbox.dump(trigger="worker_lost",
+                               reason=f"WorkerLost({e.op}): {e}", exc=e)
             # a healthy-progress window between faults forgives the budget:
             # N transient faults spread over days should not add up to the
             # same death sentence as N faults in a tight crash loop

@@ -669,12 +669,30 @@ class ServeEngine:
         batching main loop for offline/batch use; online callers own the
         loop and call ``step()`` themselves."""
         steps = 0
-        while self.pending:
-            self.step()
-            steps += 1
-            if max_steps is not None and steps >= max_steps:
-                break
-        self.drain()
+        try:
+            while self.pending:
+                self.step()
+                steps += 1
+                if max_steps is not None and steps >= max_steps:
+                    break
+            self.drain()
+        except Exception as e:
+            # the serving loop is the long-running production surface:
+            # freeze the evidence window with engine state attached
+            # before the exception unwinds (the excepthook dedupes on
+            # the same exception object, so this is the one bundle)
+            from .. import blackbox as _blackbox
+            if _blackbox._active:
+                _blackbox.set_context(serve={
+                    "decode_steps": steps,
+                    "queued": len(self._queue),
+                    "live_slots": sum(1 for s in self._slots
+                                      if s is not None),
+                    "completed": len(self._completed)})
+                _blackbox.dump(trigger="manual",
+                               reason=f"serve.run fatal: "
+                                      f"{type(e).__name__}: {e}", exc=e)
+            raise
         return self
 
     # -- shutdown / liveness ---------------------------------------------

@@ -57,7 +57,7 @@ __all__ = ["enable", "disable", "configure", "active", "inc", "set_gauge",
            "summary_line", "snapshot", "exposition", "serve_http",
            "stop_http", "reset", "RecompileWarning", "TrainingTelemetry",
            "CATALOG", "EXPOSITION_CONTENT_TYPE", "register_health",
-           "unregister_health", "health"]
+           "unregister_health", "health", "note_event", "events"]
 
 _lock = threading.Lock()
 #: hot-path gate — instrumentation sites read this one attribute; False
@@ -175,6 +175,12 @@ declare_metric("train.iter_seconds", "histogram",
                buckets=TIME_BUCKETS)
 declare_metric("telemetry.records_total", "counter",
                "JSONL records emitted by TrainingTelemetry")
+declare_metric("telemetry.events_total", "counter",
+               "python warnings and framework log records captured into "
+               "the bounded telemetry event ring, by kind")
+declare_metric("telemetry.report_rotations_total", "counter",
+               "TrainingTelemetry JSONL files rolled to a .gNNNN "
+               "generation by the telemetry.report_max_bytes cap")
 declare_metric("memory.bytes_in_use", "gauge",
                "per-device live HBM bytes (PJRT memory_stats), by device")
 declare_metric("memory.peak_bytes_in_use", "gauge",
@@ -210,9 +216,13 @@ declare_metric("autotune.cache_hits_total", "counter",
 
 def enable(on=True):
     """Turn the registry on/off.  Off (the default) every instrumentation
-    hook in the stack is one module-attribute read."""
+    hook in the stack is one module-attribute read.  Enabling also arms
+    the pipeline sync-site counter so ``snapshot()["sync_sites"]`` and
+    ``pipeline.host_syncs_total`` report where host syncs happen."""
     global _active
     _active = bool(on)
+    from . import pipeline as _pipeline   # lazy: pipeline imports us
+    _pipeline.arm_site_counts("telemetry", _active)
     return _active
 
 
@@ -375,10 +385,51 @@ def record_memory(devices=None):
 
 def reset():
     """Drop every recorded value (the catalog and enabled state stay)."""
+    global _events
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
+    with _events_lock:
+        _events = None
+
+
+# -- bounded event ring -----------------------------------------------------
+
+#: bounded ring of structured events — python warnings (RecompileWarning
+#: et al.) and framework log records >= WARNING — fed by the capture
+#: hooks mx.blackbox installs; postmortem bundles embed it so a crash
+#: carries the warnings that preceded it, not just metric totals.
+_events = None
+_events_lock = threading.Lock()
+
+
+def note_event(kind, message, **fields):
+    """Append one structured event to the bounded ring (capacity from
+    the ``telemetry.event_ring`` knob; oldest dropped first).  Unlike the
+    metric recorders this does not gate on ``_active`` — the installers
+    (mx.blackbox's warning/log capture hooks) are the gate, so an armed
+    recorder never loses the event that explains a crash."""
+    global _events
+    import collections
+    entry = {"kind": kind, "message": str(message)[:2048],
+             "time": time.time(), **fields}
+    with _events_lock:
+        if _events is None:
+            _events = collections.deque(
+                maxlen=max(1, int(_config.get("telemetry.event_ring"))))
+        _events.append(entry)
+    inc("telemetry.events_total", kind=kind)
+    return entry
+
+
+def events(last=None):
+    """Captured ring events, oldest first (``last`` = newest N only)."""
+    with _events_lock:
+        out = list(_events) if _events is not None else []
+    if last is not None:
+        out = out[-int(last):]
+    return out
 
 
 # -- recompilation detector -------------------------------------------------
@@ -536,9 +587,11 @@ def snapshot():
                 "buckets": cum, "sum": h.sum, "count": h.count,
                 "quantiles": {("%g" % (100 * q)): v for q, v in
                               _hist_quantiles(h).items()}}
+    from . import pipeline as _pipeline   # lazy: pipeline imports us
     return {"counters": dict(sorted(counter_snap.items())),
             "gauges": dict(sorted(gauge_snap.items())),
-            "histograms": dict(sorted(hist_snap.items()))}
+            "histograms": dict(sorted(hist_snap.items())),
+            "sync_sites": _pipeline.sync_site_counts()}
 
 
 def _sanitize(name):
@@ -642,6 +695,8 @@ def serve_http(port=None):
       spans as JSON, optionally filtered to one category.
     - ``GET /insight``  — the mx.insight attribution report (local +
       merged fleet view) as JSON.
+    - ``GET /postmortem?last=N`` — metadata of the newest N mx.blackbox
+      postmortem bundles in the resolved bundle directory.
 
     ``port=None`` reads the ``telemetry.http_port`` knob
     (``MXNET_TELEMETRY_PORT``); 0 binds an ephemeral port — read it back
@@ -714,11 +769,26 @@ def serve_http(port=None):
                 from . import insight as _insight
                 self._send(200, json.dumps(_insight.endpoint_report()),
                            "application/json")
+            elif url.path == "/postmortem":
+                from . import blackbox as _blackbox
+                query = urllib.parse.parse_qs(url.query)
+                last = None
+                if "last" in query:
+                    try:
+                        last = int(query["last"][0])
+                    except ValueError:
+                        self._send(400, json.dumps(
+                            {"error": "last must be an integer"}),
+                            "application/json")
+                        return
+                self._send(200, json.dumps(
+                    _blackbox.endpoint_report(last)), "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown path {url.path!r}",
                      "paths": ["/metrics", "/healthz", "/insight",
-                               "/trace?last=N&category=C"]}),
+                               "/trace?last=N&category=C",
+                               "/postmortem?last=N"]}),
                     "application/json")
 
     if port is None:
@@ -814,9 +884,14 @@ class TrainingTelemetry:
         inc("telemetry.records_total")
         self.records.append(record)
         if self._path:
+            line = json.dumps(record) + "\n"
             if self._file is None:
                 self._file = open(self._path, "a")
-            self._file.write(json.dumps(record) + "\n")
+            limit = int(_config.get("telemetry.report_max_bytes") or 0)
+            if limit > 0 and self._file.tell() \
+                    and self._file.tell() + len(line) > limit:
+                self._rotate()
+            self._file.write(line)
             self._file.flush()
         from . import profiler as _profiler
         if _profiler.is_running():
@@ -825,6 +900,21 @@ class TrainingTelemetry:
                 time.perf_counter_ns() // 1000, 0,
                 {k: v for k, v in record.items()
                  if isinstance(v, (int, float, str))})
+
+    def _rotate(self):
+        """Roll the JSONL file to the next free ``<path>.gNNNN``
+        generation and reopen fresh.  The size cap is checked before a
+        record is written, so rotation never truncates mid-record, and
+        rotated generations stay on disk — :meth:`generations` finds
+        them (ROADMAP item 5 trains on these files)."""
+        self._file.close()
+        self._file = None
+        n = 0
+        while os.path.exists(f"{self._path}.g{n:04d}"):
+            n += 1
+        os.replace(self._path, f"{self._path}.g{n:04d}")
+        inc("telemetry.report_rotations_total")
+        self._file = open(self._path, "a")
 
     def step(self, step=None, **fields):
         """Record one training iteration; emit a JSONL step record every
@@ -886,11 +976,23 @@ class TrainingTelemetry:
         with open(path) as f:
             return [json.loads(line) for line in f if line.strip()]
 
+    @staticmethod
+    def generations(path):
+        """Every surviving generation of a rotated report, oldest first
+        (``<path>.g0000``, ``<path>.g0001``, ..., then the live file).
+        Rotation renames, never deletes — this is the discovery surface
+        for consumers of the full run history."""
+        import glob
+        gens = sorted(glob.glob(glob.escape(path) + ".g[0-9]*"))
+        if os.path.exists(path):
+            gens.append(path)
+        return gens
+
 
 # arm from the environment at import (MXNET_TELEMETRY=1), mirroring
 # fault.py, so spawned workers and plain scripts inherit the switch
 if _config.get("telemetry.enable"):
-    _active = True
+    enable()
 
 # MXNET_TELEMETRY_PORT=N arms the ops endpoint at import (best-effort:
 # a taken port must not kill the training job it observes)

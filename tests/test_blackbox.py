@@ -1,0 +1,437 @@
+"""mx.blackbox — flight recorder, postmortem bundles, fleet merge.
+
+One drill per trigger class (docs/OBSERVABILITY.md "Postmortem
+forensics"): an injected mx.fault worker crash escalating WorkerLost, a
+SIGTERM preemption through the exit-75 path, an uncaught exception in a
+loader thread, a fleet host loss where the supervisor attaches the dead
+host's bundle to the degrade event, and a torn bundle (the
+"blackbox.torn_bundle" injection point) skipped by validate/merge.
+
+Satellites covered here too: the warnings/log event ring, size-capped
+JSONL report rotation (telemetry.report_max_bytes), and sync_guard
+per-site counts in telemetry.snapshot().
+
+Chaos spec literals exercised here: "blackbox.torn_bundle:at=1",
+"resilience.preempt:at=3".
+"""
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import warnings
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import blackbox, config, telemetry, trace
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet import FleetSupervisor
+from mxnet_tpu.parallel.mesh import MeshConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_blackbox_state(tmp_path):
+    """Every test gets an armed recorder pointed at its own bundle dir
+    and leaves no hooks, flags or overrides behind."""
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    prev_dir = config.set("blackbox.dir", str(tmp_path / "bundles"))
+    blackbox._snap_last = 0.0
+    blackbox._last_exc_id = None
+    blackbox.set_context(rank=None, step=None, mesh=None, checkpoint=None,
+                         serve=None)
+    yield
+    blackbox.disable()
+    blackbox.set_context(rank=None, step=None, mesh=None, checkpoint=None,
+                         serve=None)
+    config.set("blackbox.dir", prev_dir)
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    mx.resilience.uninstall_signal_handlers()
+    mx.resilience.clear_preempt()
+
+
+@pytest.fixture
+def bundles(tmp_path):
+    d = tmp_path / "bundles"
+    d.mkdir(exist_ok=True)
+    return str(d)
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _cli(*args):
+    """Run tools/postmortem.py; -> (returncode, stdout-json-or-None,
+    stderr)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "postmortem.py"),
+         *args], capture_output=True, text=True, env=env, timeout=120)
+    doc = json.loads(p.stdout) if p.returncode == 0 and p.stdout else None
+    return p.returncode, doc, p.stderr
+
+
+# -- bundle mechanics --------------------------------------------------------
+
+def test_manual_dump_roundtrips_with_checksum(bundles):
+    blackbox.enable()
+    blackbox.set_context(run="unit")
+    path = blackbox.dump(trigger="manual", reason="operator dump",
+                         step=7, rank=3)
+    assert os.path.basename(path) == "blackbox-3-00000007.json"
+    assert os.path.exists(path + ".sha256")
+    doc = blackbox.read_bundle(path)
+    assert doc["schema"] == blackbox.BUNDLE_SCHEMA
+    meta = doc["meta"]
+    assert meta["trigger"] == "manual" and meta["reason"] == "operator dump"
+    assert meta["rank"] == 3 and meta["step"] == 7 and not meta["shadow"]
+    assert doc["context"]["run"] == "unit"
+    # every evidence plane is present even when empty
+    for key in ("spans", "telemetry", "counters_delta", "events", "fault",
+                "insight", "sync_sites", "config"):
+        assert key in doc, key
+    assert doc["config"]["blackbox.window"] == config.get("blackbox.window")
+    assert blackbox.latest_bundle(rank=3) == path
+
+
+def test_dump_without_directory_is_a_safe_noop():
+    config.set("blackbox.dir", "")
+    prev = config.set("fleet.lease_dir", "")
+    try:
+        blackbox.enable()
+        assert blackbox.dump(trigger="manual", reason="nowhere") is None
+    finally:
+        config.set("fleet.lease_dir", prev)
+
+
+def test_retention_keeps_last_k_per_rank(bundles):
+    prev = config.set("blackbox.keep", 2)
+    try:
+        blackbox.enable()
+        for s in range(5):
+            blackbox.dump(trigger="manual", step=s, rank=0)
+        blackbox.dump(trigger="manual", step=9, rank=1)
+        mine = blackbox.list_bundles(rank=0)
+        assert [os.path.basename(p) for p in mine] == \
+            ["blackbox-0-00000003.json", "blackbox-0-00000004.json"]
+        # other ranks' evidence is never collected away
+        assert len(blackbox.list_bundles(rank=1)) == 1
+        leftovers = [f for f in os.listdir(bundles)
+                     if f.endswith(".sha256")]
+        assert len(leftovers) == 3       # sidecars follow their bundles
+    finally:
+        config.set("blackbox.keep", prev)
+
+
+def test_disabled_gate_never_writes(bundles):
+    assert not blackbox.active()
+    if blackbox._active:                 # the one-attr-read hook pattern
+        blackbox.dump(trigger="manual")
+    assert blackbox.list_bundles() == []
+
+
+# -- trigger drills ----------------------------------------------------------
+
+def test_uncaught_exception_hits_excepthook(bundles, capfd):
+    blackbox.enable()
+    try:
+        raise RuntimeError("host stepped on a rake")
+    except RuntimeError:
+        sys.excepthook(*sys.exc_info())  # what the interpreter does
+    capfd.readouterr()                   # chained default hook's traceback
+    path = blackbox.latest_bundle()
+    doc = blackbox.read_bundle(path)
+    assert doc["meta"]["trigger"] == "excepthook"
+    assert doc["exception"]["type"] == "RuntimeError"
+    assert "rake" in doc["exception"]["message"]
+    assert any("RuntimeError" in ln
+               for ln in doc["exception"]["traceback"])
+
+
+def test_uncaught_exception_in_loader_thread(bundles, capfd):
+    """Drill: a loader/prefetch thread dies uncaught; threading.excepthook
+    must leave a bundle even though the main thread never sees the
+    exception."""
+    blackbox.enable()
+
+    def loader():
+        raise ValueError("batch 12 decode failed")
+
+    t = threading.Thread(target=loader, name="loader-0")
+    t.start()
+    t.join()
+    capfd.readouterr()
+    doc = blackbox.read_bundle(blackbox.latest_bundle())
+    assert doc["meta"]["trigger"] == "thread_excepthook"
+    assert doc["exception"]["type"] == "ValueError"
+    assert "thread=loader-0" in doc["meta"]["reason"]
+
+
+def test_sigterm_preemption_exit75_leaves_bundle(bundles):
+    """Drill: SIGTERM -> cooperative Preempted -> resilience.run exits
+    with the resume sentinel (75) AND the recorder captured the preempt
+    (SystemExit never reaches sys.excepthook, so the run() path must
+    dump explicitly)."""
+    blackbox.enable()
+    mx.resilience.install_signal_handlers()
+
+    def train_fn():
+        signal.raise_signal(signal.SIGTERM)
+        assert mx.resilience.preempt_requested()
+        raise mx.resilience.Preempted(path="ckpt.bundle", step=12)
+
+    with pytest.raises(SystemExit) as ei:
+        mx.resilience.run(train_fn, exit_on_preempt=True)
+    assert ei.value.code == mx.resilience.RESUME_EXIT_CODE == 75
+    doc = blackbox.read_bundle(blackbox.latest_bundle())
+    assert doc["meta"]["trigger"] == "preempt"
+    assert doc["meta"]["step"] == 12
+    assert "preempted (signal)" in doc["meta"]["reason"]
+
+
+def test_injected_preempt_fault_drives_same_path(bundles):
+    """Drill: the chaos injection ("resilience.preempt:at=3") produces
+    the same preempt bundle as a real signal."""
+    blackbox.enable()
+    mx.fault.configure("resilience.preempt:at=3")
+
+    def train_fn():
+        for s in range(1, 6):
+            if mx.resilience.preempt_requested(step=s):
+                raise mx.resilience.Preempted(step=s, origin="injected")
+        return "finished"
+
+    with pytest.raises(mx.resilience.Preempted):
+        mx.resilience.run(train_fn)
+    doc = blackbox.read_bundle(blackbox.latest_bundle())
+    assert doc["meta"]["trigger"] == "preempt" and doc["meta"]["step"] == 3
+
+
+def test_worker_crash_past_budget_dumps_worker_lost(bundles):
+    """Drill: an injected worker crash escalates WorkerLost past the
+    restart budget; the terminal bundle names the op and the crash."""
+    blackbox.enable()
+
+    def always_lost():
+        raise mx.resilience.WorkerLost("allreduce", "w", 0, 2, 3,
+                                       RuntimeError("worker crashed"))
+
+    with pytest.raises(mx.resilience.WorkerLost):
+        mx.resilience.run(always_lost, max_restarts=1)
+    doc = blackbox.read_bundle(blackbox.latest_bundle())
+    assert doc["meta"]["trigger"] == "worker_lost"
+    assert "WorkerLost(allreduce)" in doc["meta"]["reason"]
+    assert doc["exception"]["type"] == "WorkerLost"
+
+
+def test_supervisor_attaches_dead_hosts_bundle(bundles, metrics):
+    """Drill: host 1 dies in a 2-host fleet; the supervisor finds its
+    latest bundle and attaches it to the degrade trace span."""
+
+    class _FakeStep:
+        mesh_config = MeshConfig(dp=2)
+
+        def rebuild(self, cfg, sync=False):
+            new = _FakeStep()
+            new.mesh_config = cfg
+            return new
+
+    blackbox.enable()
+    blackbox.dump(trigger="worker_lost", reason="host 1 went dark",
+                  step=4, rank=1)
+    dead = blackbox.latest_bundle(rank=1)
+    trace.enable(buffer=256)
+    try:
+        sup = FleetSupervisor(_FakeStep(), mx.resilience.TrainState(),
+                              n_hosts=2)
+        mx.fault.configure("fleet.host_loss:at=1")
+        sup.probe(1)
+        assert sup.degrades == 1
+        assert sup.postmortems == {1: dead}
+        spans = [s for s in trace.spans(category="fleet")
+                 if s["name"] == "fleet.degrade"]
+        assert spans and spans[-1]["args"]["postmortem"] == dead
+        assert spans[-1]["args"]["postmortem_host"] == 1
+    finally:
+        trace.disable()
+        trace.clear()
+
+
+def test_torn_bundle_is_skipped_not_fatal(bundles):
+    """Drill: the host dies mid-write ("blackbox.torn_bundle:at=1"); the
+    torn file fails validation and every reader walks past it to the
+    surviving evidence."""
+    blackbox.enable()
+    mx.fault.configure("blackbox.torn_bundle:at=1")
+    torn = blackbox.dump(trigger="manual", reason="will be torn",
+                         step=1, rank=0)
+    assert mx.fault.stats().get("injected.blackbox.torn_bundle") == 1
+    good = blackbox.dump(trigger="worker_lost", reason="real crash",
+                         step=2, rank=0)
+    with pytest.raises(MXNetError):
+        blackbox.read_bundle(torn)
+    assert blackbox.latest_bundle(rank=0) == good
+    report = blackbox.endpoint_report()
+    by_path = {e["path"]: e for e in report["bundles"]}
+    assert by_path[torn]["valid"] is False
+    assert by_path[good]["valid"] is True
+
+    rc, _, err = _cli("validate", torn)
+    assert rc == 1 and "torn" in err
+    rc, doc, err = _cli("merge", os.path.dirname(good))
+    assert rc == 0 and doc["torn"] == 1 and doc["hosts"] == 1
+    assert "skipping torn bundle" in err
+    assert doc["first_anomaly"]["reason"] == "real crash"
+
+
+def test_drift_trigger_dumps_bundle(bundles, metrics):
+    """insight.drift escalation doubles as a flight-recorder trigger."""
+    from mxnet_tpu import insight
+    blackbox.enable()
+    insight._record_drift("step_time", {"step": 40, "ratio": 2.0})
+    doc = blackbox.read_bundle(blackbox.latest_bundle())
+    assert doc["meta"]["trigger"] == "drift"
+    assert "step_time" in doc["meta"]["reason"]
+
+
+# -- shadow checkpoints ------------------------------------------------------
+
+def test_shadow_snapshot_rides_health_beat(tmp_path, bundles):
+    from mxnet_tpu.fleet import HealthPlane
+    blackbox.enable()
+    hp = HealthPlane(rank=0, nprocs=1, lease_dir=str(tmp_path / "lease"))
+    assert hp.beat(step=3) is True
+    doc = blackbox.read_bundle(blackbox.latest_bundle(rank=0))
+    assert doc["meta"]["shadow"] is True
+    assert doc["meta"]["trigger"] == "shadow" and doc["meta"]["step"] == 3
+    # rate limit: an immediate second beat does not write another bundle
+    n = len(blackbox.list_bundles())
+    hp.beat(step=4)
+    assert len(blackbox.list_bundles()) == n
+
+
+def test_shadow_loses_first_anomaly_to_terminal(bundles):
+    """Merge semantics: a terminal bundle outranks any shadow, even an
+    older one, when naming the first-anomaly host."""
+    blackbox.enable()
+    blackbox.dump(trigger="shadow", shadow=True, step=10, rank=0)
+    blackbox.dump(trigger="excepthook", reason="boom", step=11, rank=1)
+    rc, doc, _ = _cli("merge", blackbox.bundle_dir())
+    assert rc == 0 and doc["first_anomaly_host"] == 1
+    assert doc["first_anomaly"]["trigger"] == "excepthook"
+    rc, doc, _ = _cli("summary", blackbox.bundle_dir())
+    assert rc == 0 and doc["bundles"] == 2
+    assert doc["hosts"]["0"]["shadow"] is True
+
+
+def test_validate_expect_gates_trigger(bundles):
+    blackbox.enable()
+    path = blackbox.dump(trigger="manual", step=1, rank=0)
+    rc, doc, _ = _cli("validate", path, "--expect", "manual")
+    assert rc == 0 and doc["ok"] and doc["trigger"] == "manual"
+    rc, _, err = _cli("validate", path, "--expect", "worker_lost")
+    assert rc == 1 and "not in expected" in err
+
+
+# -- satellite: warnings + log records land in the event ring ---------------
+
+def test_event_ring_captures_warnings_and_logs(bundles, metrics):
+    blackbox.enable()
+    with warnings.catch_warnings():
+        warnings.simplefilter("always")
+        warnings.warn("grad clipped hard", RuntimeWarning)
+    logging.getLogger("mxnet_tpu.test").warning("lease renew slow: %ds", 3)
+    logging.getLogger("mxnet_tpu.test").debug("below threshold")
+    kinds = {(e["kind"], e["message"]) for e in telemetry.events()}
+    assert any(k == "warning" and "grad clipped hard" in m
+               for k, m in kinds)
+    assert any(k == "log" and "lease renew slow: 3s" in m
+               for k, m in kinds)
+    assert not any("below threshold" in m for _, m in kinds)
+    counts = telemetry.counters(aggregate=False)
+    assert counts.get('telemetry.events_total{kind="warning"}', 0) >= 1
+    # the ring rides into bundles
+    doc = blackbox.read_bundle(blackbox.dump(trigger="manual", step=1,
+                                             rank=0))
+    assert any(e["kind"] == "warning" for e in doc["events"])
+
+
+def test_event_ring_is_bounded(metrics):
+    prev = config.set("telemetry.event_ring", 4)
+    try:
+        telemetry.reset()                # re-latch the ring size
+        for i in range(10):
+            telemetry.note_event("log", f"record {i}")
+        evs = telemetry.events()
+        assert len(evs) == 4
+        assert [e["message"] for e in evs] == \
+            [f"record {i}" for i in range(6, 10)]
+    finally:
+        config.set("telemetry.event_ring", prev)
+        telemetry.reset()
+
+
+# -- satellite: size-capped JSONL report rotation ---------------------------
+
+def test_report_rotates_at_size_cap_never_mid_record(tmp_path, metrics):
+    path = str(tmp_path / "report.jsonl")
+    prev = config.set("telemetry.report_max_bytes", 400)
+    try:
+        rep = telemetry.TrainingTelemetry(path=path, interval=1,
+                                          run_id="rot")
+        for _ in range(12):
+            rep.step(loss=1.0)
+        rep.close()
+        gens = telemetry.TrainingTelemetry.generations(path)
+        assert len(gens) > 1 and gens[-1] == path
+        for g in gens:
+            with open(g, encoding="utf-8") as f:
+                size = 0
+                for line in f:
+                    json.loads(line)     # every line is a whole record
+                    size += len(line)
+            assert size <= 400 or sum(1 for _ in open(g)) == 1
+        counts = telemetry.counters(aggregate=True)
+        assert counts.get("telemetry.report_rotations_total", 0) == \
+            len(gens) - 1
+    finally:
+        config.set("telemetry.report_max_bytes", prev)
+
+
+def test_report_uncapped_never_rotates(tmp_path, metrics):
+    path = str(tmp_path / "flat.jsonl")
+    assert config.get("telemetry.report_max_bytes") == 0
+    rep = telemetry.TrainingTelemetry(path=path, interval=1, run_id="flat")
+    for _ in range(20):
+        rep.step(loss=0.5)
+    rep.close()
+    assert telemetry.TrainingTelemetry.generations(path) == [path]
+
+
+# -- satellite: sync_guard site counts in snapshot() ------------------------
+
+def test_snapshot_exposes_sync_site_counts(metrics):
+    from mxnet_tpu import pipeline
+    before = telemetry.snapshot()["sync_sites"].get("ndarray.item", 0)
+    a = mx.np.ones(())
+    a.item()                             # telemetry arms the site counter
+    snap = telemetry.snapshot()
+    assert snap["sync_sites"]["ndarray.item"] == before + 1
+    counts = telemetry.counters(aggregate=False)
+    assert counts.get('pipeline.host_syncs_total{site="ndarray.item"}',
+                      0) >= 1
+    assert pipeline.sync_site_counts()["ndarray.item"] >= before + 1

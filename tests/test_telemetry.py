@@ -73,7 +73,7 @@ def test_disabled_hooks_are_noops():
     assert telemetry.counters() == {}
     assert telemetry.summary_line() == ""
     assert telemetry.snapshot() == {
-        "counters": {}, "gauges": {}, "histograms": {}}
+        "counters": {}, "gauges": {}, "histograms": {}, "sync_sites": {}}
     assert telemetry.exposition() == ""
 
 

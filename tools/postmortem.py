@@ -1,0 +1,183 @@
+"""Postmortem CLI: inspect, validate and merge mx.blackbox bundles.
+
+Works on the checksummed ``blackbox-<rank>-<step>.json`` bundles the
+flight recorder writes (docs/OBSERVABILITY.md "Postmortem forensics").
+Prints ONE JSON summary line on stdout; diagnostics go to stderr.
+
+Usage:
+    # per-host digest of every readable bundle in a directory
+    python tools/postmortem.py summary /path/to/bundles
+
+    # fleet merge: one causal timeline across hosts (spans interleaved
+    # on the shared CLOCK_MONOTONIC base), first-anomaly host flagged
+    python tools/postmortem.py merge /path/to/bundles [--out merged.json]
+
+    # CI: integrity + trigger assertion on one bundle (exit 1 on torn)
+    python tools/postmortem.py validate bundle.json --expect worker_lost
+
+``validate`` re-verifies the ``.sha256`` sidecar, the JSON, and the
+schema tag; ``--expect TRIGGER`` additionally requires ``meta.trigger``
+to match.  ``merge`` skips torn bundles (reported on stderr) and keeps
+only the newest bundle per rank; the *first-anomaly host* is the rank
+whose earliest terminal (non-shadow) bundle carries the smallest
+``meta.clock_us`` — the host where things went wrong first.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fail(msg):
+    print(f"postmortem.py: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def load(path):
+    """Read one bundle with full integrity checks (checksum + JSON +
+    schema); failures exit 1."""
+    from mxnet_tpu import blackbox
+    from mxnet_tpu.base import MXNetError
+    try:
+        return blackbox.read_bundle(path)
+    except (MXNetError, OSError) as e:
+        fail(f"{path}: {e}")
+
+
+def scan(directory):
+    """-> (readable {path: bundle}, torn [path]) over one bundle dir,
+    newest per rank last."""
+    from mxnet_tpu import blackbox
+    from mxnet_tpu.base import MXNetError
+    paths = blackbox.list_bundles(directory)
+    if not paths:
+        fail(f"{directory}: no blackbox-<rank>-<step>.json bundles")
+    good, torn = {}, []
+    for p in paths:
+        try:
+            good[p] = blackbox.read_bundle(p)
+        except (MXNetError, OSError) as e:
+            torn.append(p)
+            print(f"postmortem.py: skipping torn bundle {p}: {e}",
+                  file=sys.stderr)
+    return good, torn
+
+
+def newest_per_rank(bundles):
+    """{rank: (path, bundle)} keeping each rank's newest bundle (the
+    list_bundles order is (mtime, name) ascending)."""
+    out = {}
+    for path, doc in bundles.items():
+        out[int(doc["meta"]["rank"])] = (path, doc)
+    return out
+
+
+def first_anomaly(per_rank):
+    """(rank, meta) of the earliest terminal (non-shadow) bundle on the
+    shared monotonic clock; falls back to the earliest shadow bundle
+    when no host recorded a terminal trigger."""
+    terminal = [(doc["meta"]["clock_us"], rank, doc["meta"])
+                for rank, (_, doc) in per_rank.items()
+                if not doc["meta"].get("shadow")]
+    pool = terminal or [(doc["meta"]["clock_us"], rank, doc["meta"])
+                        for rank, (_, doc) in per_rank.items()]
+    pool.sort(key=lambda t: (t[0], t[1]))
+    _, rank, meta = pool[0]
+    return rank, meta
+
+
+def merge(per_rank):
+    """One causal fleet timeline: every host's spans interleaved on the
+    shared CLOCK_MONOTONIC microsecond base, host label injected."""
+    timeline = []
+    for rank, (path, doc) in sorted(per_rank.items()):
+        for ev in doc.get("spans", ()):
+            ev = dict(ev)
+            args = dict(ev.get("args") or {})
+            args["host"] = rank
+            ev["args"] = args
+            timeline.append(ev)
+    timeline.sort(key=lambda e: (e.get("ts", 0),
+                                 e.get("args", {}).get("host", 0)))
+    rank, meta = first_anomaly(per_rank)
+    return {
+        "schema": "mx.postmortem-merge/1",
+        "hosts": {str(r): {"path": p, "trigger": d["meta"]["trigger"],
+                           "reason": d["meta"].get("reason"),
+                           "shadow": d["meta"].get("shadow", False),
+                           "step": d["meta"]["step"],
+                           "clock_us": d["meta"]["clock_us"]}
+                  for r, (p, d) in sorted(per_rank.items())},
+        "first_anomaly_host": rank,
+        "first_anomaly": {"trigger": meta["trigger"],
+                          "reason": meta.get("reason"),
+                          "step": meta["step"],
+                          "clock_us": meta["clock_us"]},
+        "timeline": timeline,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=("summary", "merge", "validate"))
+    ap.add_argument("path", help="bundle directory (summary/merge) or "
+                                 "one bundle file (validate)")
+    ap.add_argument("--expect", action="append", default=[],
+                    metavar="TRIGGER",
+                    help="validate: require meta.trigger to be one of "
+                         "the given values")
+    ap.add_argument("--out", default=None,
+                    help="merge: also write the merged document here")
+    args = ap.parse_args(argv)
+
+    if args.command == "validate":
+        doc = load(args.path)
+        meta = doc["meta"]
+        if args.expect and meta.get("trigger") not in args.expect:
+            fail(f"{args.path}: trigger {meta.get('trigger')!r} not in "
+                 f"expected {args.expect}")
+        print(json.dumps({"ok": True, "path": args.path,
+                          "trigger": meta.get("trigger"),
+                          "rank": meta.get("rank"),
+                          "step": meta.get("step"),
+                          "shadow": meta.get("shadow", False),
+                          "spans": len(doc.get("spans", ())),
+                          "events": len(doc.get("events", ()))}))
+        return 0
+
+    good, torn = scan(args.path)
+    per_rank = newest_per_rank(good)
+
+    if args.command == "summary":
+        print(json.dumps({
+            "dir": args.path, "bundles": len(good), "torn": len(torn),
+            "hosts": {str(r): {"path": p,
+                               "trigger": d["meta"]["trigger"],
+                               "shadow": d["meta"].get("shadow", False),
+                               "step": d["meta"]["step"],
+                               "spans": len(d.get("spans", ())),
+                               "events": len(d.get("events", ()))}
+                      for r, (p, d) in sorted(per_rank.items())}}))
+        return 0
+
+    doc = merge(per_rank)
+    doc["torn"] = torn
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+    print(json.dumps({"ok": True, "hosts": len(per_rank),
+                      "torn": len(torn),
+                      "timeline_events": len(doc["timeline"]),
+                      "first_anomaly_host": doc["first_anomaly_host"],
+                      "first_anomaly": doc["first_anomaly"],
+                      "out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
