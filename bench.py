@@ -857,6 +857,12 @@ def main(argv=None):
         if tuned is not None:
             row["tuned"] = True
             row["tuned_config"] = tuned
+        if "_train" in fn.__name__ or "_decode" in fn.__name__:
+            # the Pallas block shapes this row executed with (static
+            # defaults unless kernel winners are loaded) — makes a tuned
+            # vs untuned A/B readable straight off the bench JSON
+            from mxnet_tpu import autotune as _at
+            row["kernel_config"] = _at.kernel_config_summary()
         rows.append({k: (round(v, 2) if isinstance(v, float) else v)
                      for k, v in row.items()})
 

@@ -80,6 +80,20 @@ def _blackbox_loop(a, n, probes_per_op, blackbox):
     return time.perf_counter() - t0
 
 
+def _resolve_loop(a, n, probes_per_op, resolve_blocks):
+    """Same shape, probing the UNTUNED autotune.resolve_blocks fast path
+    (the routing every Pallas kernel call site takes at trace time)."""
+    t0 = time.perf_counter()
+    out = a
+    probe = range(probes_per_op)
+    for _ in range(n):
+        out = out + a
+        for _ in probe:
+            resolve_blocks("flash_attention", (256, 256, 64))
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
 def _trace_enabled_loop(a, n, trace):
     """Eager loop with one real recorded span per op (tracing ON)."""
     t0 = time.perf_counter()
@@ -94,20 +108,25 @@ def _trace_enabled_loop(a, n, trace):
 def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     import mxnet_tpu as mx
     from mxnet_tpu import blackbox, telemetry, trace
+    from mxnet_tpu.autotune.kernels import resolve_blocks, _TUNED
 
     telemetry.disable()
     trace.disable()
     blackbox.disable()
     assert not telemetry.active() and not trace.active() \
         and not blackbox.active()
+    assert not _TUNED, "resolve_blocks gate measures the UNTUNED path"
     a = mx.np.ones((8, 8))
     _loop(a, 200, 0, telemetry)          # warmup: compile + caches hot
-    base_s, probed_s, tprobed_s, bprobed_s, ton_s = [], [], [], [], []
+    resolve_blocks("flash_attention", (256, 256, 64))  # static table fill
+    base_s, probed_s, tprobed_s, bprobed_s = [], [], [], []
+    rprobed_s, ton_s = [], []
     for _ in range(repeats):
         base_s.append(_loop(a, n, 0, telemetry))
         probed_s.append(_loop(a, n, probes_per_op, telemetry))
         tprobed_s.append(_trace_loop(a, n, probes_per_op, trace))
         bprobed_s.append(_blackbox_loop(a, n, probes_per_op, blackbox))
+        rprobed_s.append(_resolve_loop(a, n, probes_per_op, resolve_blocks))
         trace.enable(buffer=max(1024, n))
         ton_s.append(_trace_enabled_loop(a, n, trace))
         trace.disable()
@@ -116,31 +135,38 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     probed = statistics.median(probed_s)
     tprobed = statistics.median(tprobed_s)
     bprobed = statistics.median(bprobed_s)
+    rprobed = statistics.median(rprobed_s)
     ton = statistics.median(ton_s)
     # cost of the K probes, scaled to the ~1 probe a real dispatch adds
     per_probe = max(0.0, probed - base) / probes_per_op
     per_trace_probe = max(0.0, tprobed - base) / probes_per_op
     per_blackbox_probe = max(0.0, bprobed - base) / probes_per_op
+    per_resolve_probe = max(0.0, rprobed - base) / probes_per_op
     ratio = per_probe / base
     trace_ratio = per_trace_probe / base
     blackbox_ratio = per_blackbox_probe / base
+    resolve_ratio = per_resolve_probe / base
     return {"ops": n, "probes_per_op": probes_per_op, "repeats": repeats,
             "baseline_s": round(base, 6), "probed_s": round(probed, 6),
             "trace_probed_s": round(tprobed, 6),
             "blackbox_probed_s": round(bprobed, 6),
+            "resolve_probed_s": round(rprobed, 6),
             "trace_enabled_s": round(ton, 6),
             "per_op_probe_overhead_ns": round(per_probe / n * 1e9, 2),
             "per_op_trace_probe_overhead_ns":
                 round(per_trace_probe / n * 1e9, 2),
             "per_op_blackbox_probe_overhead_ns":
                 round(per_blackbox_probe / n * 1e9, 2),
+            "per_op_resolve_probe_overhead_ns":
+                round(per_resolve_probe / n * 1e9, 2),
             "overhead_ratio": round(ratio, 6),
             "trace_overhead_ratio": round(trace_ratio, 6),
             "blackbox_overhead_ratio": round(blackbox_ratio, 6),
+            "resolve_overhead_ratio": round(resolve_ratio, 6),
             "trace_enabled_ratio": round(max(0.0, ton - base) / base, 6),
             "budget": budget,
             "ok": ratio < budget and trace_ratio < budget
-                  and blackbox_ratio < budget}
+                  and blackbox_ratio < budget and resolve_ratio < budget}
 
 
 def main(argv=None):
@@ -165,6 +191,8 @@ def main(argv=None):
               f"{r['trace_probed_s'] * 1e3:9.2f} ms")
         print(f"with {r['probes_per_op']}x disabled blackbox probes/op "
               f"{r['blackbox_probed_s'] * 1e3:9.2f} ms")
+        print(f"with {r['probes_per_op']}x untuned resolve_blocks/op "
+              f"{r['resolve_probed_s'] * 1e3:9.2f} ms")
         print(f"with tracing ENABLED (1 span/op) "
               f"{r['trace_enabled_s'] * 1e3:9.2f} ms "
               f"(+{r['trace_enabled_ratio'] * 100:.2f}%, informational)")
@@ -176,12 +204,15 @@ def main(argv=None):
         print(f"blackbox overhead ratio  "
               f"{r['blackbox_overhead_ratio'] * 100:9.4f} % "
               f"(budget {r['budget'] * 100:g}%)")
+        print(f"resolve_blocks ratio     "
+              f"{r['resolve_overhead_ratio'] * 100:9.4f} % "
+              f"(budget {r['budget'] * 100:g}%)")
     if not r["ok"]:
         print("FAIL: a disabled observability fast path exceeds the "
               "overhead budget", file=sys.stderr)
         return 1
-    print("OK: disabled telemetry + trace + blackbox fast paths within "
-          "budget")
+    print("OK: disabled telemetry + trace + blackbox + untuned "
+          "resolve_blocks fast paths within budget")
     return 0
 
 

@@ -341,6 +341,15 @@ autotune() {
     JAX_PLATFORMS=cpu python tools/autotune.py --model mlp \
         --cache-dir "$tmp" --trial-seconds 0.05 --expect-reused
     rm -rf "$tmp"
+    echo "== autotune: kernel block-shape suite (docs/PERFORMANCE.md) =="
+    python -m pytest tests/test_kernel_autotune.py -q
+    echo "== autotune: kernel search e2e (winner/bucket, cached 2nd run = 0 trials) =="
+    tmp=$(mktemp -d)
+    JAX_PLATFORMS=cpu python tools/autotune.py --kernels \
+        --cache-dir "$tmp" --trial-seconds 0.02 --assert
+    JAX_PLATFORMS=cpu python tools/autotune.py --kernels \
+        --cache-dir "$tmp" --trial-seconds 0.02 --expect-reused
+    rm -rf "$tmp"
 }
 
 quantize() {

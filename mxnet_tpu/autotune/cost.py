@@ -29,10 +29,13 @@ from __future__ import annotations
 import numpy as onp
 
 from .. import config as _config
+from ..base import MXNetError
 from .space import Candidate
 
 __all__ = ["ModelStats", "CostModel", "REMAT_MEM_FRACTION",
-           "REMAT_FLOPS_FACTOR", "PRECISION_COMPUTE_FACTOR"]
+           "REMAT_FLOPS_FACTOR", "PRECISION_COMPUTE_FACTOR",
+           "VMEM_BYTES", "VMEM_FRACTION", "kernel_tile_bytes",
+           "kernel_cost"]
 
 #: fraction of peak live activation bytes kept under each remat policy
 #: (full remat keeps only layer inputs; 'dots' keeps matmul outputs)
@@ -229,3 +232,92 @@ class CostModel:
             pruned.extend((c, "ranked_out") for c in extra)
             keep = ranked
         return keep, pruned
+
+
+# ---------------------------------------------------------------------------
+# kernel-level analytics (kernels.py): VMEM footprint + relative tile cost
+# ---------------------------------------------------------------------------
+
+#: per-core VMEM capacity the tile footprint must fit (TPU v4/v5/v6 all
+#: carry ~16 MB; the interpreter has no real limit but honoring it keeps
+#: CPU-CI pruning representative)
+VMEM_BYTES = 16 * 2 ** 20
+#: fraction of VMEM the tuner budgets for one kernel's resident tiles
+#: (the rest is Mosaic's: double-buffered DMA staging, scratch, spills)
+VMEM_FRACTION = 0.5
+
+
+def kernel_tile_bytes(kernel, bucket, blocks):
+    """Estimated VMEM bytes resident for one grid step of ``kernel`` at
+    ``blocks`` on a ``bucket``-shaped problem — the kernel tuner's
+    pre-compile OOM guard (prune reason ``"vmem"``)."""
+    b = dict(blocks)
+    if kernel in ("flash_attention", "flash_attention_bwd"):
+        sq, sk, d = bucket
+        d = max(128, int(d))  # head_dim zero-pads to the lane width
+        bq = min(int(b["block_q"]), int(sq))
+        bk = min(int(b["block_k"]), int(sk))
+        # q/o/acc tiles + k/v tiles + the (bq, bk) score block, fp32;
+        # the bwd kernels additionally hold do/dq (q-shaped) and dk/dv
+        # (k-shaped) accumulators
+        tiles = 3 * bq * d + 2 * bk * d + bq * bk
+        if kernel == "flash_attention_bwd":
+            tiles += 2 * bq * d + 2 * bk * d
+        return 4 * tiles
+    if kernel in ("quantized_matmul", "fp8_matmul"):
+        m, n, k = bucket
+        bm = min(int(b["block_m"]), int(m))
+        bn = min(int(b["block_n"]), int(n))
+        kp = max(128, int(k))
+        # one (bm, K) activation tile (fp32 in + int8/fp8 quantized copy),
+        # one (bn, K) low-bit weight tile, the fp32 (bm, bn) output tile
+        return 5 * bm * kp + bn * kp + 4 * bm * bn
+    if kernel == "ln_residual":
+        rows, dim = bucket
+        br = min(int(b["block_rows"]), max(8, int(rows)))
+        # x/h/mask/out tiles plus fp32 row stats
+        return 4 * (4 * br * dim + 2 * br)
+    raise MXNetError(f"kernel_tile_bytes: unknown kernel {kernel!r}")
+
+
+def kernel_cost(kernel, bucket, blocks):
+    """Relative analytic cost of ``blocks`` on a ``bucket``-shaped
+    problem: per-tile work plus a fixed launch overhead per grid step,
+    plus an MXU/VPU under-utilization penalty for tiles below the native
+    (8/32 x 128) shape.  Only the ORDER matters — this is the ranking
+    the learned model (learned.py) must beat on Spearman correlation to
+    replace it."""
+    b = dict(blocks)
+    launch = 1.0   # relative dispatch cost per grid step
+
+    def _grid_and_util(sizes, tiles, aligns):
+        steps, util = 1.0, 1.0
+        for size, tile, align in zip(sizes, tiles, aligns):
+            size = max(1, int(size))
+            tile = max(1, min(int(tile), size))
+            steps *= -(-size // tile)          # ceil-div grid steps
+            util *= min(1.0, tile / align)     # sub-native-tile penalty
+        return steps, util
+
+    if kernel in ("flash_attention", "flash_attention_bwd"):
+        sq, sk, d = bucket
+        steps, util = _grid_and_util((sq, sk), (b["block_q"], b["block_k"]),
+                                     (256, 256))
+        work = (min(b["block_q"], sq) * min(b["block_k"], sk)
+                * max(128, d)) / 2 ** 20
+        passes = 3.0 if kernel == "flash_attention_bwd" else 1.0
+    elif kernel in ("quantized_matmul", "fp8_matmul"):
+        m, n, k = bucket
+        steps, util = _grid_and_util((m, n), (b["block_m"], b["block_n"]),
+                                     (256, 256))
+        work = (min(b["block_m"], m) * min(b["block_n"], n)
+                * max(128, k)) / 2 ** 20
+        passes = 1.0
+    elif kernel == "ln_residual":
+        rows, dim = bucket
+        steps, util = _grid_and_util((rows,), (b["block_rows"],), (256,))
+        work = (min(b["block_rows"], rows) * dim) / 2 ** 17
+        passes = 1.0
+    else:
+        raise MXNetError(f"kernel_cost: unknown kernel {kernel!r}")
+    return passes * steps * (launch + work / util)

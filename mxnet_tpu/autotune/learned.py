@@ -1,0 +1,217 @@
+"""Learned kernel cost model: ridge regression over hashed features.
+
+"A Learned Performance Model for Tensor Processing Units" (arXiv
+2008.01040) shows a small regressor over op shapes/flops/bytes predicts
+TPU kernel runtime well enough to rank a tile search.  This is the
+minimal honest version of that result: a feature-hashed ridge regressor
+(pure stdlib — the normal equations are solved with Gaussian
+elimination, no sklearn/scipy) trained on the measured kernel trials
+every tuned run already persists (``winners.json`` ``"trials"`` plane,
+persist.py) plus the ``"autotune"`` plane of ``TrainingTelemetry``
+JSONL run reports the fleet accumulates for free.
+
+The model never gets authority it hasn't earned: before it ranks a
+search, :func:`rank_gate` compares its Spearman rank correlation on the
+recorded trials against the analytic :func:`~.cost.kernel_cost` — only
+a model that beats (or ties) the closed form replaces it, and the
+margin lands on the ``autotune.learned_rank_corr`` gauge either way.
+"""
+from __future__ import annotations
+
+import json
+import math
+
+from ..base import MXNetError
+from .cost import kernel_cost, kernel_tile_bytes
+
+__all__ = ["LearnedCostModel", "spearman", "rank_gate",
+           "load_telemetry_records", "MIN_FIT_RECORDS"]
+
+#: below this many recorded trials the learned model abstains (the
+#: analytic model ranks) — a 2-point fit "beating" the closed form is
+#: noise, not evidence
+MIN_FIT_RECORDS = 8
+
+
+def _stable_hash(s):
+    """Deterministic string hash (Python's builtin hash is salted per
+    process — useless for a model whose weights must mean the same thing
+    across runs)."""
+    h = 2166136261
+    for ch in s.encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def spearman(xs, ys):
+    """Spearman rank correlation of two equal-length sequences (average
+    ranks on ties); 0.0 when degenerate (n < 2 or a constant side)."""
+    n = len(xs)
+    if n != len(ys):
+        raise MXNetError(f"spearman: length mismatch {n} vs {len(ys)}")
+    if n < 2:
+        return 0.0
+
+    def _ranks(vs):
+        order = sorted(range(n), key=lambda i: vs[i])
+        ranks = [0.0] * n
+        i = 0
+        while i < n:
+            j = i
+            while j + 1 < n and vs[order[j + 1]] == vs[order[i]]:
+                j += 1
+            avg = (i + j) / 2.0 + 1.0
+            for t in range(i, j + 1):
+                ranks[order[t]] = avg
+            i = j + 1
+        return ranks
+
+    rx, ry = _ranks(list(xs)), _ranks(list(ys))
+    mx_ = sum(rx) / n
+    my = sum(ry) / n
+    cov = sum((a - mx_) * (b - my) for a, b in zip(rx, ry))
+    vx = sum((a - mx_) ** 2 for a in rx)
+    vy = sum((b - my) ** 2 for b in ry)
+    if vx <= 0 or vy <= 0:
+        return 0.0
+    return cov / math.sqrt(vx * vy)
+
+
+class LearnedCostModel:
+    """Feature-hashed ridge regressor: trial record -> log runtime.
+
+    Features per (kernel, bucket, blocks) point: one hashed categorical
+    slot per ``kernel`` and per ``block=value`` pair, plus hashed
+    numeric slots carrying log2 of every bucket dim and block value, the
+    log tile footprint and the log analytic cost — so the learned model
+    starts from everything the closed form knows and corrects it from
+    measurements.
+    """
+
+    def __init__(self, dim=32, l2=1e-2):
+        self.dim = int(dim)
+        self.l2 = float(l2)
+        self.w = [0.0] * self.dim
+        self.n_fit = 0
+
+    def featurize(self, kernel, bucket, blocks):
+        x = [0.0] * self.dim
+
+        def _add(name, value):
+            x[_stable_hash(name) % self.dim] += value
+
+        _add(f"kernel={kernel}", 1.0)
+        for i, d in enumerate(bucket):
+            _add(f"{kernel}.dim{i}", math.log2(max(1, int(d))))
+        for k, v in sorted(dict(blocks).items()):
+            _add(f"{k}={v}", 1.0)
+            _add(f"{kernel}.{k}", math.log2(max(1, int(v))))
+        _add("tile_bytes",
+             math.log2(max(1, kernel_tile_bytes(kernel, bucket, blocks))))
+        _add("analytic",
+             math.log2(max(1e-9, kernel_cost(kernel, bucket, blocks))))
+        _add("bias", 1.0)
+        return x
+
+    def fit(self, records):
+        """Ridge fit on trial records (``{"kernel", "bucket", "blocks",
+        "seconds"}``); records without a positive measurement are
+        skipped.  Returns the number of records used."""
+        rows, ys = [], []
+        for r in records:
+            sec = r.get("seconds")
+            if not sec or sec <= 0:
+                continue
+            try:
+                rows.append(self.featurize(r["kernel"], tuple(r["bucket"]),
+                                           r["blocks"]))
+            except (KeyError, MXNetError):
+                continue
+            ys.append(math.log(sec))
+        self.n_fit = len(rows)
+        if not rows:
+            return 0
+        d = self.dim
+        # normal equations (X^T X + l2 I) w = X^T y, Gaussian elimination
+        a = [[self.l2 if i == j else 0.0 for j in range(d)]
+             for i in range(d)]
+        b = [0.0] * d
+        for x, y in zip(rows, ys):
+            for i in range(d):
+                xi = x[i]
+                if xi == 0.0:
+                    continue
+                b[i] += xi * y
+                for j in range(d):
+                    if x[j] != 0.0:
+                        a[i][j] += xi * x[j]
+        for col in range(d):
+            piv = max(range(col, d), key=lambda r_: abs(a[r_][col]))
+            if abs(a[piv][col]) < 1e-12:
+                continue
+            a[col], a[piv] = a[piv], a[col]
+            b[col], b[piv] = b[piv], b[col]
+            inv = 1.0 / a[col][col]
+            for r_ in range(d):
+                if r_ == col:
+                    continue
+                f = a[r_][col] * inv
+                if f == 0.0:
+                    continue
+                for j in range(col, d):
+                    a[r_][j] -= f * a[col][j]
+                b[r_] -= f * b[col]
+        self.w = [b[i] / a[i][i] if abs(a[i][i]) > 1e-12 else 0.0
+                  for i in range(d)]
+        return self.n_fit
+
+    def predict(self, kernel, bucket, blocks):
+        """Predicted log-runtime (relative — only the order is used)."""
+        x = self.featurize(kernel, tuple(bucket), blocks)
+        return sum(wi * xi for wi, xi in zip(self.w, x))
+
+
+def rank_gate(model, records):
+    """Score the learned model against the analytic ``kernel_cost`` on
+    the recorded trials: Spearman(predicted, measured) for both.
+    Returns ``(use_learned, learned_corr, analytic_corr)`` — the learned
+    model ranks only when fitted on enough records AND its correlation
+    is at least the closed form's."""
+    pts = [r for r in records
+           if r.get("seconds") and r["seconds"] > 0
+           and "kernel" in r and "bucket" in r and "blocks" in r]
+    if len(pts) < 2:
+        return False, 0.0, 0.0
+    measured = [r["seconds"] for r in pts]
+    learned = [model.predict(r["kernel"], tuple(r["bucket"]), r["blocks"])
+               for r in pts]
+    analytic = [kernel_cost(r["kernel"], tuple(r["bucket"]), r["blocks"])
+                for r in pts]
+    lc = spearman(learned, measured)
+    ac = spearman(analytic, measured)
+    use = model.n_fit >= MIN_FIT_RECORDS and lc >= ac
+    return use, lc, ac
+
+
+def load_telemetry_records(path):
+    """Harvest kernel trial records from a ``TrainingTelemetry`` JSONL
+    run-report file: every report whose ``"autotune"`` plane carries a
+    ``"kernel_trials"`` list contributes its records.  Malformed lines
+    are skipped — fleet-aggregated files splice reports from many hosts
+    and one torn line must not poison the training set."""
+    records = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return records
+    for line in lines:
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        plane = doc.get("autotune") if isinstance(doc, dict) else None
+        trials = (plane or {}).get("kernel_trials")
+        if isinstance(trials, list):
+            records.extend(t for t in trials if isinstance(t, dict))
+    return records

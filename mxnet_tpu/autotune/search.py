@@ -174,8 +174,18 @@ class SearchResult:
 
 def last_summary():
     """Summary dict of the most recent search in this process (None when
-    no search ran) — merged into TrainingTelemetry run reports."""
-    return _LAST
+    no search ran) — merged into TrainingTelemetry run reports.  A
+    kernel-level search (kernels.py) contributes a ``"kernels"`` plane
+    and the raw ``"kernel_trials"`` records the learned cost model
+    harvests back out of fleet-aggregated report files."""
+    from . import kernels as _kernels
+    ks = _kernels.last_kernel_summary()
+    if ks is None:
+        return _LAST
+    out = dict(_LAST or {})
+    out["kernels"] = {k: v for k, v in ks.items() if k != "kernel_trials"}
+    out["kernel_trials"] = ks.get("kernel_trials", [])
+    return out
 
 
 def _hbm_budget(devices=None):

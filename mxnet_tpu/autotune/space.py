@@ -23,7 +23,15 @@ import itertools
 from .. import config as _config
 from ..base import MXNetError
 
-__all__ = ["Candidate", "SearchSpace", "REMAT_VALUES", "PRECISION_VALUES"]
+__all__ = ["Candidate", "SearchSpace", "REMAT_VALUES", "PRECISION_VALUES",
+           "as_axis"]
+
+
+def as_axis(v):
+    """Normalize one grid axis: a scalar becomes a single-value axis, a
+    list/tuple passes through as a tuple (shared with the kernel-level
+    block-shape space in kernels.py)."""
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,)
 
 
 def _mesh_value(v):
@@ -124,8 +132,7 @@ class SearchSpace:
     def __init__(self, batch_size, steps_per_call=(1, 2, 4),
                  grad_accum=(1, 2), zero=(0, 1, 2), remat=REMAT_VALUES,
                  prefetch_depth=None, precision="fp32", mesh=None):
-        def _axis(v):
-            return tuple(v) if isinstance(v, (tuple, list)) else (v,)
+        _axis = as_axis
         self.batch_size = _axis(batch_size)
         self.steps_per_call = _axis(steps_per_call)
         self.grad_accum = _axis(grad_accum)

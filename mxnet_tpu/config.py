@@ -276,6 +276,26 @@ declare("autotune.launch_overhead_items", float, 8.0,
         "Cost-model constant: per-launch dispatch overhead expressed in "
         "item-equivalents, amortized over batch*steps_per_call when "
         "ranking candidates (tunneled-TPU dispatch is ~1-7ms/launch).")
+declare("autotune.kernel_trial_fraction", float, 0.5,
+        "MXNET_AUTOTUNE_KERNEL_TRIAL_FRACTION",
+        "Fraction of the VMEM-feasible kernel block-shape candidates the "
+        "kernel-level search actually measures: the cost model (learned "
+        "when it out-ranks the analytic one, see "
+        "autotune.learned_rank_corr) ranks the grid and only the "
+        "predicted-top fraction (min 1, always including the static "
+        "default) gets a timed trial.")
+declare("autotune.kernel_trial_seconds", float, 0.1,
+        "MXNET_AUTOTUNE_KERNEL_TRIAL_SECONDS",
+        "Target measured window per kernel block-shape trial — kernels "
+        "are microseconds-scale, so a much shorter window than the "
+        "step-level autotune.trial_seconds still averages hundreds of "
+        "launches.")
+declare("autotune.retune_on_drift", bool, False,
+        "MXNET_AUTOTUNE_RETUNE_ON_DRIFT",
+        "Arm the online kernel re-tuner: when mx.insight raises a "
+        "step-time drift event, an armed Retuner re-searches kernel "
+        "block shapes in a background thread and hot-swaps the winner "
+        "at the next checkpoint boundary (autotune.retunes_total).")
 declare("quantize.fused_matmul", str, "auto", "MXNET_QUANTIZE_FUSED_MATMUL",
         "Pallas fused quantize+int8-dot+dequant matmul for calibrated "
         "QuantizedDense layers: 'auto' (TPU only), 'on' (everywhere, "

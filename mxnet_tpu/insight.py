@@ -45,7 +45,7 @@ __all__ = [
     "enable", "disable", "configure", "active", "reset",
     "capture_cost", "capture_jit", "register_executable", "note_step",
     "roofline_verdict", "attribution", "last_summary", "healthz",
-    "drift_events", "DriftDetector",
+    "drift_events", "DriftDetector", "on_drift", "remove_drift_hook",
     "write_snapshot", "maybe_snapshot", "read_snapshots",
     "merge_snapshots", "fleet_exposition", "relative_slowness",
     "endpoint_report",
@@ -165,6 +165,7 @@ def reset():
         _detectors.clear()
         _drift_ring.clear()
         _last_call.clear()
+        _drift_hooks.clear()
         _snap_last = 0.0
         _peak_cache = None
 
@@ -449,9 +450,31 @@ def _feed(source, seconds, exe=None, step=None):
         _record_drift(source, event)
 
 
+#: external drift subscribers (e.g. the autotune Retuner arming an
+#: online kernel re-search) — called as fn(source, event), exceptions
+#: swallowed: a broken subscriber must not take the drift plane down
+_drift_hooks: list = []
+
+
+def on_drift(fn):
+    """Subscribe ``fn(source, event)`` to every drift event; returns
+    ``fn`` (decorator-friendly).  Idempotent per function object."""
+    if fn not in _drift_hooks:
+        _drift_hooks.append(fn)
+    return fn
+
+
+def remove_drift_hook(fn):
+    """Unsubscribe; unknown functions are a no-op."""
+    try:
+        _drift_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
 def _record_drift(source, event):
     """Mirror one drift event into the telemetry, fault and trace
-    planes."""
+    planes, then fan out to the registered drift hooks."""
     if _telemetry._active:
         _telemetry.inc("insight.drift_events_total", source=source)
     _fault.record("insight.drift")
@@ -469,6 +492,11 @@ def _record_drift(source, event):
         _blackbox.dump(trigger="drift",
                        reason=f"insight.drift: {source}",
                        step=event.get("step"))
+    for fn in list(_drift_hooks):
+        try:
+            fn(source, event)
+        except Exception:
+            pass
 
 
 def drift_events():

@@ -115,13 +115,15 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, block_q, block_k, bwd_block_q,
+           bwd_block_k, interpret):
     out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, bwd_block_q,
+               bwd_block_k, interpret):
     out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
@@ -212,18 +214,22 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
         _compute()
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, bwd_block_q, bwd_block_k,
+               interpret, res, do):
     """Blocked Pallas backward (flash-style residuals: out + logsumexp).
 
     Memory is O(seq): P is rebuilt per (q-block, k-block) tile in VMEM from
     the saved lse, never materialized in HBM — the training-side completion
     of the forward kernel's claim (round-1 VJP materialized (s, s) scores).
+    Tiles independently of the forward (bwd_block_q/bwd_block_k): the
+    backward holds ~2x the forward's accumulators per tile, so its tuned
+    optimum is usually smaller.
     """
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = min(bwd_block_q, sq)
+    bk = min(bwd_block_k, sk)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
@@ -286,17 +292,34 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
-                    block_k=512, interpret=False):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, bwd_block_q=None, bwd_block_k=None,
+                    interpret=False):
     """Multi-head attention, scores never materialized in HBM.
 
     q: (batch, heads, seq_q, head_dim); k/v: (batch, heads, seq_k, head_dim).
     Returns (batch, heads, seq_q, head_dim).
+
+    Block shapes default to ``mx.autotune.resolve_blocks`` — the tuned
+    winner for this (seq_q, seq_k, head_dim) bucket when one is loaded,
+    else the per-device static table (CPU row keeps the historical
+    1024/512).  The backward tiles independently via bwd_block_q /
+    bwd_block_k.  Explicit values always win.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if block_q is None or block_k is None:
+        from ...autotune.kernels import resolve_blocks
+        fb = resolve_blocks("flash_attention", (sq, sk, d))
+        block_q = fb["block_q"] if block_q is None else block_q
+        block_k = fb["block_k"] if block_k is None else block_k
+    if bwd_block_q is None or bwd_block_k is None:
+        from ...autotune.kernels import resolve_blocks
+        bb = resolve_blocks("flash_attention_bwd", (sq, sk, d))
+        bwd_block_q = bb["block_q"] if bwd_block_q is None else bwd_block_q
+        bwd_block_k = bb["block_k"] if bwd_block_k is None else bwd_block_k
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
@@ -307,7 +330,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=1024,
     if d_pad:
         pad = ((0, 0), (0, 0), (0, d_pad))
         qr, kr, vr = (jnp.pad(qr, pad), jnp.pad(kr, pad), jnp.pad(vr, pad))
-    out = _flash(qr, kr, vr, causal, scale, block_q, block_k, interpret)
+    out = _flash(qr, kr, vr, causal, scale, block_q, block_k, bwd_block_q,
+                 bwd_block_k, interpret)
     if d_pad:
         out = out[..., :d]
     return out.reshape(b, h, sq, d)

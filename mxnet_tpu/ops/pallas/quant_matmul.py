@@ -90,7 +90,7 @@ def _int8_kernel(xs_ref, x_ref, w_ref, ws_ref, b_ref, o_ref, *, act):
 
 
 def quantized_matmul(x, w_q, w_scale, x_scale, bias=None, act=None,
-                     block_m=256, block_n=256, interpret=False):
+                     block_m=None, block_n=None, interpret=False):
     """``dequant(quantize(x) @ w_q.T) + bias`` fused in one VMEM pass.
 
     x: (M, K) float; w_q: (N, K) int8 (per-output-channel quantized);
@@ -109,6 +109,11 @@ def quantized_matmul(x, w_q, w_scale, x_scale, bias=None, act=None,
                          f"one of {sorted(k for k in _ACTS if k)}")
     m, k = x.shape
     n = w_q.shape[0]
+    if block_m is None or block_n is None:
+        from ...autotune.kernels import resolve_blocks
+        tb = resolve_blocks("quantized_matmul", (m, n, k))
+        block_m = tb["block_m"] if block_m is None else block_m
+        block_n = tb["block_n"] if block_n is None else block_n
     # int8 tiles are (32, 128); the fp32 output tile needs lane 128
     bm = min(block_m, _round_up(m, 32))
     bn = min(block_n, _round_up(n, 128))
@@ -149,7 +154,7 @@ def _fp8_kernel(xs_ref, x_ref, w_ref, ws_ref, b_ref, o_ref, *, act, fmt):
 
 
 def fp8_matmul(x, w_q, w_scale, x_scale, bias=None, act=None, fmt="e4m3",
-               block_m=256, block_n=256, interpret=False):
+               block_m=None, block_n=None, interpret=False):
     """fp8×fp8 variant of :func:`quantized_matmul`.
 
     w_q: (N, K) in the chosen fp8 format (per-output-channel scaled so
@@ -163,6 +168,11 @@ def fp8_matmul(x, w_q, w_scale, x_scale, bias=None, act=None, fmt="e4m3",
         raise ValueError(f"unsupported fused activation {act!r}")
     m, k = x.shape
     n = w_q.shape[0]
+    if block_m is None or block_n is None:
+        from ...autotune.kernels import resolve_blocks
+        tb = resolve_blocks("fp8_matmul", (m, n, k))
+        block_m = tb["block_m"] if block_m is None else block_m
+        block_n = tb["block_n"] if block_n is None else block_n
     bm = min(block_m, _round_up(m, 32))
     bn = min(block_n, _round_up(n, 128))
     grid_m, grid_n = pl.cdiv(m, bm), pl.cdiv(n, bn)

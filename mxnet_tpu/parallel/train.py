@@ -834,12 +834,17 @@ class ShardedTrainStep:
         tuned._n_step = self._n_step
         return tuned, result
 
-    def rebuild(self, mesh, sync=True):
-        """Re-construct this step around a different :class:`MeshConfig`
-        (same block / loss / optimizer / zero / grad_accum / remat) — the
+    def rebuild(self, mesh=None, sync=True):
+        """Re-construct this step around a :class:`MeshConfig` (same
+        block / loss / optimizer / zero / grad_accum / remat) — the
         fleet supervisor's degrade/re-expand primitive.  Batch and param
         specs re-derive from the new layout, so the result accepts the
         same per-update batches at a different dp size.
+
+        ``mesh=None`` rebuilds on this step's own mesh — a re-jit in
+        place, which is how the autotune Retuner makes freshly published
+        kernel block shapes take effect at a checkpoint boundary without
+        changing the layout.
 
         ``sync=True`` writes the current sharded weights back into the
         block first, so the rebuilt step starts from this step's live
@@ -847,6 +852,12 @@ class ShardedTrainStep:
         bitwise bundle restore immediately follows and the dying layout's
         device buffers may no longer be gatherable.
         """
+        if mesh is None:
+            mesh = self.mesh_config
+            if mesh is None:
+                raise MXNetError(
+                    "rebuild() without a mesh needs a step built from a "
+                    "MeshConfig (this one was built from a raw mesh)")
         if not isinstance(mesh, MeshConfig):
             raise MXNetError(
                 f"rebuild needs a MeshConfig, got {type(mesh).__name__}")

@@ -61,7 +61,7 @@ from . import trace as _trace
 __all__ = ["DevicePrefetcher", "prefetch_to_device", "DeferredWindow",
            "maybe_device_put", "ensure_sharded", "sync_guard",
            "note_host_sync", "SyncGuard", "take", "arm_site_counts",
-           "sync_site_counts"]
+           "sync_site_counts", "reset_site_counts"]
 
 
 def take(source, n):
@@ -204,6 +204,13 @@ def sync_site_counts():
     """Process-lifetime host-sync counts by call site (sorted copy)."""
     with _guard_lock:
         return dict(sorted(_site_totals.items()))
+
+
+def reset_site_counts():
+    """Drop the per-site sync totals (telemetry.reset test isolation);
+    the armed owners and guard depth are untouched."""
+    with _guard_lock:
+        _site_totals.clear()
 
 
 def note_host_sync(site):

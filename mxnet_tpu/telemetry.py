@@ -191,7 +191,8 @@ declare_metric("autotune.candidates_total", "counter",
                "config-search grid points considered by mx.autotune")
 declare_metric("autotune.pruned_total", "counter",
                "candidates the analytic cost model rejected without a "
-               "compile, by reason (dominated/hbm/invalid/ranked_out)")
+               "compile, by reason (dominated/hbm/invalid/vmem/"
+               "ranked_out)")
 declare_metric("autotune.trials_total", "counter",
                "measured autotune trials executed (compile + short "
                "timed window), including failed ones")
@@ -210,6 +211,18 @@ declare_metric("telemetry.scrape_duration_seconds", "gauge",
 declare_metric("autotune.cache_hits_total", "counter",
                "searches answered from the persisted winners file "
                "(fingerprint match, zero trials re-run)")
+declare_metric("autotune.kernel_trials_total", "counter",
+               "measured kernel-level block-shape trials executed by "
+               "mx.autotune.kernels (including failed ones)")
+declare_metric("autotune.kernel_cache_hits_total", "counter",
+               "kernel block-shape searches answered from the persisted "
+               "winners file (bucket match, zero trials re-run)")
+declare_metric("autotune.retunes_total", "counter",
+               "drift-triggered kernel re-tunes applied at a checkpoint "
+               "boundary (Retuner hot-swaps)")
+declare_metric("autotune.learned_rank_corr", "gauge",
+               "Spearman rank correlation of the learned kernel cost "
+               "model against measured trials at the last rank gate")
 
 
 # -- switches ---------------------------------------------------------------
@@ -392,6 +405,8 @@ def reset():
         _hists.clear()
     with _events_lock:
         _events = None
+    from . import pipeline as _pipeline   # lazy: pipeline imports us
+    _pipeline.reset_site_counts()
 
 
 # -- bounded event ring -----------------------------------------------------

@@ -356,7 +356,8 @@ def test_winners_file_is_valid_json_with_version():
     res = _search(_planted(), net=net)
     with open(res.path) as f:
         data = json.load(f)
-    assert data["version"] == 1
+    # schema 2 (kernel winners + trials ring); "version" kept as an alias
+    assert data["schema"] == 2 and data["version"] == 2
     rec = data["winners"][res.key]
     assert rec["config"] == res.config
     assert rec["fingerprint"] == res.key.split("|")[0]

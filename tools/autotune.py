@@ -18,9 +18,18 @@ Usage:
     JAX_PLATFORMS=cpu python tools/autotune.py --model mlp \
         --cache-dir /tmp/tune --expect-reused
 
+    # kernel-level search: tuned Pallas block shapes (flash attention,
+    # int8/fp8 matmul, ln_residual) into the same winners.json
+    JAX_PLATFORMS=cpu python tools/autotune.py --kernels --assert
+    JAX_PLATFORMS=cpu python tools/autotune.py --kernels \
+        --cache-dir /tmp/tune --expect-reused
+
 ``--assert`` enforces: >=50% of the grid pruned without compiling, the
 winner's measured items/s >= the untuned default, zero RecompileWarnings
 after the search, and (with --inject-oom-at) the OOM trial recorded.
+With ``--kernels`` it enforces: a winner per searched bucket, zero
+RecompileWarnings after the search, and the measured-trial cap
+(autotune.kernel_trial_fraction) respected.
 """
 from __future__ import annotations
 
@@ -70,6 +79,52 @@ def make_batch(model, feature_shape, n_classes, batch, seq, seed=0):
     return x, y
 
 
+def run_kernels(args):
+    """The --kernels path: block-shape search, one JSON line, same
+    acceptance discipline as the step search."""
+    from mxnet_tpu import autotune, telemetry
+
+    kernels = tuple(args.kernel) if args.kernel else None
+    print(f"# autotune --kernels: {kernels or autotune.KERNELS} "
+          f"cache={autotune.winners_path()}", file=sys.stderr, flush=True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always", telemetry.RecompileWarning)
+        result = autotune.search_kernels(
+            kernels=kernels, force=args.force,
+            trial_seconds=args.trial_seconds)
+        post_warnings = [w for w in caught
+                         if issubclass(w.category, telemetry.RecompileWarning)]
+
+    summary = result.summary()
+    summary["post_search_recompile_warnings"] = len(post_warnings)
+    line = json.dumps(summary)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line, flush=True)
+
+    failures = []
+    if args.expect_reused:
+        if result.n_trials or result.cache_hits != len(result.searches):
+            failures.append("expected every bucket answered from the "
+                            "cache with zero trials")
+    if args.check:
+        if post_warnings:
+            failures.append(
+                f"{len(post_warnings)} RecompileWarning(s) escaped the "
+                "trial scope")
+        missing = [s["key"] for s in result.searches if not s.get("blocks")]
+        if missing:
+            failures.append(f"no winner for {missing}")
+        if args.inject_oom_at:
+            oom = sum(1 for t in result.trials if t["status"] == "oom")
+            if oom < 1:
+                failures.append("injected OOM trial not recorded")
+    for f in failures:
+        print(f"ASSERT FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="mlp", choices=["mlp", "tiny_gpt"])
@@ -102,6 +157,12 @@ def main(argv=None):
                    help="fail unless the winner came from the cache with "
                         "zero trials (second-run check)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kernels", action="store_true",
+                   help="run the kernel-level block-shape search instead "
+                        "of the step-config search")
+    p.add_argument("--kernel", nargs="+", default=None, metavar="NAME",
+                   help="with --kernels: restrict to these kernels "
+                        "(default: all)")
     args = p.parse_args(argv)
 
     import mxnet_tpu as mx
@@ -115,6 +176,9 @@ def main(argv=None):
     telemetry.enable()
     if args.inject_oom_at:
         fault.configure(f"autotune.trial_oom:at={args.inject_oom_at},times=1")
+
+    if args.kernels:
+        return run_kernels(args)
 
     net, feature_shape, n_classes = build_model(args.model, args.seed)
     sample = make_batch(args.model, feature_shape, n_classes,
