@@ -141,7 +141,8 @@ def _row(name, sec_per_step, items_per_step, model_flops_per_step,
         row["xla_bytes_accessed_per_step"] = xla_bytes
     if xla_flops and xla_bytes:
         from mxnet_tpu import insight as _insight
-        row["bound"] = _insight.roofline_verdict(xla_flops, xla_bytes)
+        row["bound"] = _insight.roofline_verdict(xla_flops, xla_bytes,
+                                                 step_seconds=sec_per_step)
     if peak:
         eff = model_flops_per_step / sec_per_step
         row["effective_tflops"] = round(eff / 1e12, 2)

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""mx.stream input-plane benchmark + host-loss drill (CI `stream` stage).
+
+Two contracts from docs/FAULT_TOLERANCE.md "Streaming data plane":
+
+1. THE STREAM KEEPS THE DEVICE FED: a streaming DataLoader (thread
+   workers decoding checksummed shard records) feeding a jitted step
+   through ``DevicePrefetcher`` must keep the measured
+   ``pipeline.input_stall_seconds`` total well below the serial
+   producer wait (all decodes back to back) — the overlap actually
+   happened.  The measured epoch must trigger zero RecompileWarnings
+   and leave the ``sync_guard`` per-site counts unchanged: streaming
+   adds no hidden host syncs and no shape churn.
+
+2. HOST LOSS IS EXACTLY-ONCE: the 2-process drill
+   (tests/stream_worker.py) kills one host mid-epoch; the survivor
+   adopts its unfinished shards from the last published cursor.  The
+   union of the durable served-record logs must be the epoch with
+   multiplicity 1.
+
+The ``STREAM_DRILL_OK`` sentinel (what ci/run.sh greps) prints only
+when EVERY gate above holds, so a failed stall/recompile/sync gate
+fails the stage even though the pipeline exit status is grep's.
+
+Usage: python benchmark/stream_input.py [--stall-ratio 0.5] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import warnings
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DECODE_MS = 1.0      # per-record decode cost (sleep = GIL released)
+HOST_MS = 2.0        # host-side per-step work the prefetch overlaps
+BATCH = 8
+N_RECORDS = 256      # 32 full batches
+N_SHARDS = 8
+WORKERS = 4
+
+
+def _build_shards(d, n=N_RECORDS, shards=N_SHARDS, dim=64):
+    import numpy as onp
+    from mxnet_tpu import stream
+    rs = onp.random.RandomState(0)
+    with stream.ShardWriter(d, shards) as w:
+        for _ in range(n):
+            w.append(stream.pack_sample(
+                rs.standard_normal((dim, dim)).astype(onp.float32)))
+    return d
+
+
+def _step_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        y = jnp.tanh(x @ x.transpose(0, 2, 1))
+        return jnp.sum(y) / x.size
+    return step
+
+
+def _decode(payload):
+    from mxnet_tpu import stream
+    time.sleep(DECODE_MS / 1000.0)     # the IO/decode cost under test
+    return stream.unpack_sample(payload)
+
+
+def _run_epoch(data, step):
+    """One streamed epoch: thread workers decode, DevicePrefetcher
+    overlaps H2D with compute.  Returns (stall_total_s, n_steps)."""
+    from mxnet_tpu import pipeline, stream, telemetry
+    from mxnet_tpu.gluon.data import DataLoader
+    ds = stream.StreamDataset(data, transform=_decode)
+    samp = stream.StreamSampler(data, batch_size=BATCH, seed=3)
+    loader = DataLoader(ds, batch_sampler=samp, num_workers=WORKERS,
+                        thread_pool=True, prefetch=2 * WORKERS)
+    acc = []
+    n = 0
+    pf = pipeline.DevicePrefetcher(iter(loader), depth=2)
+    for x in pf:
+        acc.append(step(getattr(x, "_data", x)))
+        n += 1
+        time.sleep(HOST_MS / 1000.0)   # host-side step overhead
+    for a in acc:
+        a.block_until_ready()          # syncs paid once, at the end
+    snap = telemetry.snapshot()
+    stall = snap["histograms"].get("pipeline.input_stall_seconds", {})
+    return stall.get("sum", float("inf")), n
+
+
+def _host_loss_drill():
+    """The 2-process kill-one-host drill; returns (ok, detail)."""
+    from mxnet_tpu import stream
+    import numpy as onp
+    root = tempfile.mkdtemp(prefix="stream_drill_")
+    data = os.path.join(root, "data")
+    n = 96
+    with stream.ShardWriter(data, 8) as w:
+        for g in range(n):
+            w.append(stream.pack_sample(
+                onp.full((2,), g, dtype=onp.float32), onp.int32(0)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    worker = os.path.join(REPO, "tests", "stream_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, root, str(rank), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    if procs[0].returncode != 0 or "STREAM_DRILL_DONE" not in outs[0]:
+        return False, f"survivor failed: {outs[0]!r}"
+    served = []
+    for path in glob.glob(os.path.join(root, "served-*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                served.extend(json.loads(line))
+    if sorted(served) != list(range(n)):
+        return False, (f"multiset broke: {len(served)} served, "
+                       f"{len(set(served))} unique of {n}")
+    return True, f"{n} records exactly once across host loss"
+
+
+def run(stall_ratio=0.5, json_out=False):
+    from mxnet_tpu import pipeline, telemetry
+
+    with tempfile.TemporaryDirectory() as d:
+        data = _build_shards(d)
+        step = _step_fn()
+        telemetry.enable()
+        telemetry.reset()
+        _run_epoch(data, step)                   # warmup: compile + pools
+        telemetry.reset()
+        sites_before = dict(pipeline.sync_site_counts())
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            stall_total, steps = _run_epoch(data, step)
+        sites_after = dict(pipeline.sync_site_counts())
+        telemetry.disable()
+    recompiles = [w for w in caught
+                  if issubclass(w.category, telemetry.RecompileWarning)]
+    serial_wait = N_RECORDS * DECODE_MS / 1000.0
+    sync_same = sites_before == sites_after
+    stall_ok = stall_total < stall_ratio * serial_wait
+    drill_ok, drill_detail = _host_loss_drill()
+
+    result = {
+        "steps": steps,
+        "input_stall_s": round(stall_total, 4),
+        "serial_producer_wait_s": round(serial_wait, 4),
+        "stall_ratio_limit": stall_ratio,
+        "recompile_warnings": len(recompiles),
+        "sync_sites_unchanged": sync_same,
+        "host_loss_drill": drill_detail,
+        "ok": bool(stall_ok and not recompiles and sync_same and drill_ok),
+    }
+    if json_out:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"streamed {steps} batches; input stall "
+              f"{stall_total * 1000:.1f} ms (serial producer wait "
+              f"{serial_wait * 1000:.0f} ms, limit "
+              f"{stall_ratio:.0%} of it)")
+        print(f"recompile warnings: {len(recompiles)}   "
+              f"sync_guard sites unchanged: {sync_same}")
+        print(f"host-loss drill: {drill_detail}")
+        print("PASS" if result["ok"] else "FAIL")
+    if result["ok"]:
+        print("STREAM_DRILL_OK")
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stall-ratio", type=float, default=0.5,
+                    help="max input stall as a fraction of the serial "
+                         "producer wait")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    result = run(stall_ratio=args.stall_ratio, json_out=args.json)
+    sys.exit(0 if result["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

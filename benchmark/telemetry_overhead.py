@@ -94,6 +94,20 @@ def _resolve_loop(a, n, probes_per_op, resolve_blocks):
     return time.perf_counter() - t0
 
 
+def _stream_loop(a, n, probes_per_op, note_served):
+    """Same shape, probing mx.stream's per-record hot-path hook (the
+    exact function its read path calls once per served record)."""
+    t0 = time.perf_counter()
+    out = a
+    probe = range(probes_per_op)
+    for _ in range(n):
+        out = out + a
+        for _ in probe:
+            note_served(1)  # gates on telemetry._active internally
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
 def _trace_enabled_loop(a, n, trace):
     """Eager loop with one real recorded span per op (tracing ON)."""
     t0 = time.perf_counter()
@@ -109,6 +123,7 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     import mxnet_tpu as mx
     from mxnet_tpu import blackbox, telemetry, trace
     from mxnet_tpu.autotune.kernels import resolve_blocks, _TUNED
+    from mxnet_tpu.stream import _note_served
 
     telemetry.disable()
     trace.disable()
@@ -120,13 +135,14 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     _loop(a, 200, 0, telemetry)          # warmup: compile + caches hot
     resolve_blocks("flash_attention", (256, 256, 64))  # static table fill
     base_s, probed_s, tprobed_s, bprobed_s = [], [], [], []
-    rprobed_s, ton_s = [], []
+    rprobed_s, sprobed_s, ton_s = [], [], []
     for _ in range(repeats):
         base_s.append(_loop(a, n, 0, telemetry))
         probed_s.append(_loop(a, n, probes_per_op, telemetry))
         tprobed_s.append(_trace_loop(a, n, probes_per_op, trace))
         bprobed_s.append(_blackbox_loop(a, n, probes_per_op, blackbox))
         rprobed_s.append(_resolve_loop(a, n, probes_per_op, resolve_blocks))
+        sprobed_s.append(_stream_loop(a, n, probes_per_op, _note_served))
         trace.enable(buffer=max(1024, n))
         ton_s.append(_trace_enabled_loop(a, n, trace))
         trace.disable()
@@ -136,21 +152,25 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     tprobed = statistics.median(tprobed_s)
     bprobed = statistics.median(bprobed_s)
     rprobed = statistics.median(rprobed_s)
+    sprobed = statistics.median(sprobed_s)
     ton = statistics.median(ton_s)
     # cost of the K probes, scaled to the ~1 probe a real dispatch adds
     per_probe = max(0.0, probed - base) / probes_per_op
     per_trace_probe = max(0.0, tprobed - base) / probes_per_op
     per_blackbox_probe = max(0.0, bprobed - base) / probes_per_op
     per_resolve_probe = max(0.0, rprobed - base) / probes_per_op
+    per_stream_probe = max(0.0, sprobed - base) / probes_per_op
     ratio = per_probe / base
     trace_ratio = per_trace_probe / base
     blackbox_ratio = per_blackbox_probe / base
     resolve_ratio = per_resolve_probe / base
+    stream_ratio = per_stream_probe / base
     return {"ops": n, "probes_per_op": probes_per_op, "repeats": repeats,
             "baseline_s": round(base, 6), "probed_s": round(probed, 6),
             "trace_probed_s": round(tprobed, 6),
             "blackbox_probed_s": round(bprobed, 6),
             "resolve_probed_s": round(rprobed, 6),
+            "stream_probed_s": round(sprobed, 6),
             "trace_enabled_s": round(ton, 6),
             "per_op_probe_overhead_ns": round(per_probe / n * 1e9, 2),
             "per_op_trace_probe_overhead_ns":
@@ -159,14 +179,18 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
                 round(per_blackbox_probe / n * 1e9, 2),
             "per_op_resolve_probe_overhead_ns":
                 round(per_resolve_probe / n * 1e9, 2),
+            "per_op_stream_probe_overhead_ns":
+                round(per_stream_probe / n * 1e9, 2),
             "overhead_ratio": round(ratio, 6),
             "trace_overhead_ratio": round(trace_ratio, 6),
             "blackbox_overhead_ratio": round(blackbox_ratio, 6),
             "resolve_overhead_ratio": round(resolve_ratio, 6),
+            "stream_overhead_ratio": round(stream_ratio, 6),
             "trace_enabled_ratio": round(max(0.0, ton - base) / base, 6),
             "budget": budget,
             "ok": ratio < budget and trace_ratio < budget
-                  and blackbox_ratio < budget and resolve_ratio < budget}
+                  and blackbox_ratio < budget and resolve_ratio < budget
+                  and stream_ratio < budget}
 
 
 def main(argv=None):
@@ -193,6 +217,8 @@ def main(argv=None):
               f"{r['blackbox_probed_s'] * 1e3:9.2f} ms")
         print(f"with {r['probes_per_op']}x untuned resolve_blocks/op "
               f"{r['resolve_probed_s'] * 1e3:9.2f} ms")
+        print(f"with {r['probes_per_op']}x disabled stream probes/op "
+              f"{r['stream_probed_s'] * 1e3:9.2f} ms")
         print(f"with tracing ENABLED (1 span/op) "
               f"{r['trace_enabled_s'] * 1e3:9.2f} ms "
               f"(+{r['trace_enabled_ratio'] * 100:.2f}%, informational)")
@@ -207,12 +233,15 @@ def main(argv=None):
         print(f"resolve_blocks ratio     "
               f"{r['resolve_overhead_ratio'] * 100:9.4f} % "
               f"(budget {r['budget'] * 100:g}%)")
+        print(f"stream overhead ratio    "
+              f"{r['stream_overhead_ratio'] * 100:9.4f} % "
+              f"(budget {r['budget'] * 100:g}%)")
     if not r["ok"]:
         print("FAIL: a disabled observability fast path exceeds the "
               "overhead budget", file=sys.stderr)
         return 1
     print("OK: disabled telemetry + trace + blackbox + untuned "
-          "resolve_blocks fast paths within budget")
+          "resolve_blocks + stream fast paths within budget")
     return 0
 
 

@@ -75,6 +75,14 @@
 #                first-anomaly host; the disabled-fast-path budget
 #                (<2%) is re-enforced with the recorder compiled in
 #                (docs/OBSERVABILITY.md "Postmortem forensics")
+#   stream     - deterministic sharded streaming data plane suite:
+#                exactly-once epoch oracle across host loss + elastic
+#                dp resizes, bitwise cursor resume, corrupt-record
+#                drills; plus the input-plane benchmark (stall below
+#                the serial producer wait, zero recompiles, sync_guard
+#                counts unchanged) and the 2-process kill-one-host
+#                drill (STREAM_DRILL_OK) (docs/FAULT_TOLERANCE.md
+#                "Streaming data plane")
 #   lint       - framework-aware static analysis (tools/mxlint.py):
 #                trace-safety, donated-buffer, lock-order and registry
 #                drift rules over the whole tree, gated on ZERO new
@@ -88,7 +96,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|insight|lint|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|insight|blackbox|stream|lint|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -512,6 +520,14 @@ PY
     JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
 }
 
+stream() {
+    echo "== stream: deterministic sharded streaming suite (docs/FAULT_TOLERANCE.md \"Streaming data plane\") =="
+    python -m pytest tests/test_stream.py -q
+    echo "== stream: input-plane benchmark + 2-process host-loss drill =="
+    JAX_PLATFORMS=cpu python benchmark/stream_input.py | tee /dev/stderr \
+        | grep -q "STREAM_DRILL_OK"
+}
+
 lint() {
     echo "== lint: static-analysis suite (docs/STATIC_ANALYSIS.md) =="
     python -m pytest tests/test_analyze.py -q
@@ -558,9 +574,10 @@ case "$stage" in
     trace) trace ;;
     insight) insight ;;
     blackbox) blackbox ;;
+    stream) stream ;;
     lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; blackbox; lint ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; blackbox; stream; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

@@ -68,6 +68,7 @@ from . import image
 from . import contrib
 from . import serialization
 from . import resilience
+from . import stream
 from . import fleet
 from . import serve
 from . import autotune

@@ -375,6 +375,25 @@ declare("insight.snapshot_interval", float, 5.0,
         "Seconds between atomic insight-<rank>.json fleet snapshots "
         "published next to the heartbeat leases (riding the "
         "HealthPlane.beat cadence, so no extra thread).")
+declare("insight.input_bound_ratio", float, 0.5,
+        "MXNET_INSIGHT_INPUT_BOUND_RATIO",
+        "Fraction of the measured step time the pipeline.input_stall_"
+        "seconds p50 must exceed before the roofline verdict flips to "
+        "'input' — the data plane, not the math, is the bottleneck "
+        "(surfaced on /insight and in bench rows).")
+declare("stream.on_corrupt", str, "raise", "MXNET_STREAM_ON_CORRUPT",
+        "Checksum-failure policy for mx.stream record reads: 'raise' "
+        "escalates a structured CorruptRecord (carried into blackbox "
+        "postmortem bundles), 'skip' drops the record and counts it in "
+        "stream.records_skipped_total.")
+declare("stream.open_retries", int, 2, "MXNET_STREAM_OPEN_RETRIES",
+        "Shard-open attempts retried (with stream.open_backoff * attempt "
+        "sleeps) before mx.stream escalates a WorkerLost-style "
+        "ShardUnreadable; the bounded budget is what guarantees "
+        "escalation instead of a hang.")
+declare("stream.open_backoff", float, 0.05, "MXNET_STREAM_OPEN_BACKOFF",
+        "Base backoff (seconds) between shard-open retries; attempt k "
+        "sleeps k * backoff.")
 declare("insight.straggler_ratio", float, 1.5,
         "MXNET_INSIGHT_STRAGGLER_RATIO",
         "A host whose step-time EWMA (from its fleet snapshot) exceeds "

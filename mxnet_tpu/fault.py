@@ -96,6 +96,19 @@ POINTS = {
         "checksum landed): verify_checksum rejects it, latest_bundle "
         "and tools/postmortem.py skip it, and the fleet merge proceeds "
         "on the surviving bundles",
+    "stream.torn_record":
+        "one streamed record's payload is corrupted in flight (probed "
+        "per record read, before checksum verification): the per-record "
+        "crc32 rejects it and stream.on_corrupt picks the path — 'skip' "
+        "drops it with stream.records_skipped_total, 'raise' escalates "
+        "a structured CorruptRecord the blackbox recorder carries into "
+        "the postmortem bundle",
+    "stream.shard_unreadable":
+        "a shard archive cannot be opened (probed once per open "
+        "attempt): bounded retry-with-backoff (stream.open_retries / "
+        "stream.open_backoff) counts stream.open_retries_total, and "
+        "exhausting the budget escalates a WorkerLost-style "
+        "ShardUnreadable — a structured failure, never a hang",
     "insight.drift":
         "one observed step-time sample is stretched 3x (probed at "
         "every insight drift-feed sample): the EWMA+MAD detector must "

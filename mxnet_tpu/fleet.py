@@ -411,7 +411,7 @@ class FleetSupervisor:
     """
 
     def __init__(self, step, state, n_hosts=1, host_index=0, min_dp=None,
-                 checkpoint_every=1, health=None):
+                 checkpoint_every=1, health=None, stream=None):
         if step.mesh_config is None:
             raise MXNetError(
                 "FleetSupervisor needs a ShardedTrainStep built from a "
@@ -432,6 +432,10 @@ class FleetSupervisor:
                        else _config.get("fleet.min_dp"))
         self.checkpoint_every = max(1, int(checkpoint_every))
         self.health = health
+        #: streaming data plane (a mx.stream.StreamSampler or a
+        #: DataLoader wrapping one): lose_host additionally reassigns
+        #: the dead host's unfinished shards to the survivors
+        self.stream = stream
         self._lost: set[int] = set()
         #: host -> path of the dead host's latest valid postmortem
         #: bundle (attached to the fleet.degrade decision)
@@ -470,6 +474,21 @@ class FleetSupervisor:
             if bundle:
                 self.postmortems[int(host)] = bundle
         self._replan()
+        # data plane follows the compute plane: the dead host's
+        # unfinished shards move to the survivors exactly once, resumed
+        # from its last *checkpointed* cursor (anything it served past
+        # that checkpoint was never durable — those steps rolled back
+        # with the bundle, so re-serving keeps the epoch multiset exact)
+        if self.stream is not None:
+            sdir = ((self.health.lease_dir if self.health is not None
+                     else "") or _config.get("fleet.lease_dir"))
+            try:
+                self.stream.take_over_host(
+                    host, survivors=self.alive_hosts(),
+                    cursor_dir=sdir or None)
+            except OSError:
+                pass    # shared dir unreadable: the shards stay lost
+                        # until a retried lose_host or manual reassign
 
     def restore_hosts(self, *hosts):
         """Mark lost hosts as rejoined (all of them by default).  The
@@ -584,4 +603,13 @@ class FleetSupervisor:
             self.state.step = s
             if s % self.checkpoint_every == 0 and self.state.path:
                 self.state.save()
+                if self.stream is not None:
+                    # the cursor travels inside the bundle when the
+                    # stream is the TrainState loader; the shared-dir
+                    # copy (what survivors roll forward) refreshes at
+                    # the same boundary either way
+                    try:
+                        self.stream.publish_cursor()
+                    except OSError:
+                        pass
         return losses

@@ -196,7 +196,10 @@ def _mp_worker_task(indices, fault_step=0, grants=None, trace_ctx=None):
     t0u = _trace.clock_us() if trace_ctx is not None else 0
     ds, bf = _worker_state["dataset"], _worker_state["batchify"]
     grants = list(grants) if grants is not None else None
-    spec = _to_shm(bf([ds[i] for i in indices]), grants)
+    fetch = getattr(ds, "sample_batch", None)
+    samples = (fetch(indices) if fetch is not None
+               else [ds[i] for i in indices])
+    spec = _to_shm(bf(samples), grants)
     spans = []
     if trace_ctx is not None:
         spans.append(_trace.make_span(
@@ -427,7 +430,12 @@ class DataLoader:
         return self._worker_mode_cache
 
     def _make_batch(self, indices):
-        samples = [self._dataset[i] for i in indices]
+        # streaming sources (mx.stream.StreamDataset) fetch whole
+        # batches: the corrupt-record skip policy must be able to shrink
+        # a batch, which per-item __getitem__ cannot express
+        fetch = getattr(self._dataset, "sample_batch", None)
+        samples = (fetch(indices) if fetch is not None
+                   else [self._dataset[i] for i in indices])
         return self._batchify(False)(samples)
 
     def _get_proc_pool(self):
@@ -516,6 +524,23 @@ class DataLoader:
                 f"batch_sampler {type(self._batch_sampler).__name__} has no "
                 "load_state_dict; cannot resume this DataLoader")
         self._batch_sampler.load_state_dict(state)
+
+    def publish_cursor(self, **kwargs):
+        """Streaming passthrough: publish the sampler's cursor at the
+        CONSUMED position (``self._served``) to the shared fleet dir —
+        what a surviving host resumes a dead peer's shards from.  No-op
+        for non-streaming samplers."""
+        publish = getattr(self._batch_sampler, "publish_cursor", None)
+        if publish is None:
+            return None
+        kwargs.setdefault("cursor", self._served)
+        return publish(**kwargs)
+
+    def take_over_host(self, dead_rank, **kwargs):
+        """Streaming passthrough: adopt this host's share of a dead
+        peer's unfinished shards (see StreamSampler.take_over_host)."""
+        take = getattr(self._batch_sampler, "take_over_host", None)
+        return take(dead_rank, **kwargs) if take is not None else 0
 
     def _pump(self, pool, task, unwrap, batches, dispose=None):
         pending = []

@@ -44,7 +44,8 @@ from . import trace as _trace
 __all__ = [
     "enable", "disable", "configure", "active", "reset",
     "capture_cost", "capture_jit", "register_executable", "note_step",
-    "roofline_verdict", "attribution", "last_summary", "healthz",
+    "roofline_verdict", "input_stall_p50", "attribution", "last_summary",
+    "healthz",
     "drift_events", "DriftDetector", "on_drift", "remove_drift_hook",
     "write_snapshot", "maybe_snapshot", "read_snapshots",
     "merge_snapshots", "fleet_exposition", "relative_slowness",
@@ -198,11 +199,35 @@ def _peaks(kind=None):
     return _peak_cache
 
 
+def input_stall_p50():
+    """Median recorded ``pipeline.input_stall_seconds`` (the device-
+    prefetch consumer's wait for the host producer), or None without
+    samples — the signal that separates a slow step from a starved
+    one."""
+    q = _telemetry.quantiles("pipeline.input_stall_seconds")
+    return q.get("p50") if q else None
+
+
 def roofline_verdict(flops, bytes_accessed, peak_flops=None,
-                     peak_bytes_per_s=None):
-    """``'compute'`` | ``'memory'`` | None: arithmetic intensity
-    (flops/byte) against the machine balance (peak FLOP/s over peak
-    bytes/s) — the classic roofline ridge-point test."""
+                     peak_bytes_per_s=None, step_seconds=None):
+    """``'input'`` | ``'compute'`` | ``'memory'`` | None.
+
+    With ``step_seconds`` (a measured wall-clock step time), input
+    starvation is tested first: when the recorded
+    ``pipeline.input_stall_seconds`` p50 exceeds
+    ``insight.input_bound_ratio`` × the step time the verdict is
+    ``'input'`` regardless of arithmetic intensity — starvation
+    masquerades as compute cost (arxiv 2008.01040), so the data plane
+    must be ruled out before the roofline is read.  Otherwise:
+    arithmetic intensity (flops/byte) against the machine balance (peak
+    FLOP/s over peak bytes/s) — the classic roofline ridge-point
+    test."""
+    if step_seconds:
+        stall = input_stall_p50()
+        if stall is not None and stall > (
+                float(_config.get("insight.input_bound_ratio"))
+                * float(step_seconds)):
+            return "input"
     if not flops or not bytes_accessed:
         return None
     if peak_flops is None or peak_bytes_per_s is None:
@@ -527,9 +552,22 @@ def attribution():
         exes = {n: dict(e) for n, e in _exes.items()}
         drift = {s: d.state() for s, d in _detectors.items()}
         events = list(_drift_ring)
+    # re-read each verdict against the MEASURED step time: a registry
+    # entry's static compute/memory call flips to 'input' when the
+    # recorded input-stall p50 dominates the step it feeds
+    stall = input_stall_p50()
+    if stall is not None:
+        for e in exes.values():
+            if e.get("last_seconds"):
+                v = roofline_verdict(e.get("flops"),
+                                     e.get("bytes_accessed"),
+                                     step_seconds=e["last_seconds"])
+                if v == "input":
+                    e["bound"] = "input"
     return {"device_kind": _device_kind(),
             "peak_flops_per_s": pf, "peak_bytes_per_s": pb,
             "machine_balance_flops_per_byte": pf / pb,
+            "input_stall_p50_s": stall,
             "executables": exes, "drift": drift, "drift_events": events}
 
 

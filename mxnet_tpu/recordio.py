@@ -22,6 +22,32 @@ _CFLAG_BITS = 29
 _LEN_MASK = (1 << _CFLAG_BITS) - 1
 
 
+class RecordIOCorrupt(MXNetError):
+    """Structured corruption report from a record stream.
+
+    ``kind`` distinguishes the two failure classes a reader meets:
+
+    - ``"torn_tail"`` — the file ends mid-record (a writer died between
+      the header and the payload, or the payload itself was truncated).
+      Everything before ``offset`` is intact: the file is *resumable* —
+      re-open for append at ``offset``, or stop reading there.
+    - ``"bad_magic"`` — framing lost mid-file (bit rot, a seek into the
+      middle of a payload). Not resumable; the bytes from ``offset`` on
+      cannot be trusted.
+
+    ``offset`` is always the position of the last good record boundary.
+    """
+
+    def __init__(self, uri, offset, kind, detail):
+        self.uri = uri
+        self.offset = int(offset)
+        self.kind = kind
+        self.resumable = kind == "torn_tail"
+        super().__init__(
+            f"recordio corruption in {uri!r} at offset {offset}: "
+            f"{detail} [{kind}]")
+
+
 class MXRecordIO:
     """Sequential record file reader/writer (reference: recordio.py:34)."""
 
@@ -77,16 +103,30 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        start = self.record.tell()
         header = self.record.read(8)
+        if not header:
+            return None          # clean EOF on a record boundary
         if len(header) < 8:
-            return None
+            raise RecordIOCorrupt(
+                self.uri, start, "torn_tail",
+                f"{len(header)}-byte header fragment at EOF")
         magic, lrec = struct.unpack("<II", header)
         if magic != _MAGIC:
-            raise MXNetError("invalid record magic")
+            raise RecordIOCorrupt(
+                self.uri, start, "bad_magic",
+                f"invalid record magic 0x{magic:08x}")
         length = lrec & _LEN_MASK
         buf = self.record.read(length)
+        if len(buf) < length:
+            raise RecordIOCorrupt(
+                self.uri, start, "torn_tail",
+                f"payload truncated: {len(buf)} of {length} bytes")
         pad = (4 - (length % 4)) % 4
         if pad:
+            # a short pad is still a complete record: the torn bytes are
+            # alignment filler, so tolerate it (next read() reports EOF
+            # or the tear, whichever the tail holds)
             self.record.read(pad)
         return buf
 

@@ -228,6 +228,18 @@ class TrainState:
         _serialization.write_checksum(path)
         _event("bundle_save")
         self._gc(path)
+        # streaming loaders additionally publish their cursor to the
+        # shared fleet dir at every checkpoint: the bundle owns the
+        # cursor for *this* host's restarts, the published copy is what
+        # a SURVIVOR rolls forward when this host dies (mx.stream
+        # take_over_host). Best-effort: shared storage hiccups must not
+        # fail the checkpoint that just landed.
+        publish = getattr(self.loader, "publish_cursor", None)
+        if publish is not None:
+            try:
+                publish()
+            except OSError:
+                pass
         from . import blackbox as _blackbox
         if _blackbox._active:
             # the postmortem names the exact checkpoint generation a

@@ -1,0 +1,124 @@
+"""Subprocess body for the mx.stream host-loss exactly-once drill.
+
+Usage: python tests/stream_worker.py <root> <rank> <nprocs>
+
+``<root>/data`` holds the shard set; ``<root>`` doubles as the lease +
+cursor directory.  The highest rank is the victim: it serves a few
+batches, making some of them durable (publish_cursor + an fsync'd
+append to its served-record log — the drill's stand-in for "those steps
+landed in a checkpoint"), then makes MORE progress without
+checkpointing and exits hard: a crash, its lease left to rot and its
+cursor naming only the durable prefix.  Rank 0 is the survivor: it
+serves its own share to completion (checkpointing as it goes), watches
+the health plane until the victim's lease expires into the structured
+WorkerLost escalation, adopts the victim's unfinished shards from the
+published cursor and serves those too.  The parent test asserts the
+union of the served-record logs is the epoch, every record exactly once
+— the victim's un-checkpointed batches were never durable, so the
+survivor re-serving them is the correct multiplicity, not a duplicate.
+"""
+import json
+import os
+import sys
+import time
+
+import mxnet_tpu as mx
+from mxnet_tpu import stream
+from mxnet_tpu.fleet import HealthPlane
+
+BATCH = 4
+CKPT_EVERY = 2       # batches per durable checkpoint
+INTERVAL = 0.05
+TIMEOUT = 0.6
+SEED = 7
+
+
+def _log_path(root, rank):
+    return os.path.join(root, f"served-{rank}.jsonl")
+
+
+def _checkpoint(samp, root, rank, buf, served):
+    """One durable checkpoint: cursor first, then the served-id log —
+    both land or the drill's oracle catches the difference."""
+    samp.publish_cursor(cursor=served)
+    with open(_log_path(root, rank), "a") as f:
+        f.write(json.dumps(buf) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    buf.clear()
+
+
+def main(root, rank, nprocs):
+    samp = stream.StreamSampler(os.path.join(root, "data"),
+                                batch_size=BATCH, seed=SEED,
+                                dp=nprocs, rank=rank, cursor_dir=root)
+    hp = HealthPlane(rank=rank, nprocs=nprocs, lease_dir=root,
+                     interval=INTERVAL, timeout=TIMEOUT)
+    hp.beat(step=0)
+    buf, served = [], 0
+
+    if rank == nprocs - 1 and nprocs > 1:
+        # victim: 2 durable checkpoints, 2 more non-durable batches, crash
+        crash_at = 2 * CKPT_EVERY + 2
+        for batch in samp:
+            buf.extend(batch)
+            served += 1
+            hp.beat(step=served)
+            if served % CKPT_EVERY == 0 and served < crash_at:
+                _checkpoint(samp, root, rank, buf, served)
+            if served == crash_at:
+                print(f"STREAM_VICTIM_DOWN {rank} served={served}",
+                      flush=True)
+                os._exit(0)   # crash: lease rots, tail batches not durable
+            time.sleep(INTERVAL)
+        # the test sized the dataset so the share outlives the crash point
+        print(f"STREAM_VICTIM_UNDERFED {rank} served={served}", flush=True)
+        return 1
+
+    # survivor: own share first, checkpointing every CKPT_EVERY batches
+    for batch in samp:
+        buf.extend(batch)
+        served += 1
+        hp.beat(step=served)
+        if served % CKPT_EVERY == 0:
+            _checkpoint(samp, root, rank, buf, served)
+    if buf:
+        _checkpoint(samp, root, rank, buf, served)
+
+    deadline = time.monotonic() + 30.0
+    while len(hp.peers()) < nprocs - 1:     # wait for every peer's lease
+        if time.monotonic() > deadline:
+            print("STREAM_TIMEOUT waiting for peers", flush=True)
+            return 1
+        time.sleep(INTERVAL)
+    dead = None
+    while dead is None:
+        if time.monotonic() > deadline:
+            print("STREAM_TIMEOUT waiting for lease expiry", flush=True)
+            return 1
+        hp.beat(step=served)
+        try:
+            hp.check_peers()
+        except mx.resilience.WorkerLost as e:
+            dead = int(str(e.key).split("-", 1)[1])
+        time.sleep(INTERVAL)
+
+    adopted = samp.take_over_host(dead, survivors=[rank])
+    # this epoch's generator already finished — re-enter it through the
+    # cursor: the resume skips exactly the records already served, so
+    # only the adopted work remains
+    samp.load_state_dict(samp.state_dict(cursor=served))
+    for batch in samp:
+        buf.extend(batch)
+        served += 1
+        if served % CKPT_EVERY == 0:
+            _checkpoint(samp, root, rank, buf, served)
+    if buf:
+        _checkpoint(samp, root, rank, buf, served)
+    print(f"STREAM_DRILL_DONE rank={rank} adopted={adopted} "
+          f"served={served}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3])))

@@ -1,0 +1,538 @@
+"""mx.stream — deterministic sharded streaming that survives host loss
+and elastic dp resizes.
+
+Oracles: the exactly-once epoch multiset (union of served record ids
+across hosts, restarts and take-overs == the epoch's ids, multiplicity
+1); bitwise batch parity between an uninterrupted epoch and a
+cursor-resumed one; a real 2-process host-loss drill via subprocess
+(tests/stream_worker.py) where the victim's un-checkpointed progress is
+legitimately re-served by the survivor.
+
+Chaos spec literals exercised here: "stream.torn_record:prob=1,times=3",
+"stream.torn_record:prob=1,times=1", "stream.shard_unreadable:prob=1,times=3",
+"stream.shard_unreadable:prob=1,times=1".
+"""
+import glob
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import blackbox, config, insight, recordio, stream, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet import FleetSupervisor
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.parallel.mesh import MeshConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_RECORDS = 53
+N_SHARDS = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_stream_state():
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    yield
+    mx.fault.clear()
+    mx.fault.reset_stats()
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def shards(tmp_path):
+    d = str(tmp_path / "data")
+    with stream.ShardWriter(d, N_SHARDS) as w:
+        for g in range(N_RECORDS):
+            w.append(stream.pack_sample(
+                onp.full((3,), g, dtype=onp.float32), onp.int32(g % 5)))
+    return d
+
+
+def _ids(batches):
+    return [g for b in batches for g in b]
+
+
+# -- shard format + manifest -------------------------------------------------
+
+def test_shard_writer_round_trips_through_manifest(shards):
+    m = stream.ShardManifest.load(shards)
+    assert m.num_shards == N_SHARDS and m.total_records == N_RECORDS
+    # round-robin: record g lives in shard g % num_shards
+    assert [m.records(s) for s in range(N_SHARDS)] == [14, 13, 13, 13]
+    ds = stream.StreamDataset(m, transform=stream.unpack_sample)
+    assert len(ds) == N_RECORDS
+    for g in (0, 1, N_SHARDS, N_RECORDS - 1):
+        x, y = ds[g]
+        assert x[0] == float(g) and int(y) == g % 5
+    report = stream.validate_manifest(shards)
+    assert report["ok"] and report["records"] == N_RECORDS
+
+
+def test_record_envelope_checksum_catches_a_flipped_byte():
+    buf = stream.encode_record(7, b"payload bytes")
+    assert stream.decode_record(buf) == (7, b"payload bytes")
+    flipped = buf[:-3] + bytes([buf[-3] ^ 0xFF]) + buf[-2:]
+    with pytest.raises(stream.CorruptRecord) as ei:
+        stream.decode_record(flipped, shard="s0")
+    assert ei.value.kind == "checksum" and ei.value.shard == "s0"
+
+
+def test_validate_manifest_reports_on_disk_corruption(shards):
+    rec = stream.ShardManifest.load(shards).rec_path(1)
+    with open(rec, "r+b") as f:
+        f.seek(os.path.getsize(rec) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    report = stream.validate_manifest(shards)
+    assert not report["ok"] and report["errors"]
+    assert "shard-00001" in report["errors"][0]
+
+
+# -- recordio structured truncation (the satellite) --------------------------
+
+def test_recordio_torn_tail_is_structured_and_resumable(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"A" * 100)
+    w.close()
+    with open(path, "r+b") as f:
+        f.truncate(50)                     # mid-payload: a torn tail
+    r = recordio.MXRecordIO(path, "r")
+    with pytest.raises(recordio.RecordIOCorrupt) as ei:
+        r.read()
+    assert ei.value.kind == "torn_tail" and ei.value.resumable
+    assert ei.value.uri == path and ei.value.offset == 0
+    r.close()
+
+
+def test_recordio_torn_header_and_bad_magic(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"first")
+    w.write(b"second")
+    w.close()
+    first_len = 8 + len(b"first") + (-len(b"first") % 4)
+    with open(path, "r+b") as f:
+        f.truncate(first_len + 4)          # second record: header cut short
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b"first"            # the intact prefix still reads
+    with pytest.raises(recordio.RecordIOCorrupt) as ei:
+        r.read()
+    assert ei.value.kind == "torn_tail" and ei.value.offset == first_len
+    r.close()
+    with open(path, "r+b") as f:           # now stomp the first magic
+        f.write(struct.pack("<I", 0xdeadbeef))
+    r = recordio.MXRecordIO(path, "r")
+    with pytest.raises(recordio.RecordIOCorrupt) as ei:
+        r.read()
+    assert ei.value.kind == "bad_magic" and not ei.value.resumable
+    r.close()
+
+
+# -- epoch plan determinism --------------------------------------------------
+
+def test_epoch_plan_is_deterministic_and_partitions_by_dp(shards):
+    a = stream.EpochPlan(shards, seed=3, epoch=1)
+    b = stream.EpochPlan(shards, seed=3, epoch=1)
+    assert list(a.shard_order) == list(b.shard_order)
+    assert [list(a.shard_records(s)) for s in range(N_SHARDS)] == \
+        [list(b.shard_records(s)) for s in range(N_SHARDS)]
+    for dp in (1, 2, 3):
+        parts = [a.host_shards(r, dp) for r in range(dp)]
+        flat = [s for p in parts for s in p]
+        assert sorted(flat) == list(range(N_SHARDS))   # disjoint + complete
+    # every record id appears exactly once across the shard orders
+    all_gids = [g for s in range(N_SHARDS) for g in a.shard_records(s)]
+    assert sorted(all_gids) == list(range(N_RECORDS))
+
+
+def test_epoch_plan_reshuffles_across_epochs_and_seeds(shards):
+    e1 = stream.EpochPlan(shards, seed=3, epoch=1)
+    e2 = stream.EpochPlan(shards, seed=3, epoch=2)
+    s9 = stream.EpochPlan(shards, seed=9, epoch=1)
+    assert list(e1.shard_records(0)) != list(e2.shard_records(0))
+    assert list(e1.shard_records(0)) != list(s9.shard_records(0))
+
+
+# -- the sampler: exactly-once, cursors, elastic resume ----------------------
+
+def test_single_host_epoch_is_exactly_once_and_reproducible(shards):
+    a = list(iter(stream.StreamSampler(shards, batch_size=4, seed=11)))
+    b = list(iter(stream.StreamSampler(shards, batch_size=4, seed=11)))
+    assert a == b
+    assert sorted(_ids(a)) == list(range(N_RECORDS))
+
+
+def test_bitwise_resume_mid_epoch(shards):
+    full = list(iter(stream.StreamSampler(shards, batch_size=4, seed=11)))
+    s = stream.StreamSampler(shards, batch_size=4, seed=11)
+    it = iter(s)
+    head = [next(it) for _ in range(3)]
+    st = s.state_dict(cursor=3)
+    assert st["cursor"] == 3 and st["consumed"] == 12
+    s2 = stream.StreamSampler(shards, batch_size=4, seed=11)
+    s2.load_state_dict(st)
+    assert head + list(iter(s2)) == full
+
+
+def test_len_reflects_pending_resume(shards):
+    s = stream.StreamSampler(shards, batch_size=4, seed=11)
+    total = len(s)
+    assert total == (N_RECORDS + 3) // 4
+    it = iter(s)
+    for _ in range(3):
+        next(it)
+    s2 = stream.StreamSampler(shards, batch_size=4, seed=11)
+    s2.load_state_dict(s.state_dict(cursor=3))
+    assert len(s2) == total - 3
+
+
+def test_load_state_dict_rejects_mismatched_geometry(shards):
+    s = stream.StreamSampler(shards, batch_size=4, seed=11)
+    st = s.state_dict()
+    other_bs = stream.StreamSampler(shards, batch_size=8, seed=11)
+    with pytest.raises(MXNetError, match="batch_size"):
+        other_bs.load_state_dict(st)
+    other_seed = stream.StreamSampler(shards, batch_size=4, seed=12)
+    with pytest.raises(MXNetError, match="seed"):
+        other_seed.load_state_dict(st)
+
+
+def test_dataloader_resume_is_bitwise(shards):
+    def loader():
+        ds = stream.StreamDataset(shards)
+        samp = stream.StreamSampler(shards, batch_size=4, seed=5)
+        return DataLoader(ds, batch_sampler=samp, num_workers=0,
+                          batchify_fn=lambda x: x)
+    full = list(loader())
+    l1 = loader()
+    it = iter(l1)
+    head = [next(it) for _ in range(3)]
+    st = l1.state_dict()
+    assert st["cursor"] == 3               # consumer-side, not prefetch-side
+    l2 = loader()
+    l2.load_state_dict(st)
+    assert head + list(l2) == full
+
+
+def test_dataloader_thread_pool_matches_serial(shards):
+    ds = stream.StreamDataset(shards)
+    serial = list(DataLoader(
+        ds, batch_sampler=stream.StreamSampler(shards, batch_size=4, seed=5),
+        num_workers=0, batchify_fn=lambda x: x))
+    threaded = list(DataLoader(
+        ds, batch_sampler=stream.StreamSampler(shards, batch_size=4, seed=5),
+        num_workers=2, thread_pool=True, batchify_fn=lambda x: x))
+    assert threaded == serial
+
+
+# -- host loss + elastic dp: the exactly-once take-over ----------------------
+
+def test_dp_partition_is_disjoint_and_complete(shards):
+    served = []
+    for rank in range(2):
+        s = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2,
+                                 rank=rank)
+        served.extend(_ids(iter(s)))
+    assert sorted(served) == list(range(N_RECORDS))
+
+
+def test_take_over_resumes_from_published_cursor(shards, tmp_path, metrics):
+    d = str(tmp_path / "cursors")
+    dead = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=1,
+                                cursor_dir=d)
+    it = iter(dead)
+    dead_served = [next(it) for _ in range(2)]
+    dead.publish_cursor(cursor=2)          # then the host dies
+
+    surv = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=0,
+                                cursor_dir=d)
+    surv_batches = list(iter(surv))        # own share done (partial tail ok)
+    adopted = surv.take_over_host(1, survivors=[0])
+    assert adopted > 0
+    surv.load_state_dict(surv.state_dict(cursor=len(surv_batches)))
+    takeover_batches = list(iter(surv))
+    all_ids = _ids(dead_served) + _ids(surv_batches) + _ids(takeover_batches)
+    assert sorted(all_ids) == list(range(N_RECORDS))
+    assert telemetry.counters()["stream.shards_reassigned_total"] == adopted
+
+
+def test_take_over_without_cursor_reserves_full_share(shards, tmp_path):
+    d = str(tmp_path / "cursors")      # empty: the host died pre-checkpoint
+    surv = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=0,
+                                cursor_dir=d)
+    surv_batches = list(iter(surv))
+    assert surv.take_over_host(1, survivors=[0]) > 0
+    surv.load_state_dict(surv.state_dict(cursor=len(surv_batches)))
+    all_ids = _ids(surv_batches) + _ids(iter(surv))
+    assert sorted(all_ids) == list(range(N_RECORDS))
+
+
+def test_take_over_reentry_is_a_no_op(shards, tmp_path):
+    d = str(tmp_path / "cursors")
+    surv = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=0,
+                                cursor_dir=d)
+    list(iter(surv))
+    assert surv.take_over_host(1, survivors=[0]) > 0
+    assert surv.take_over_host(1, survivors=[0]) == 0    # exactly once
+
+
+def test_take_over_splits_deterministically_across_survivors(shards):
+    samplers = [stream.StreamSampler(shards, batch_size=4, seed=7, dp=3,
+                                     rank=r) for r in range(3)]
+    served = []
+    for s in samplers:
+        served.extend(_ids(iter(s)))
+    # host 2 dies pre-checkpoint; survivors 0 and 1 each run the same
+    # deterministic split — no shard lands on both, none is dropped
+    dead_share = _ids(iter(stream.StreamSampler(shards, batch_size=4, seed=7,
+                                                dp=3, rank=2)))
+    again = []
+    for s in samplers[:2]:
+        n = s.take_over_host(2, survivors=[0, 1])
+        assert n >= 0
+        s.load_state_dict(s.state_dict())
+        again.extend(_ids(iter(s)))
+    assert sorted(again) == sorted(dead_share)
+
+
+def test_resume_at_different_dp_size(shards, tmp_path):
+    """The elastic resize: a dp=2 run checkpoints, the restart runs
+    dp=1 — the new world adopts both cursors and finishes the SAME
+    epoch, every record exactly once."""
+    d = str(tmp_path / "cursors")
+    world, cursors = [], {}
+    for rank in range(2):
+        s = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2,
+                                 rank=rank, cursor_dir=d)
+        it = iter(s)
+        world.extend(_ids([next(it) for _ in range(2)]))
+        s.publish_cursor(cursor=2)
+        cursors[rank] = s.state_dict(cursor=2)
+
+    # restart: ONE host left, resuming host 0's cursor and adopting
+    # host 1's published one
+    s0 = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=0,
+                              cursor_dir=d)
+    s0.load_state_dict(cursors[0])
+    world.extend(_ids(iter(s0)))
+    assert s0.take_over_host(1, survivors=[0]) > 0
+    s0.load_state_dict(s0.state_dict())
+    world.extend(_ids(iter(s0)))
+    assert sorted(world) == list(range(N_RECORDS))
+
+
+def test_fleet_supervisor_reassigns_dead_host_shards(shards, tmp_path,
+                                                     metrics):
+    class _FakeStep:
+        mesh_config = MeshConfig(dp=2)
+
+    d = str(tmp_path / "leases")
+    dead = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=1,
+                                cursor_dir=d)
+    it = iter(dead)
+    next(it)
+    dead.publish_cursor(cursor=1)
+
+    surv = stream.StreamSampler(shards, batch_size=4, seed=7, dp=2, rank=0,
+                                cursor_dir=d)
+    iter(surv).__next__()                  # epoch live
+    prev = config.set("fleet.lease_dir", d)
+    try:
+        sup = FleetSupervisor(_FakeStep(), mx.resilience.TrainState(),
+                              n_hosts=2, min_dp=2, stream=surv)
+        sup.lose_host(1)                   # parks the mesh, moves the data
+    finally:
+        config.set("fleet.lease_dir", prev)
+    assert telemetry.counters().get("stream.shards_reassigned_total", 0) > 0
+    assert sup.parked                      # min_dp floor: compute parked,
+    #                                        but the shards are not lost
+
+
+# -- corrupt-record drills ---------------------------------------------------
+
+def test_corrupt_skip_policy_counts_and_shrinks(shards, metrics):
+    prev = config.set("stream.on_corrupt", "skip")
+    mx.fault.configure("stream.torn_record:prob=1,times=3")
+    try:
+        ds = stream.StreamDataset(shards)
+        samp = stream.StreamSampler(shards, batch_size=4, seed=5)
+        served = []
+        for batch in samp:
+            served.extend(ds.sample_batch(batch))
+    finally:
+        config.set("stream.on_corrupt", prev)
+    counters = telemetry.counters()
+    assert counters["stream.records_skipped_total"] == 3
+    assert len(served) == N_RECORDS - 3
+    assert counters["stream.records_served_total"] == N_RECORDS - 3
+    assert mx.fault.stats().get("injected.stream.torn_record") == 3
+
+
+def test_corrupt_raise_policy_lands_in_blackbox_bundle(shards, tmp_path):
+    bdir = str(tmp_path / "bundles")
+    prev = config.set("blackbox.dir", bdir)
+    blackbox.enable()
+    mx.fault.configure("stream.torn_record:prob=1,times=1")
+    try:
+        ds = stream.StreamDataset(shards)
+        samp = stream.StreamSampler(shards, batch_size=4, seed=5)
+        with pytest.raises(stream.CorruptRecord) as ei:
+            for batch in samp:
+                ds.sample_batch(batch)
+        assert ei.value.kind == "checksum" and ei.value.record_id is not None
+        path = blackbox.dump(trigger="exception", reason="corrupt record",
+                             exc=ei.value)
+        doc = blackbox.read_bundle(path)
+        assert doc["exception"]["type"] == "CorruptRecord"
+        assert "checksum" in doc["exception"]["message"]
+    finally:
+        blackbox.disable()
+        config.set("blackbox.dir", prev)
+
+
+def test_getitem_always_raises_on_corruption(shards):
+    prev = config.set("stream.on_corrupt", "skip")   # policy is batch-only
+    mx.fault.configure("stream.torn_record:prob=1,times=1")
+    try:
+        with pytest.raises(stream.CorruptRecord):
+            stream.StreamDataset(shards)[0]
+    finally:
+        config.set("stream.on_corrupt", prev)
+
+
+# -- shard-open failures: bounded retry, structured escalation ---------------
+
+def test_shard_unreadable_escalates_after_retry_budget(shards, metrics):
+    prev = config.set("stream.open_backoff", 0.001)
+    mx.fault.configure("stream.shard_unreadable:prob=1,times=3")
+    try:
+        ds = stream.StreamDataset(shards)
+        with pytest.raises(stream.ShardUnreadable) as ei:
+            ds[0]                          # never hangs: bounded attempts
+    finally:
+        config.set("stream.open_backoff", prev)
+    e = ei.value
+    assert isinstance(e, mx.resilience.WorkerLost)   # supervisor-dispatchable
+    assert e.op == "shard_open" and e.attempts == 3
+    assert telemetry.counters()["stream.open_retries_total"] == 2
+    assert mx.fault.stats().get("stream.shard_lost") == 1
+
+
+def test_shard_open_retry_recovers_from_transient_failure(shards, metrics):
+    prev = config.set("stream.open_backoff", 0.001)
+    mx.fault.configure("stream.shard_unreadable:prob=1,times=1")
+    try:
+        ds = stream.StreamDataset(shards)
+        assert ds[0] is not None           # retry after the injected miss
+    finally:
+        config.set("stream.open_backoff", prev)
+    assert telemetry.counters()["stream.open_retries_total"] == 1
+
+
+# -- insight: the input-bound verdict ----------------------------------------
+
+def test_input_stall_flips_the_roofline_verdict(metrics):
+    for _ in range(5):
+        telemetry.observe("pipeline.input_stall_seconds", 0.08)
+    assert insight.input_stall_p50() == pytest.approx(0.08, rel=0.2)
+    # stall p50 (80ms) > input_bound_ratio (0.5) x step (100ms)? yes
+    assert insight.roofline_verdict(1e12, 1e6, peak_flops=1e12,
+                                    peak_bytes_per_s=1e12,
+                                    step_seconds=0.1) == "input"
+    # same costs without a measured step time: the plain roofline
+    assert insight.roofline_verdict(1e12, 1e6, peak_flops=1e12,
+                                    peak_bytes_per_s=1e12) == "compute"
+    # a fed pipeline (stall well under the ratio) never reads "input"
+    telemetry.reset()
+    for _ in range(5):
+        telemetry.observe("pipeline.input_stall_seconds", 0.01)
+    assert insight.roofline_verdict(1e12, 1e6, peak_flops=1e12,
+                                    peak_bytes_per_s=1e12,
+                                    step_seconds=0.1) == "compute"
+
+
+# -- tools/make_shards.py ----------------------------------------------------
+
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "make_shards.py"),
+         *args], capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_make_shards_cli_packs_and_validates(tmp_path):
+    out = str(tmp_path / "packed")
+    p = _cli("--out", out, "--num-shards", "3", "--synthetic", "32",
+             "--shape", "4,4", "--classes", "5", "--validate")
+    assert p.returncode == 0, p.stdout + p.stderr
+    lines = [json.loads(ln) for ln in p.stdout.strip().splitlines()]
+    assert lines[0]["records"] == 32 and lines[0]["shards"] == 3
+    assert lines[1]["ok"] is True
+    rec = sorted(glob.glob(os.path.join(out, "*.rec")))[1]
+    with open(rec, "r+b") as f:
+        f.seek(os.path.getsize(rec) - 6)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    p = _cli("--validate", out)
+    assert p.returncode == 1 and "CORRUPT" in p.stderr
+
+
+# -- the 2-process host-loss drill -------------------------------------------
+
+def test_multiprocess_host_loss_is_exactly_once(tmp_path):
+    """Kill one host mid-epoch (its lease rots, its cursor names only
+    the checkpointed prefix); the survivor adopts the rest.  The union
+    of the durable served-record logs is the epoch, multiplicity 1."""
+    n = 96
+    data = str(tmp_path / "data")
+    with stream.ShardWriter(data, 8) as w:
+        for g in range(n):
+            w.append(stream.pack_sample(
+                onp.full((2,), g, dtype=onp.float32), onp.int32(0)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    worker = os.path.join(REPO, "tests", "stream_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(tmp_path), str(rank), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    assert procs[1].returncode == 0 and "STREAM_VICTIM_DOWN 1" in outs[1], \
+        outs[1]
+    assert procs[0].returncode == 0, outs[0]
+    assert "STREAM_DRILL_DONE rank=0" in outs[0], outs[0]
+    served = []
+    for path in glob.glob(os.path.join(str(tmp_path), "served-*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                served.extend(json.loads(line))
+    assert sorted(served) == list(range(n)), \
+        f"multiset broke: {len(served)} served, {len(set(served))} unique"
