@@ -103,6 +103,10 @@ def _measure(step, args, n_state: int, target_s: float = 1.2,
     dt, _ = run(5)               # pilot to calibrate the window
     iters = min(max_iters, max(6, math.ceil(target_s / max(dt / 5, 1e-5))))
     dt, val = run(iters)
+    from mxnet_tpu import goodput as _goodput
+    if _goodput._active:
+        # the measured window is pure device compute in the ledger
+        _goodput.note("compute", dt)
     return dt / iters, val
 
 
@@ -150,6 +154,11 @@ def _row(name, sec_per_step, items_per_step, model_flops_per_step,
         # a reading above peak means the timing window is broken —
         # report it as invalid rather than as a throughput.
         row["valid"] = eff <= peak
+    from mxnet_tpu import goodput as _goodput
+    if _goodput._active:
+        # goodput_fraction + top-2 badput causes for this row's window
+        # (main() resets the ledger per row)
+        row.update(_goodput.bench_fields())
     return row
 
 
@@ -793,6 +802,11 @@ def main(argv=None):
     platform, on_cpu = dev.platform, dev.platform == "cpu"
     peak = _chip_peak(dev)
 
+    # arm the goodput ledger for the grid so every row reports its
+    # goodput_fraction + top badput causes (reset per row below)
+    from mxnet_tpu import goodput as _goodput
+    _goodput.enable()
+
     rows = []
     for fn, kwargs in [
         (bench_resnet50_train, dict(precision="bf16")),   # headline (bs32)
@@ -838,6 +852,8 @@ def main(argv=None):
             continue  # same dedup for the shrunken GPT rows
         from mxnet_tpu import config as _cfg
         fused_prior = _cfg.get("fused_conv_bn")
+        if _goodput._active:
+            _goodput.reset()   # per-row ledger window
         row = None
         try:
             for attempt in (1, 2, 3):  # retries: the tunneled platform can

@@ -33,6 +33,7 @@ from . import fault
 from . import trace
 from . import insight
 from . import blackbox
+from . import goodput
 from . import context
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, device, num_gpus, num_tpus
 from . import engine

@@ -35,6 +35,7 @@ import time
 
 from .. import config as _config
 from .. import fault as _fault
+from .. import goodput as _goodput
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..base import MXNetError
@@ -676,11 +677,16 @@ class Retuner:
             self._thread.start()
 
     def _search(self):
+        # the background re-search competes with training for host
+        # cycles: its lifetime is retune badput in the goodput ledger
+        tok = _goodput.begin("retune") if _goodput._active else None
         try:
             self._staged = search_kernels(**self._kw)
         except Exception as e:   # a failed re-search must not kill training
             _telemetry.note_event("autotune.retune_failed",
                                   f"{type(e).__name__}: {e}"[:200])
+        finally:
+            _goodput.end(tok)
 
     def join(self, timeout=None):
         t = self._thread
@@ -708,9 +714,14 @@ class Retuner:
         self._staged = None
         sp = _trace.begin("autotune.retune", category="autotune",
                           buckets=len(res.tuned)) if _trace._active else None
-        _TUNED.update(res.tuned)
-        if step is not None and getattr(step, "mesh_config", None) is not None:
-            step = step.rebuild(step.mesh_config)
+        tok = _goodput.begin("retune") if _goodput._active else None
+        try:
+            _TUNED.update(res.tuned)
+            if step is not None and \
+                    getattr(step, "mesh_config", None) is not None:
+                step = step.rebuild(step.mesh_config)
+        finally:
+            _goodput.end(tok)
         self.applied += 1
         _telemetry.inc("autotune.retunes_total")
         if sp is not None:

@@ -460,6 +460,43 @@ declare("serve.health_window", float, 30.0, "MXNET_SERVE_HEALTH_WINDOW",
         "Seconds without a decode step while work is pending before the "
         "serve engine reports itself unhealthy on the ops /healthz "
         "endpoint (step-loop liveness, not static OK).")
+declare("goodput.enable", bool, False, "MXNET_GOODPUT",
+        "Master switch for the mx.goodput wall-clock ledger (badput "
+        "attribution, fleet device-second merge, SLO burn rates). "
+        "Disabled, every goodput hook costs one attribute read.")
+declare("goodput.target", float, 0.0, "MXNET_GOODPUT_TARGET",
+        "Training goodput SLO: the target fraction of wall clock spent "
+        "in compute (e.g. 0.95). Setting it arms the 5m/1h error-"
+        "budget burn-rate gauges and the goodput /healthz provider; "
+        "0 disables the SLO layer.")
+declare("goodput.burn_threshold", float, 2.0,
+        "MXNET_GOODPUT_BURN_THRESHOLD",
+        "Error-budget burn rate past which the goodput /healthz "
+        "provider reports unhealthy (503) — only when every burn "
+        "window agrees, so a 5-minute blip alone never pages.")
+declare("goodput.snapshot_interval", float, 5.0,
+        "MXNET_GOODPUT_SNAPSHOT_INTERVAL",
+        "Seconds between atomic goodput-<rank>.json ledger snapshots "
+        "published next to the heartbeat leases (riding the "
+        "HealthPlane.beat cadence, so no extra thread).")
+declare("serve.slo_ttft_ms", float, 0.0, "MXNET_SERVE_SLO_TTFT_MS",
+        "Serving SLO: time-to-first-token objective in milliseconds. "
+        "A finished prefill slower than this counts into "
+        "serve.slo_violations_total{kind=ttft} and the per-engine "
+        "burn gauge; 0 disarms the ttft objective.")
+declare("serve.slo_tpot_ms", float, 0.0, "MXNET_SERVE_SLO_TPOT_MS",
+        "Serving SLO: per-output-token decode latency objective in "
+        "milliseconds, checked at request finish; violations count "
+        "into serve.slo_violations_total{kind=tpot}. 0 disarms.")
+declare("serve.slo_target", float, 0.99, "MXNET_SERVE_SLO_TARGET",
+        "Fraction of requests that must meet the serve SLO "
+        "objectives; 1 - target is the error budget the "
+        "serve.slo_burn_rate gauges burn against.")
+declare("serve.phase_sampling", int, 64, "MXNET_SERVE_PHASE_SAMPLING",
+        "Per-request cap on always-on phase timing samples "
+        "(queue_wait/prefill/decode_step) kept for stats()['phases'] "
+        "without the tracer armed; 0 restores the trace-only "
+        "behaviour (one attribute read on the disabled path).")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

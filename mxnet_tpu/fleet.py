@@ -42,6 +42,7 @@ import time
 from . import blackbox as _blackbox
 from . import config as _config
 from . import fault as _fault
+from . import goodput as _goodput
 from . import insight as _insight
 from . import resilience as _resilience
 from . import telemetry as _telemetry
@@ -230,6 +231,10 @@ class HealthPlane:
             # so the fleet always holds a recent bundle for this host
             _blackbox.maybe_checkpoint(self.lease_dir, self.rank,
                                        step=self._step)
+        if _goodput._active and self.lease_dir:
+            # goodput ledger snapshot on the same cadence (rate-limited
+            # by goodput.snapshot_interval)
+            _goodput.maybe_snapshot(self.lease_dir, self.rank)
         return True
 
     def _publish_coord(self, payload):
@@ -442,8 +447,12 @@ class FleetSupervisor:
         self.postmortems: dict[int, str] = {}
         self._last_lost: int | None = None
         self.parked = False
+        self._park_token = None
         self.degrades = 0
         self.reexpands = 0
+        if _goodput._active:
+            _goodput.set_devices(self._dev_per_host)
+            _goodput.set_capacity(self.current.size(), self.target.size())
         _gauge("fleet.peers_expected", self.n_hosts)
         _gauge("fleet.peers_alive", self.n_hosts)
         _gauge("fleet.dp_size", self.current.dp)
@@ -502,6 +511,9 @@ class FleetSupervisor:
         if self.parked:
             self.parked = False
             _gauge("fleet.parked", 0)
+            if self._park_token is not None:
+                _goodput.end(self._park_token)
+                self._park_token = None
 
     # -- plan / apply ----------------------------------------------------
 
@@ -512,6 +524,10 @@ class FleetSupervisor:
         if plan is None:
             self.parked = True
             _gauge("fleet.parked", 1)
+            if _goodput._active and self._park_token is None:
+                # open-ended: every parked second is badput until
+                # restore_hosts() closes the bracket
+                self._park_token = _goodput.begin("parked")
             _fault.record("fleet.park")
             with _trace.span("fleet.park", category="fleet",
                              devices=avail, min_dp=self.min_dp):
@@ -525,6 +541,10 @@ class FleetSupervisor:
         """Rebuild the step around ``cfg`` and restore the newest valid
         bundle bitwise into it (step counter, RNG, optimizer state ride
         along — the run resumes exactly at the last checkpoint)."""
+        # the whole transition (rebuild + recompile + bundle restore) is
+        # restart badput; restart outranks the nested restore/compile
+        # claims, so the ledger counts the downtime exactly once
+        tok = _goodput.begin("restart") if _goodput._active else None
         with _trace.span(f"fleet.{kind}", category="fleet", dp=cfg.dp,
                          tp=cfg.tp, pp=cfg.pp, devices=cfg.size()) as sp:
             if kind == "degrade" and self._last_lost is not None:
@@ -539,6 +559,10 @@ class FleetSupervisor:
             self.state.sharded_step = new_step
             if self.state.exists():
                 self.state.load_latest_valid()
+        if tok is not None:
+            _goodput.end(tok)
+        if _goodput._active:
+            _goodput.set_capacity(cfg.size(), self.target.size())
         self.current = cfg
         _gauge("fleet.dp_size", cfg.dp)
         if kind == "degrade":

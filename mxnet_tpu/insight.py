@@ -138,13 +138,15 @@ def enable(on=True):
     if _active:
         _telemetry.register_health("insight", healthz)
         _telemetry.add_sample_listener("trainer.step_seconds",
-                                       _trainer_samples)
+                                       _trainer_samples, tag="insight")
         _telemetry.add_sample_listener("serve.step_seconds",
-                                       _serve_samples)
+                                       _serve_samples, tag="insight")
     else:
         _telemetry.unregister_health("insight")
-        _telemetry.remove_sample_listener("trainer.step_seconds")
-        _telemetry.remove_sample_listener("serve.step_seconds")
+        _telemetry.remove_sample_listener("trainer.step_seconds",
+                                          tag="insight")
+        _telemetry.remove_sample_listener("serve.step_seconds",
+                                          tag="insight")
     return _active
 
 

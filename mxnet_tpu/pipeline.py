@@ -55,6 +55,7 @@ import time
 
 from . import config as _config
 from . import fault as _fault
+from . import goodput as _goodput
 from . import telemetry as _telemetry
 from . import trace as _trace
 
@@ -477,12 +478,17 @@ class DevicePrefetcher:
                     # slow-but-alive producer still hands its batch on
                     # instead of dropping it, and the replacement (blocked
                     # on the lock) cannot fetch the following batch first
+                    t0 = (time.perf_counter()
+                          if _goodput._active else 0.0)
                     if _trace._active:
                         with _trace.span("pipeline.h2d",
                                          category="pipeline"):
                             payload = self._put_batch(item)
                     else:
                         payload = self._put_batch(item)
+                    if _goodput._active:
+                        _goodput.note("h2d",
+                                      time.perf_counter() - t0)
                 except BaseException as exc:  # noqa: BLE001 - to consumer
                     # mxlint: disable=LCK002(same bounded hand-off as above; the exception must reach the consumer before the thread retires)
                     self._offer(_Raise(exc))

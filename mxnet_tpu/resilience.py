@@ -38,6 +38,7 @@ import time
 
 from . import config as _config
 from . import fault as _fault
+from . import goodput as _goodput
 from . import random as _random
 from . import serialization as _serialization
 from . import telemetry as _telemetry
@@ -222,6 +223,14 @@ class TrainState:
         path = path or self.path
         if path is None:
             raise MXNetError("TrainState.save: no bundle path configured")
+        tok = _goodput.begin("checkpoint_save") if _goodput._active else None
+        try:
+            self._save_bundle(path)
+        finally:
+            _goodput.end(tok)
+        return path
+
+    def _save_bundle(self, path):
         blob = pickle.dumps(self.state_dict(),
                             protocol=pickle.HIGHEST_PROTOCOL)
         _serialization.atomic_write_bytes(path, blob)
@@ -309,14 +318,18 @@ class TrainState:
         path = path or self.path
         if path is None or not os.path.exists(path):
             raise MXNetError(f"TrainState.load: no bundle at {path!r}")
-        _serialization.verify_checksum(path)
-        with open(path, "rb") as f:
-            try:
-                bundle = pickle.loads(f.read())
-            except Exception as e:   # noqa: BLE001 - torn/corrupt pickle
-                raise MXNetError(
-                    f"{path}: corrupt TrainState bundle ({e})") from e
-        self.restore(bundle)
+        tok = _goodput.begin("restore") if _goodput._active else None
+        try:
+            _serialization.verify_checksum(path)
+            with open(path, "rb") as f:
+                try:
+                    bundle = pickle.loads(f.read())
+                except Exception as e:  # noqa: BLE001 - torn/corrupt pickle
+                    raise MXNetError(
+                        f"{path}: corrupt TrainState bundle ({e})") from e
+            self.restore(bundle)
+        finally:
+            _goodput.end(tok)
         return bundle
 
     def load_latest_valid(self, path=None):
@@ -333,18 +346,22 @@ class TrainState:
                 "TrainState.load_latest_valid: no bundle path configured")
         candidates = [path] + list(reversed(self._history(path)))
         last_err = None
-        for p in candidates:
-            if not os.path.exists(p):
-                continue
-            try:
-                _serialization.verify_checksum(p)
-                with open(p, "rb") as f:
-                    bundle = pickle.loads(f.read())
-            except Exception as e:   # noqa: BLE001 - torn: try the next gen
-                last_err = e
-                continue
-            self.restore(bundle)
-            return p
+        tok = _goodput.begin("restore") if _goodput._active else None
+        try:
+            for p in candidates:
+                if not os.path.exists(p):
+                    continue
+                try:
+                    _serialization.verify_checksum(p)
+                    with open(p, "rb") as f:
+                        bundle = pickle.loads(f.read())
+                except Exception as e:  # noqa: BLE001 - torn: next gen
+                    last_err = e
+                    continue
+                self.restore(bundle)
+                return p
+        finally:
+            _goodput.end(tok)
         raise MXNetError(
             f"TrainState.load_latest_valid: no valid bundle at {path!r} "
             f"or its history; last error: {last_err}")
@@ -389,7 +406,8 @@ class TrainState:
 # supervisor
 # ---------------------------------------------------------------------------
 
-def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
+def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False,
+        resume_on_preempt=False):
     """Supervise ``train_fn`` (a zero-arg callable) against worker loss
     and preemption.
 
@@ -400,8 +418,12 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
       knob); then re-raise.
     - :class:`Preempted`: the bundle was already written by the preempt
       path.  With ``exit_on_preempt=True`` the process exits with
-      :data:`RESUME_EXIT_CODE` so the scheduler reschedules it; otherwise
-      the exception propagates to the caller (tests, notebooks).
+      :data:`RESUME_EXIT_CODE` so the scheduler reschedules it; with
+      ``resume_on_preempt=True`` (and a restorable ``state``) the
+      supervisor instead restores the bundle in-process and re-enters
+      ``train_fn`` against the restart budget — single-host runs where
+      the "scheduler" is this very process; otherwise the exception
+      propagates to the caller (tests, notebooks).
 
     Returns whatever ``train_fn`` returns on success.
     """
@@ -425,6 +447,24 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
             if exit_on_preempt:
                 _event("preempt_exit")
                 raise SystemExit(RESUME_EXIT_CODE)
+            if resume_on_preempt and state is not None and state.exists():
+                if restarts >= budget:
+                    _event("restart_budget_exhausted")
+                    raise
+                restarts += 1
+                # the whole resume (bundle restore + re-entry) is
+                # restart badput; restart outranks the nested restore
+                # claim so the ledger counts the downtime once
+                tok = (_goodput.begin("restart")
+                       if _goodput._active else None)
+                try:
+                    state.load_latest_valid()
+                    prev_step = state.step
+                    _event("preempt_resume")
+                    clear_preempt()
+                finally:
+                    _goodput.end(tok)
+                continue
             raise
         except WorkerLost as e:
             from . import blackbox as _blackbox
@@ -444,8 +484,12 @@ def run(train_fn, state=None, max_restarts=None, exit_on_preempt=False):
                 raise
             restarts += 1
             _event("worker_lost", op=e.op)
-            if state is not None and state.exists():
-                state.load()
-                prev_step = state.step
-            _event("restart")
-            clear_preempt()
+            tok = _goodput.begin("restart") if _goodput._active else None
+            try:
+                if state is not None and state.exists():
+                    state.load()
+                    prev_step = state.step
+                _event("restart")
+                clear_preempt()
+            finally:
+                _goodput.end(tok)

@@ -40,6 +40,7 @@ import numpy as onp
 
 from .. import config as _config
 from .. import functional as _functional
+from .. import goodput as _goodput
 from .. import insight as _insight
 from .. import pipeline as _pipeline
 from .. import profiler as _profiler
@@ -103,6 +104,17 @@ _telemetry.declare_metric(
     "serve.passthrough_params", "gauge",
     "parameters kept in float by the engine's weight quantization "
     "(ineligible rank/size, or quantization off)")
+_telemetry.declare_metric(
+    "serve.slo_violations_total", "counter",
+    "requests finishing past a declared serving SLO objective, by kind "
+    "(ttft: serve.slo_ttft_ms at first token; tpot: serve.slo_tpot_ms "
+    "per output token at finish)")
+_telemetry.declare_metric(
+    "serve.slo_burn_rate", "gauge",
+    "per-engine error-budget burn rate against serve.slo_target over "
+    "the trailing window, by kind — 1.0 spends the budget exactly; "
+    "past goodput.burn_threshold the engine's /healthz goes red (the "
+    "autoscaler admission signal)")
 
 #: weight-storage modes ServeEngine(quantize=...) understands; combine
 #: with "," (e.g. "int4_weights,int8_kv")
@@ -168,8 +180,9 @@ class Request:
         self.t_admitted = None
         self.t_first = None
         self.t_done = None
-        #: per-phase wall-time samples (seconds), filled while mx.trace
-        #: records this request — the source of stats()["phases"]
+        #: per-phase wall-time samples (seconds) — the source of
+        #: stats()["phases"]: unbounded while mx.trace records this
+        #: request, else capped by serve.phase_sampling
         self.phases = {}
         self._span = None   # serve.request root (trace.SpanHandle)
         self._enq = None    # serve.enqueue child, open until admission
@@ -328,6 +341,12 @@ class ServeEngine:
         self._max_queue = int(_config.get("serve.max_queue"))
         self._last_step_time = None
         self._created = time.monotonic()
+        # serving SLO objectives (0 = disarmed) + the always-on bounded
+        # phase reservoir (stats()["phases"] without the tracer)
+        self._slo_ttft = float(_config.get("serve.slo_ttft_ms")) / 1e3
+        self._slo_tpot = float(_config.get("serve.slo_tpot_ms")) / 1e3
+        self._slo_events = collections.deque(maxlen=2048)
+        self._phase_cap = int(_config.get("serve.phase_sampling"))
         # the ops endpoint's /healthz reflects THIS engine's step-loop
         # liveness (a process hosts one serving engine; the newest wins).
         # Bound weakly: a collected engine must not pin a stale check.
@@ -533,6 +552,8 @@ class ServeEngine:
             _telemetry.inc("serve.tokens_total", len(req.generated))
             if req.tpot is not None:
                 _telemetry.observe("serve.tpot_seconds", req.tpot)
+        if self._slo_tpot and req.tpot is not None:
+            self._slo_observe("tpot", req.tpot > self._slo_tpot)
 
     def _prefill_sink(self, req):
         def sink(fetched):
@@ -543,6 +564,8 @@ class ServeEngine:
             req.generated.append(tok)
             if _telemetry._active and req.ttft is not None:
                 _telemetry.observe("serve.ttft_seconds", req.ttft)
+            if self._slo_ttft and req.ttft is not None:
+                self._slo_observe("ttft", req.ttft > self._slo_ttft)
             if done:
                 self._finish(req)
             if _trace._active and span_ctx is not None:
@@ -584,6 +607,7 @@ class ServeEngine:
             limit = min(length + req.max_new_tokens - 1, self.max_seq - 1)
             exe = self._prefill_exe(bucket)
             t0u = _profiler.now_us() if _trace._active else 0
+            t0p = time.perf_counter()
             self._cache, self._state, emit = exe(
                 self._params, self._cache, self._state,
                 jnp.asarray(padded), jnp.int32(slot), jnp.int32(length),
@@ -598,9 +622,11 @@ class ServeEngine:
                 _trace.emit("serve.prefill", t0u, duru,
                             parent=req._span.context, category="serve",
                             request=req.id, slot=slot, bucket=bucket)
-                req.phases.setdefault("queue_wait", []).append(
-                    req.t_admitted - req.t_submit)
-                req.phases.setdefault("prefill", []).append(duru / 1e6)
+            if _trace._active or self._phase_cap:
+                self._phase_note(req, "queue_wait",
+                                 req.t_admitted - req.t_submit)
+                self._phase_note(req, "prefill",
+                                 req.t_admitted - t0p)
             self._slots[slot] = req
             self._window.push(emit, self._prefill_sink(req))
             admitted += 1
@@ -650,9 +676,21 @@ class ServeEngine:
                                 parent=req._span.context,
                                 category="serve", request=req.id,
                                 slot=slot, step=self._steps)
-                req.phases.setdefault("decode_step", []).append(dt)
+                self._phase_note(req, "decode_step", dt)
+        elif self._phase_cap:
+            for req in live.values():
+                self._phase_note(req, "decode_step", dt)
         self._window.push(emit, self._decode_sink(live))
         return True
+
+    def _phase_note(self, req, key, val):
+        """Per-request phase sample: unbounded while the tracer runs
+        (the PR 9 behaviour), else capped at ``serve.phase_sampling``
+        samples per phase so stats()["phases"] stays populated in
+        production at a bounded cost."""
+        lst = req.phases.setdefault(key, [])
+        if _trace._active or len(lst) < self._phase_cap:
+            lst.append(val)
 
     def drain(self):
         """Fetch every deferred emit (host sync); completions land."""
@@ -710,6 +748,7 @@ class ServeEngine:
         if self._stopping:
             return self
         self._stopping = True
+        tok = _goodput.begin("drain") if _goodput._active else None
         try:
             if drain:
                 self.run()
@@ -718,8 +757,42 @@ class ServeEngine:
                     self._reject(self._queue.popleft(), "stopping")
                 self.drain()
         finally:
+            _goodput.end(tok)
             _telemetry.unregister_health(self._health_name)
         return self
+
+    def _slo_observe(self, kind, violated):
+        """Account one request against the declared SLO objective of
+        ``kind`` — the drain-time observation point the burn gauge and
+        autoscaler admission signal ride."""
+        self._slo_events.append((time.monotonic(), kind, bool(violated)))
+        if violated and _telemetry._active:
+            _telemetry.inc("serve.slo_violations_total", kind=kind)
+
+    def slo_burn(self, window=300.0):
+        """Per-kind error-budget burn rate over the trailing ``window``
+        seconds: violation rate over the budget ``1 - serve.slo_target``
+        (1.0 spends the budget exactly).  {} until an objective is
+        armed and a request has been observed."""
+        budget = 1.0 - float(_config.get("serve.slo_target"))
+        if budget <= 0:
+            return {}
+        cut = time.monotonic() - window
+        out = {}
+        for kind, armed in (("ttft", self._slo_ttft),
+                            ("tpot", self._slo_tpot)):
+            if not armed:
+                continue
+            hits = [v for (t, k, v) in self._slo_events
+                    if k == kind and t >= cut]
+            if not hits:
+                continue
+            burn = (sum(hits) / len(hits)) / budget
+            out[kind] = round(burn, 4)
+            if _telemetry._active:
+                _telemetry.set_gauge("serve.slo_burn_rate",
+                                     round(burn, 4), kind=kind)
+        return out
 
     def _reject(self, req, reason):
         """Account a queued request discarded by stop(drain=False): its
@@ -737,9 +810,17 @@ class ServeEngine:
         """/healthz provider: red while stopping, and red when the engine
         has pending work but the step loop has not dispatched within
         ``serve.health_window`` seconds (a wedged or abandoned loop — the
-        condition a static-OK healthz could never see)."""
+        condition a static-OK healthz could never see); red as well when
+        a declared serving SLO's error budget burns past
+        ``goodput.burn_threshold`` — the 503 the autoscaler consumes."""
         if self._stopping:
             return {"ok": False, "state": "stopping"}
+        if self._slo_ttft or self._slo_tpot:
+            burn = self.slo_burn()
+            thresh = float(_config.get("goodput.burn_threshold"))
+            if burn and max(burn.values()) > thresh:
+                return {"ok": False, "state": "slo_burn", "burn": burn,
+                        "threshold": thresh}
         if not self.pending:
             return {"ok": True, "state": "idle", "steps": self._steps}
         last = (self._last_step_time if self._last_step_time is not None
@@ -781,8 +862,9 @@ class ServeEngine:
         for name, vals in (("ttft", ttfts), ("tpot", tpots)):
             out[name] = {"p50": pct(vals, 50), "p95": pct(vals, 95),
                          "p99": pct(vals, 99)}
-        # per-request phase breakdown from trace instrumentation (filled
-        # while mx.trace was recording; None per phase otherwise)
+        # per-request phase breakdown: unbounded trace instrumentation
+        # while mx.trace records, else the bounded always-on reservoir
+        # (serve.phase_sampling; None per phase only when both are off)
         phases = {}
         for key, label in (("queue_wait", "queue_wait"),
                            ("prefill", "prefill"),
@@ -792,6 +874,18 @@ class ServeEngine:
                 "p50": pct(vals, 50), "p95": pct(vals, 95),
                 "p99": pct(vals, 99)}
         out["phases"] = phases
+        if self._slo_ttft or self._slo_tpot:
+            viol = {}
+            for (_t, kind, v) in self._slo_events:
+                if v:
+                    viol[kind] = viol.get(kind, 0) + 1
+            out["slo"] = {
+                "ttft_ms": self._slo_ttft * 1e3 if self._slo_ttft else None,
+                "tpot_ms": self._slo_tpot * 1e3 if self._slo_tpot else None,
+                "target": float(_config.get("serve.slo_target")),
+                "burn": self.slo_burn(),
+                "violations": viol,
+            }
         if self.quantize:
             pt, qt = self._params
             now, was = _quantize.quantized_bytes(pt, qt, self._qdtypes)

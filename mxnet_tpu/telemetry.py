@@ -304,21 +304,28 @@ class _Hist:
         self.count += 1
 
 
-#: raw-sample listeners (mx.insight's drift feed): histogram name -> one
-#: callable receiving each observed value.  Consulted only while the
-#: registry is enabled, after the bucket update and OUTSIDE _lock, so a
-#: listener may record metrics of its own.
-_sample_listeners: dict[str, object] = {}
+#: raw-sample listeners (mx.insight's drift feed, mx.goodput's ledger
+#: feed): histogram name -> {tag: callable}, each callable receiving
+#: every observed value.  Consulted only while the registry is enabled,
+#: after the bucket update and OUTSIDE _lock, so a listener may record
+#: metrics of its own.
+_sample_listeners: dict[str, dict] = {}
 
 
-def add_sample_listener(name, fn):
+def add_sample_listener(name, fn, tag="default"):
     """Register ``fn(value)`` to receive every raw :func:`observe`
-    sample for histogram ``name`` (one listener per name; replaces)."""
-    _sample_listeners[name] = fn
+    sample for histogram ``name``.  Listeners are keyed by ``tag`` so
+    independent planes (insight's drift detector, goodput's ledger)
+    coexist on one histogram; re-registering a tag replaces it."""
+    _sample_listeners.setdefault(name, {})[tag] = fn
 
 
-def remove_sample_listener(name):
-    _sample_listeners.pop(name, None)
+def remove_sample_listener(name, tag="default"):
+    fns = _sample_listeners.get(name)
+    if fns is not None:
+        fns.pop(tag, None)
+        if not fns:
+            _sample_listeners.pop(name, None)
 
 
 def observe(name, value, **labels):
@@ -334,9 +341,10 @@ def observe(name, value, **labels):
         if h is None:
             h = _hists[key] = _Hist(spec[2] or TIME_BUCKETS)
         h.observe(value)
-    fn = _sample_listeners.get(name)
-    if fn is not None:
-        fn(value)
+    fns = _sample_listeners.get(name)
+    if fns is not None:
+        for fn in tuple(fns.values()):
+            fn(value)
 
 
 @contextlib.contextmanager
@@ -710,6 +718,8 @@ def serve_http(port=None):
       spans as JSON, optionally filtered to one category.
     - ``GET /insight``  — the mx.insight attribution report (local +
       merged fleet view) as JSON.
+    - ``GET /goodput``  — the mx.goodput ledger (local bucket waterfall
+      + capacity-weighted fleet device-second merge) as JSON.
     - ``GET /postmortem?last=N`` — metadata of the newest N mx.blackbox
       postmortem bundles in the resolved bundle directory.
 
@@ -784,6 +794,10 @@ def serve_http(port=None):
                 from . import insight as _insight
                 self._send(200, json.dumps(_insight.endpoint_report()),
                            "application/json")
+            elif url.path == "/goodput":
+                from . import goodput as _goodput
+                self._send(200, json.dumps(_goodput.endpoint_report()),
+                           "application/json")
             elif url.path == "/postmortem":
                 from . import blackbox as _blackbox
                 query = urllib.parse.parse_qs(url.query)
@@ -802,6 +816,7 @@ def serve_http(port=None):
                 self._send(404, json.dumps(
                     {"error": f"unknown path {url.path!r}",
                      "paths": ["/metrics", "/healthz", "/insight",
+                               "/goodput",
                                "/trace?last=N&category=C",
                                "/postmortem?last=N"]}),
                     "application/json")
@@ -969,6 +984,10 @@ class TrainingTelemetry:
         observed = _insight.last_summary()
         if observed is not None:
             out["insight"] = observed
+        from . import goodput as _goodput
+        ledger = _goodput.last_summary()
+        if ledger is not None:
+            out["goodput"] = ledger
         return out
 
     def close(self):

@@ -1,0 +1,409 @@
+"""mx.goodput — wall-clock goodput ledger, badput attribution and SLO
+error-budget burn rates (docs/OBSERVABILITY.md "Goodput & SLO budgets").
+
+Oracles: **conservation** — the sum of ledger buckets equals elapsed
+wall clock within epsilon, with zero overlapping intervals, held
+through every injected-badput chaos drill (preempt -> restart, host
+loss -> restart + degraded capacity, prefetch stall -> input_stall)
+and through claim compaction; **priority** — synthetic overlapping
+claims resolve to the highest-priority state exactly once; **merge** —
+two hand-written host snapshots combine into capacity-weighted fleet
+device-second totals; the ``/goodput`` endpoint, the burn-rate
+``/healthz`` 503 and the run-report plane round-trip end-to-end.
+
+Chaos spec literals exercised here: "resilience.preempt:at=1,times=1",
+"fleet.host_loss:at=1", "pipeline.prefetch_stall:at=2,times=1".
+"""
+import json
+import random
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import goodput, pipeline, telemetry, trace
+from mxnet_tpu.fleet import FleetSupervisor, HealthPlane
+from mxnet_tpu.parallel.mesh import MeshConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_goodput_state():
+    goodput.disable()
+    goodput.reset()
+    telemetry.stop_http()
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    yield
+    goodput.disable()
+    goodput.reset()
+    telemetry.stop_http()
+    telemetry.disable()
+    telemetry.reset()
+    trace.disable()
+    trace.clear()
+    mx.fault.clear()
+    mx.fault.reset_stats()
+    mx.config.reset()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.headers.get("Content-Type"), \
+                r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), e.read().decode()
+
+
+def _assert_conserved(s, epsilon=0.01):
+    slack = epsilon + s["late_dropped_s"]
+    assert s["conservation_error_s"] <= slack, s
+    assert abs(sum(s["buckets"].values()) - s["elapsed_s"]) <= slack, s
+
+
+# ---------------------------------------------------------------------------
+# disabled fast path
+# ---------------------------------------------------------------------------
+
+def test_disabled_hooks_are_noops():
+    assert not goodput.active()
+    assert goodput.begin("restart") is None
+    goodput.end(None)
+    goodput.note("compute", 1.0)
+    goodput.set_capacity(2, 4)
+    with goodput.phase("checkpoint_save"):
+        pass
+    assert goodput.maybe_snapshot() is None
+    assert goodput.last_summary() is None
+    assert goodput.bench_fields() == {}
+    assert goodput.healthz()["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# priority / no-overlap / conservation on synthetic claims
+# ---------------------------------------------------------------------------
+
+def test_resolve_claims_priority_and_no_overlap():
+    # compute spans everything; restart and input_stall overlap it (and
+    # each other at [3,4)): every instant counts exactly once, for the
+    # highest-priority claimant
+    b = goodput.resolve_claims(
+        [(0, 10, "compute"), (2, 4, "restart"), (3, 6, "input_stall")],
+        0, 12)
+    assert abs(sum(b.values()) - 12) < 1e-9
+    assert b["restart"] == pytest.approx(2)       # [2,4): beats both
+    assert b["input_stall"] == pytest.approx(2)   # [4,6): beats compute
+    assert b["compute"] == pytest.approx(6)       # the rest of [0,10)
+    assert b["idle"] == pytest.approx(2)          # [10,12): unclaimed
+
+
+def test_resolve_claims_capacity_split_and_parked_exemption():
+    # capacity drops to 0.5 at t=4: compute after the drop is half
+    # badput; a parked interval is NOT split (it is 100% parked already)
+    b = goodput.resolve_claims(
+        [(0, 8, "compute"), (8, 10, "parked")], 0, 10,
+        cap_marks=[(0, 1.0), (4, 0.5)])
+    assert abs(sum(b.values()) - 10) < 1e-9
+    assert b["compute"] == pytest.approx(4 + 4 * 0.5)
+    assert b["degraded_capacity"] == pytest.approx(2.0)
+    assert b["parked"] == pytest.approx(2.0)
+
+
+def test_conservation_oracle_random_overlaps():
+    rng = random.Random(17)
+    states = list(goodput.PRIORITY)
+    claims = [(a := rng.uniform(0, 50), a + rng.uniform(0, 10),
+               rng.choice(states)) for _ in range(200)]
+    b = goodput.resolve_claims(claims, 0, 60,
+                               cap_marks=[(0, 1.0), (20, 0.5), (40, 1.0)])
+    assert abs(sum(b.values()) - 60) < 1e-6
+
+
+def test_compaction_preserves_conservation():
+    led = goodput._Ledger(now=0.0)
+    rng = random.Random(5)
+    t = 0.0
+    for _ in range(3 * goodput._CLAIM_CAP):
+        d = rng.uniform(0.001, 0.01)
+        t += d
+        led.claim(rng.choice(goodput.PRIORITY), t - d, t,
+                  now=t + goodput._SETTLE_GRACE + 1.0)
+    assert len(led.claims) <= goodput._CLAIM_CAP   # compaction ran
+    buckets = led.resolve(t)
+    assert abs(sum(buckets.values()) - t) < 1e-6 + led.late_dropped_s
+
+
+# ---------------------------------------------------------------------------
+# live ledger
+# ---------------------------------------------------------------------------
+
+def test_bracket_sample_and_idle_residual():
+    goodput.enable()
+    with goodput.phase("checkpoint_save"):
+        time.sleep(0.03)
+    goodput.note("compute", 0.02)
+    time.sleep(0.02)
+    s = goodput.summary()
+    _assert_conserved(s)
+    assert s["buckets"]["checkpoint_save"] >= 0.02
+    assert s["buckets"]["idle"] > 0.0
+    assert s["elapsed_s"] >= 0.05
+
+
+def test_open_bracket_counts_up_to_now():
+    goodput.enable()
+    tok = goodput.begin("restore")
+    time.sleep(0.03)
+    s = goodput.summary()
+    _assert_conserved(s)
+    assert s["buckets"]["restore"] >= 0.025
+    goodput.end(tok)
+
+
+# ---------------------------------------------------------------------------
+# injected-badput chaos drills (the attribution acceptance oracle)
+# ---------------------------------------------------------------------------
+
+def test_preempt_drill_attributes_restart_badput(tmp_path):
+    """resilience.preempt fires -> run(resume_on_preempt=True) restores
+    the bundle in-process -> the downtime lands in the restart bucket
+    and tops the badput ranking, conservation intact."""
+    state = mx.resilience.TrainState(path=str(tmp_path / "b.bundle"))
+    state.step = 3
+    state.save()                      # bundle exists before the ledger
+    goodput.enable()
+    mx.fault.configure("resilience.preempt:at=1,times=1")
+    calls = []
+
+    def train_fn():
+        calls.append(1)
+        for s in (1, 2):
+            if mx.resilience.preempt_requested(step=s):
+                raise mx.resilience.Preempted(step=s, origin="injected")
+        goodput.note("compute", 0.001)
+        return "done"
+
+    assert mx.resilience.run(train_fn, state=state,
+                             resume_on_preempt=True) == "done"
+    assert len(calls) == 2            # preempted once, resumed once
+    s = goodput.summary()
+    _assert_conserved(s)
+    assert s["buckets"]["restart"] > 0.0
+    assert s["badput_top"][0][0] == "restart", s["badput_top"]
+    assert mx.fault.stats().get("injected.resilience.preempt") == 1
+
+
+class _ElasticFakeStep:
+    """Supervisor-shaped step: carries a mesh_config and rebuilds with a
+    measurable (20ms) transition, so restart badput is visible without
+    an 8-device mesh (the real-mesh drill runs in the goodput CI
+    stage)."""
+
+    def __init__(self, cfg):
+        self.mesh_config = cfg
+
+    def rebuild(self, cfg, sync=False):
+        time.sleep(0.02)
+        return _ElasticFakeStep(cfg)
+
+
+def test_host_loss_drill_attributes_restart_then_degraded_capacity():
+    """fleet.host_loss fires -> degrade dp2->dp1: the transition is
+    restart badput, every second at half capacity splits into
+    degraded_capacity until the re-expand restores the target layout."""
+    goodput.enable()
+    state = mx.resilience.TrainState()
+    sup = FleetSupervisor(_ElasticFakeStep(MeshConfig(dp=2)), state,
+                          n_hosts=2)
+    mx.fault.configure("fleet.host_loss:at=1")
+    assert sup.probe(1) is True       # degraded, not parked
+    assert sup.current == MeshConfig(dp=1)
+    time.sleep(0.05)                  # wall time at 50% capacity
+    mid = goodput.summary()
+    _assert_conserved(mid)
+    assert mid["capacity_ratio"] == pytest.approx(0.5)
+    assert mid["buckets"]["restart"] >= 0.015
+    assert mid["buckets"]["degraded_capacity"] >= 0.02
+    top = [kv[0] for kv in mid["badput_top"]]
+    assert set(top) <= {"restart", "degraded_capacity"}, mid["badput_top"]
+
+    sup.restore_hosts()
+    sup._maybe_reexpand()             # checkpoint boundary: re-expand
+    assert sup.current == MeshConfig(dp=2)
+    end = goodput.summary()
+    _assert_conserved(end)
+    assert end["capacity_ratio"] == pytest.approx(1.0)
+
+
+def test_prefetch_stall_drill_attributes_input_stall():
+    """pipeline.prefetch_stall wedges the producer -> the consumer's
+    measured stall flows through the input-stall histogram listener
+    into the ledger and tops the badput ranking."""
+    goodput.enable()
+    telemetry.enable()                # histogram feeds ride observe()
+    mx.fault.configure("pipeline.prefetch_stall:at=2,times=1")
+    src = [onp.full((4,), i, dtype=onp.float32) for i in range(5)]
+    pf = pipeline.DevicePrefetcher(iter(src), depth=2, stall_timeout=0.4)
+    out = [onp.asarray(b) for b in pf]
+    assert len(out) == 5
+    s = goodput.summary()
+    _assert_conserved(s)
+    assert s["buckets"]["input_stall"] >= 0.2
+    assert s["badput_top"][0][0] == "input_stall", s["badput_top"]
+
+
+def test_park_bracket_opens_and_closes():
+    goodput.enable()
+    state = mx.resilience.TrainState()
+    sup = FleetSupervisor(_ElasticFakeStep(MeshConfig(dp=2)), state,
+                          n_hosts=2, min_dp=2)
+    mx.fault.configure("fleet.host_loss:at=1")
+    assert sup.probe(1) is False and sup.parked
+    time.sleep(0.03)
+    mid = goodput.summary()
+    assert mid["buckets"]["parked"] >= 0.025
+    _assert_conserved(mid)
+    sup.restore_hosts()
+    parked_at_restore = goodput.summary()["buckets"]["parked"]
+    time.sleep(0.02)                  # bracket closed: parked stops
+    assert goodput.summary()["buckets"]["parked"] == pytest.approx(
+        parked_at_restore, abs=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# fleet merge + snapshots
+# ---------------------------------------------------------------------------
+
+def _host_snap(rank, devices, elapsed, buckets):
+    frac = buckets.get("compute", 0.0) / elapsed
+    return {"rank": rank, "pid": 1, "time": time.time(),
+            "summary": {"devices": devices, "elapsed_s": elapsed,
+                        "buckets": buckets, "goodput_fraction": frac,
+                        "conservation_error_s": 0.0,
+                        "late_dropped_s": 0.0}}
+
+
+def test_two_host_merge_capacity_weighting_oracle():
+    snaps = {0: _host_snap(0, 4, 10.0, {"compute": 8.0, "idle": 2.0}),
+             1: _host_snap(1, 2, 10.0, {"compute": 3.0, "restart": 2.0,
+                                        "idle": 5.0})}
+    m = goodput.merge_snapshots(snaps)
+    assert m["hosts"] == 2
+    # device-seconds: host0 weighs 4 devices, host1 weighs 2
+    assert m["device_seconds"]["compute"] == pytest.approx(8 * 4 + 3 * 2)
+    assert m["device_seconds"]["restart"] == pytest.approx(2 * 2)
+    assert m["elapsed_device_seconds"] == pytest.approx(10 * 4 + 10 * 2)
+    assert m["goodput_fraction"] == pytest.approx(38 / 60)
+    assert m["badput_top"][0][0] == "restart"
+
+
+def test_heartbeat_publishes_rate_limited_snapshot(tmp_path):
+    d = str(tmp_path)
+    goodput.enable()
+    goodput.note("compute", 0.01)
+    hp = HealthPlane(rank=0, nprocs=1, lease_dir=d)
+    assert hp.beat(step=1)
+    snaps = goodput.read_snapshots(d)
+    assert 0 in snaps and snaps[0]["summary"]["buckets"]["compute"] > 0
+    first = snaps[0]["time"]
+    assert hp.beat(step=2)            # inside goodput.snapshot_interval
+    assert goodput.read_snapshots(d)[0]["time"] == first  # rate-limited
+
+
+# ---------------------------------------------------------------------------
+# endpoint, healthz burn, run report
+# ---------------------------------------------------------------------------
+
+def test_goodput_endpoint_content_type():
+    goodput.enable()
+    telemetry.enable()
+    goodput.note("compute", 0.01)
+    srv = telemetry.serve_http(0)
+    port = srv.server_address[1]
+    try:
+        status, ctype, body = _get(port, "/goodput")
+    finally:
+        telemetry.stop_http()
+    assert status == 200
+    assert ctype == "application/json"
+    d = json.loads(body)
+    assert d["enabled"] is True
+    assert d["local"]["buckets"]["compute"] > 0
+    _assert_conserved(d["local"])
+
+
+def test_burn_rate_breach_flips_healthz_503():
+    goodput.enable()
+    telemetry.enable()
+    goodput.note("compute", 0.001)
+    mx.config.set("goodput.target", 0.95)   # ~all idle: burn >> 2
+    time.sleep(0.05)
+    burn = goodput.burn_rates()
+    assert burn and all(b > 2.0 for b in burn.values())
+    assert goodput.healthz()["ok"] is False
+    srv = telemetry.serve_http(0)
+    port = srv.server_address[1]
+    try:
+        status, _ctype, body = _get(port, "/healthz")
+    finally:
+        telemetry.stop_http()
+    assert status == 503
+    assert json.loads(body)["checks"]["goodput"]["ok"] is False
+    # clearing the objective clears the page
+    mx.config.set("goodput.target", 0.0)
+    assert goodput.healthz()["ok"] is True
+
+
+def test_training_telemetry_report_gains_goodput_plane(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    goodput.enable()
+    with telemetry.TrainingTelemetry(path=path, interval=2,
+                                     run_id="gp") as rep:
+        goodput.note("compute", 0.01)
+        for _ in range(2):
+            rep.step(loss=0.1)
+    report = telemetry.TrainingTelemetry.read(path)[-1]
+    assert report["type"] == "run_report"
+    plane = report["goodput"]
+    assert plane["buckets"]["compute"] > 0
+    _assert_conserved(plane)
+
+
+# ---------------------------------------------------------------------------
+# tools/goodput.py
+# ---------------------------------------------------------------------------
+
+def test_tools_goodput_cli_summary_and_validate(tmp_path):
+    d = str(tmp_path)
+    goodput.enable()
+    with goodput.phase("restart"):
+        time.sleep(0.02)
+    goodput.note("compute", 0.01)
+    goodput.write_snapshot(d, 0)
+    out = subprocess.run(
+        [sys.executable, "tools/goodput.py", "summary", d],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)["hosts"] == 1
+    ok = subprocess.run(
+        [sys.executable, "tools/goodput.py", "validate", d,
+         "--expect-badput", "restart"],
+        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stderr
+    assert json.loads(ok.stdout)["ok"] is True
+    bad = subprocess.run(
+        [sys.executable, "tools/goodput.py", "validate", d,
+         "--expect-badput", "input_stall"],
+        capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert json.loads(bad.stdout)["ok"] is False

@@ -611,3 +611,80 @@ def test_engine_healthz_tracks_step_loop(metrics):
     assert telemetry.health()[1]["serve"]["ok"] is True
     eng.stop()
     assert "serve" not in telemetry.health()[1]
+
+
+# -- SLO budgets + always-on phase reservoir (docs/OBSERVABILITY.md) --------
+
+def test_slo_violations_counted_and_burn_gauge(metrics):
+    prev = [mx.config.set("serve.slo_ttft_ms", 0.0001),
+            mx.config.set("serve.slo_tpot_ms", 0.0001),
+            mx.config.set("serve.slo_target", 0.9)]
+    try:
+        eng = _engine()
+        eng.submit([5, 9, 3], max_new_tokens=4)
+        eng.run()
+        counters = telemetry.counters()
+        viol = {k: v for k, v in counters.items()
+                if k.startswith("serve.slo_violations_total")}
+        assert sum(viol.values()) >= 1, counters
+        assert any('kind="ttft"' in k for k in viol), viol
+        burn = eng.slo_burn()
+        assert burn and max(burn.values()) > 2.0
+        slo = eng.stats()["slo"]
+        assert slo["violations"]["ttft"] >= 1
+        assert slo["burn"] == burn
+        # a hot burn rate flips the engine health check red
+        ok, checks = telemetry.health()
+        assert ok is False and checks["serve"]["state"] == "slo_burn"
+        eng.stop()
+    finally:
+        mx.config.set("serve.slo_ttft_ms", prev[0])
+        mx.config.set("serve.slo_tpot_ms", prev[1])
+        mx.config.set("serve.slo_target", prev[2])
+
+
+def test_slo_disarmed_by_default(metrics):
+    eng = _engine()
+    eng.submit([5, 9], max_new_tokens=2)
+    eng.run()
+    assert eng.slo_burn() == {}
+    assert "slo" not in eng.stats()
+    assert not any(k.startswith("serve.slo_violations_total")
+                   for k in telemetry.counters())
+    eng.stop()
+
+
+def test_phase_reservoir_without_tracer(metrics):
+    # stats()["phases"] populates from the bounded reservoir even when
+    # the request tracer is off
+    eng = _engine()
+    for _ in range(2):
+        eng.submit([5, 9, 3], max_new_tokens=3)
+    eng.run()
+    phases = eng.stats()["phases"]
+    for label in ("queue_wait", "prefill", "decode_per_token"):
+        assert phases[label] is not None, phases
+        assert phases[label]["p50"] >= 0.0
+    eng.stop()
+
+
+def test_phase_reservoir_disabled_and_bounded(metrics):
+    prev = mx.config.set("serve.phase_sampling", 0)
+    try:
+        eng = _engine()
+        eng.submit([5, 9], max_new_tokens=2)
+        eng.run()
+        assert all(v is None                   # off and no tracer
+                   for v in eng.stats()["phases"].values())
+        eng.stop()
+    finally:
+        mx.config.set("serve.phase_sampling", prev)
+    prev = mx.config.set("serve.phase_sampling", 2)
+    try:
+        eng = _engine()
+        req = eng.submit([5, 9, 3], max_new_tokens=6)
+        eng.run()
+        assert len(req.phases["decode_step"]) <= 2   # reservoir cap
+        eng.stop()
+    finally:
+        mx.config.set("serve.phase_sampling", prev)

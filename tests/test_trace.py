@@ -379,11 +379,17 @@ def test_e2e_serve_request_span_tree():
 
 
 def test_serve_phase_quantiles_absent_when_untraced():
-    mx.random.seed(0)
-    eng = mx.serve.load(_tiny_gpt(), max_slots=2, buckets="4,8")
-    eng.submit([5, 6, 7], max_new_tokens=3)
-    eng.run()
-    assert all(v is None for v in eng.stats()["phases"].values())
+    # with the always-on reservoir off (serve.phase_sampling=0), no
+    # tracer means no phase quantiles — the pre-reservoir contract
+    prev = mx.config.set("serve.phase_sampling", 0)
+    try:
+        mx.random.seed(0)
+        eng = mx.serve.load(_tiny_gpt(), max_slots=2, buckets="4,8")
+        eng.submit([5, 6, 7], max_new_tokens=3)
+        eng.run()
+        assert all(v is None for v in eng.stats()["phases"].values())
+    finally:
+        mx.config.set("serve.phase_sampling", prev)
 
 
 def _toy_data(n=32, d=8, classes=3, bs=16, seed=0):

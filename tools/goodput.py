@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Merge and validate mx.goodput fleet ledgers.
+
+Usage:
+    python tools/goodput.py summary  <lease_dir>
+    python tools/goodput.py validate <lease_dir> [--epsilon 0.05]
+                                     [--expect-badput STATE]
+
+``summary`` merges every ``goodput-<rank>.json`` snapshot in the lease
+dir into the capacity-weighted fleet device-second waterfall (the same
+merge ``GET /goodput`` serves) and prints it as one JSON document.
+
+``validate`` re-checks the conservation oracle on every host ledger
+(sum of buckets == elapsed wall clock within ``--epsilon`` seconds,
+late-dropped time included) and, with ``--expect-badput``, asserts the
+named state is the fleet's top attributed badput bucket — the
+postmortem.py-style CI hook the chaos drills call after injecting a
+known badput cause.
+
+Diagnostics go to stderr; stdout carries exactly one JSON document.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg):
+    print(f"goodput: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def _load(lease_dir):
+    from mxnet_tpu import goodput
+    if not os.path.isdir(lease_dir):
+        fail(f"{lease_dir!r} is not a directory")
+    snaps = goodput.read_snapshots(lease_dir)
+    if not snaps:
+        fail(f"no {goodput.SNAPSHOT_PREFIX}*.json snapshots in "
+             f"{lease_dir!r}")
+    return goodput, snaps
+
+
+def summary(lease_dir):
+    goodput, snaps = _load(lease_dir)
+    print(json.dumps(goodput.merge_snapshots(snaps)))
+    return 0
+
+
+def validate(lease_dir, epsilon=0.05, expect_badput=None):
+    goodput, snaps = _load(lease_dir)
+    problems = []
+    for rank, payload in sorted(snaps.items()):
+        s = payload.get("summary") or {}
+        err = float(s.get("conservation_error_s", float("inf")))
+        slack = epsilon + float(s.get("late_dropped_s", 0.0))
+        if err > slack:
+            problems.append(
+                f"rank {rank}: conservation violated — "
+                f"|elapsed - attributed| = {err:.6f}s > {slack:.6f}s")
+    merged = goodput.merge_snapshots(snaps)
+    top = [state for state, _sec in merged["badput_top"]]
+    if expect_badput and (not top or top[0] != expect_badput):
+        problems.append(
+            f"expected top badput {expect_badput!r}, ledger attributes "
+            f"{top or 'nothing'} (device-seconds: "
+            f"{merged['device_seconds']})")
+    out = {"ok": not problems, "hosts": merged["hosts"],
+           "goodput_fraction": merged["goodput_fraction"],
+           "badput_top": merged["badput_top"], "problems": problems}
+    print(json.dumps(out))
+    if problems:
+        for p in problems:
+            print(f"goodput: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge/validate mx.goodput fleet ledger snapshots")
+    ap.add_argument("command", choices=["summary", "validate"])
+    ap.add_argument("path", help="fleet lease dir holding "
+                                 "goodput-<rank>.json snapshots")
+    ap.add_argument("--epsilon", type=float, default=0.05,
+                    help="conservation tolerance in seconds (on top of "
+                         "each ledger's accounted late-dropped time)")
+    ap.add_argument("--expect-badput", default=None, metavar="STATE",
+                    help="validate: require this state to be the "
+                         "fleet's top attributed badput bucket")
+    args = ap.parse_args(argv)
+    if args.command == "summary":
+        return summary(args.path)
+    return validate(args.path, epsilon=args.epsilon,
+                    expect_badput=args.expect_badput)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
