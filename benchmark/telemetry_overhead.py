@@ -108,6 +108,22 @@ def _stream_loop(a, n, probes_per_op, note_served):
     return time.perf_counter() - t0
 
 
+def _servefleet_loop(a, n, probes_per_op, servefleet):
+    """Same shape, probing the mx.servefleet disabled gate instead (the
+    pattern ServeEngine.step runs once per decode step when no fleet
+    group exists in the process)."""
+    t0 = time.perf_counter()
+    out = a
+    probe = range(probes_per_op)
+    for _ in range(n):
+        out = out + a
+        for _ in probe:
+            if servefleet._active:  # the hook pattern under test
+                servefleet.note_step(None)
+    out._data.block_until_ready()
+    return time.perf_counter() - t0
+
+
 def _goodput_loop(a, n, probes_per_op, goodput):
     """Same shape, probing the mx.goodput disabled gate instead (the
     pattern every ledger claim site uses)."""
@@ -136,7 +152,7 @@ def _trace_enabled_loop(a, n, trace):
 
 def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     import mxnet_tpu as mx
-    from mxnet_tpu import blackbox, goodput, telemetry, trace
+    from mxnet_tpu import blackbox, goodput, servefleet, telemetry, trace
     from mxnet_tpu.autotune.kernels import resolve_blocks, _TUNED
     from mxnet_tpu.stream import _note_served
 
@@ -146,12 +162,14 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     goodput.disable()
     assert not telemetry.active() and not trace.active() \
         and not blackbox.active() and not goodput.active()
+    assert not servefleet._active, \
+        "servefleet gate measures the no-fleet path"
     assert not _TUNED, "resolve_blocks gate measures the UNTUNED path"
     a = mx.np.ones((8, 8))
     _loop(a, 200, 0, telemetry)          # warmup: compile + caches hot
     resolve_blocks("flash_attention", (256, 256, 64))  # static table fill
     base_s, probed_s, tprobed_s, bprobed_s = [], [], [], []
-    rprobed_s, sprobed_s, gprobed_s, ton_s = [], [], [], []
+    rprobed_s, sprobed_s, gprobed_s, fprobed_s, ton_s = [], [], [], [], []
     for _ in range(repeats):
         base_s.append(_loop(a, n, 0, telemetry))
         probed_s.append(_loop(a, n, probes_per_op, telemetry))
@@ -160,6 +178,7 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
         rprobed_s.append(_resolve_loop(a, n, probes_per_op, resolve_blocks))
         sprobed_s.append(_stream_loop(a, n, probes_per_op, _note_served))
         gprobed_s.append(_goodput_loop(a, n, probes_per_op, goodput))
+        fprobed_s.append(_servefleet_loop(a, n, probes_per_op, servefleet))
         trace.enable(buffer=max(1024, n))
         ton_s.append(_trace_enabled_loop(a, n, trace))
         trace.disable()
@@ -171,6 +190,7 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     rprobed = statistics.median(rprobed_s)
     sprobed = statistics.median(sprobed_s)
     gprobed = statistics.median(gprobed_s)
+    fprobed = statistics.median(fprobed_s)
     ton = statistics.median(ton_s)
     # cost of the K probes, scaled to the ~1 probe a real dispatch adds
     per_probe = max(0.0, probed - base) / probes_per_op
@@ -179,12 +199,14 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
     per_resolve_probe = max(0.0, rprobed - base) / probes_per_op
     per_stream_probe = max(0.0, sprobed - base) / probes_per_op
     per_goodput_probe = max(0.0, gprobed - base) / probes_per_op
+    per_servefleet_probe = max(0.0, fprobed - base) / probes_per_op
     ratio = per_probe / base
     trace_ratio = per_trace_probe / base
     blackbox_ratio = per_blackbox_probe / base
     resolve_ratio = per_resolve_probe / base
     stream_ratio = per_stream_probe / base
     goodput_ratio = per_goodput_probe / base
+    servefleet_ratio = per_servefleet_probe / base
     return {"ops": n, "probes_per_op": probes_per_op, "repeats": repeats,
             "baseline_s": round(base, 6), "probed_s": round(probed, 6),
             "trace_probed_s": round(tprobed, 6),
@@ -192,6 +214,7 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
             "resolve_probed_s": round(rprobed, 6),
             "stream_probed_s": round(sprobed, 6),
             "goodput_probed_s": round(gprobed, 6),
+            "servefleet_probed_s": round(fprobed, 6),
             "trace_enabled_s": round(ton, 6),
             "per_op_probe_overhead_ns": round(per_probe / n * 1e9, 2),
             "per_op_trace_probe_overhead_ns":
@@ -204,17 +227,21 @@ def run(n=2000, probes_per_op=32, repeats=7, budget=0.02):
                 round(per_stream_probe / n * 1e9, 2),
             "per_op_goodput_probe_overhead_ns":
                 round(per_goodput_probe / n * 1e9, 2),
+            "per_op_servefleet_probe_overhead_ns":
+                round(per_servefleet_probe / n * 1e9, 2),
             "overhead_ratio": round(ratio, 6),
             "trace_overhead_ratio": round(trace_ratio, 6),
             "blackbox_overhead_ratio": round(blackbox_ratio, 6),
             "resolve_overhead_ratio": round(resolve_ratio, 6),
             "stream_overhead_ratio": round(stream_ratio, 6),
             "goodput_overhead_ratio": round(goodput_ratio, 6),
+            "servefleet_overhead_ratio": round(servefleet_ratio, 6),
             "trace_enabled_ratio": round(max(0.0, ton - base) / base, 6),
             "budget": budget,
             "ok": ratio < budget and trace_ratio < budget
                   and blackbox_ratio < budget and resolve_ratio < budget
-                  and stream_ratio < budget and goodput_ratio < budget}
+                  and stream_ratio < budget and goodput_ratio < budget
+                  and servefleet_ratio < budget}
 
 
 def main(argv=None):
@@ -245,6 +272,8 @@ def main(argv=None):
               f"{r['stream_probed_s'] * 1e3:9.2f} ms")
         print(f"with {r['probes_per_op']}x disabled goodput probes/op "
               f"{r['goodput_probed_s'] * 1e3:9.2f} ms")
+        print(f"with {r['probes_per_op']}x disabled servefleet probes/op "
+              f"{r['servefleet_probed_s'] * 1e3:9.2f} ms")
         print(f"with tracing ENABLED (1 span/op) "
               f"{r['trace_enabled_s'] * 1e3:9.2f} ms "
               f"(+{r['trace_enabled_ratio'] * 100:.2f}%, informational)")
@@ -265,12 +294,16 @@ def main(argv=None):
         print(f"goodput overhead ratio   "
               f"{r['goodput_overhead_ratio'] * 100:9.4f} % "
               f"(budget {r['budget'] * 100:g}%)")
+        print(f"servefleet overhead ratio "
+              f"{r['servefleet_overhead_ratio'] * 100:9.4f} % "
+              f"(budget {r['budget'] * 100:g}%)")
     if not r["ok"]:
         print("FAIL: a disabled observability fast path exceeds the "
               "overhead budget", file=sys.stderr)
         return 1
     print("OK: disabled telemetry + trace + blackbox + untuned "
-          "resolve_blocks + stream + goodput fast paths within budget")
+          "resolve_blocks + stream + goodput + servefleet fast paths "
+          "within budget")
     return 0
 
 

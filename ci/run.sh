@@ -95,6 +95,17 @@
 #                disabled-fast-path budget (<2%) is re-enforced with
 #                the ledger compiled in (docs/OBSERVABILITY.md
 #                "Goodput & SLO budgets")
+#   servefleet - multi-replica serving control-plane suite
+#                (rendezvous session-affinity routing, crash/stall
+#                failover with exactly-once re-dispatch, rolling
+#                weight updates with canary auto-rollback, SLO-driven
+#                scaling) + the 3-process chaos drill: SIGKILL a
+#                replica mid-stream, lease-expiry detection, rolling
+#                update under live traffic, bad-canary rollback —
+#                gated on SERVEFLEET_DRILL_OK (docs/SERVING.md
+#                "Multi-replica serving"); the disabled-fast-path
+#                budget (<2%) is re-enforced with the fleet hook
+#                compiled in
 #   lint       - framework-aware static analysis (tools/mxlint.py):
 #                trace-safety, donated-buffer, lock-order and registry
 #                drift rules over the whole tree, gated on ZERO new
@@ -108,7 +119,7 @@
 # The stage x platform matrix (what the reference spreads across
 # Jenkinsfiles) is ci/matrix.yaml; 'all' runs the PR-blocking set.
 #
-# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|insight|blackbox|stream|lint|nightly|tpu|all]
+# Usage: ci/run.sh [sanity|unit|native|contracts|chaos|telemetry|resilience|pipeline|zero|mesh|serve|autotune|quantize|trace|insight|blackbox|stream|goodput|servefleet|lint|nightly|tpu|all]
 set -e
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -540,6 +551,20 @@ stream() {
         | grep -q "STREAM_DRILL_OK"
 }
 
+servefleet() {
+    echo "== servefleet: multi-replica serving control plane suite (docs/SERVING.md \"Multi-replica serving\") =="
+    # the tier-1 sweep keeps a fast core of this file; the dedicated
+    # stage runs the whole surface including the slow bucket
+    MXNET_TEST_SLOW=1 python -m pytest tests/test_servefleet.py -q
+    echo "== servefleet: 3-process chaos drill — SIGKILL failover, rolling update, bad-canary rollback =="
+    tmp=$(mktemp -d)
+    JAX_PLATFORMS=cpu python tests/servefleet_worker.py drive "$tmp" \
+        | tee /dev/stderr | grep -q "SERVEFLEET_DRILL_OK"
+    rm -rf "$tmp"
+    echo "== servefleet: disabled fast-path overhead budget (<2%) with the fleet hook compiled in =="
+    JAX_PLATFORMS=cpu python benchmark/telemetry_overhead.py
+}
+
 goodput() {
     echo "== goodput: wall-clock ledger / badput attribution / SLO burn suite (docs/OBSERVABILITY.md \"Goodput & SLO budgets\") =="
     python -m pytest tests/test_goodput.py -q
@@ -682,9 +707,10 @@ case "$stage" in
     blackbox) blackbox ;;
     stream) stream ;;
     goodput) goodput ;;
+    servefleet) servefleet ;;
     lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; blackbox; stream; goodput; lint ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; blackbox; stream; goodput; servefleet; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

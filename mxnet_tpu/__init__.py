@@ -72,6 +72,7 @@ from . import resilience
 from . import stream
 from . import fleet
 from . import serve
+from . import servefleet
 from . import autotune
 from . import storage
 from . import callback

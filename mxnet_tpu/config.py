@@ -497,6 +497,39 @@ declare("serve.phase_sampling", int, 64, "MXNET_SERVE_PHASE_SAMPLING",
         "(queue_wait/prefill/decode_step) kept for stats()['phases'] "
         "without the tracer armed; 0 restores the trace-only "
         "behaviour (one attribute read on the disabled path).")
+declare("servefleet.min_replicas", int, 1, "MXNET_SERVEFLEET_MIN_REPLICAS",
+        "Floor on live serving replicas a mx.servefleet group may drop "
+        "to: rolling weight updates take replicas out one at a time "
+        "only while the rest stay at or above this floor, and the "
+        "scale-in path refuses to drain below it.")
+declare("servefleet.max_replicas", int, 0, "MXNET_SERVEFLEET_MAX_REPLICAS",
+        "Ceiling the SLO-driven scale-out path may grow a mx.servefleet "
+        "group to (unparking parked replicas first, then building new "
+        "engines); 0 caps at the replica count the fleet was "
+        "constructed with.")
+declare("servefleet.stall_deadline", float, 2.0,
+        "MXNET_SERVEFLEET_STALL_DEADLINE",
+        "Seconds a replica's engine may sit with pending work and no "
+        "decode-step progress before the fleet supervisor declares it "
+        "stalled and fails its requests over to the survivors (the "
+        "serve.replica_stall drill drives this path).")
+declare("servefleet.scale_patience", int, 3,
+        "MXNET_SERVEFLEET_SCALE_PATIENCE",
+        "Consecutive supervisor ticks an SLO burn-rate breach (scale "
+        "out) or an occupancy-floor underrun (scale in) must persist "
+        "before mx.servefleet acts — and the cooldown ticks after an "
+        "action before it will act again.")
+declare("servefleet.occupancy_floor", float, 0.25,
+        "MXNET_SERVEFLEET_OCCUPANCY_FLOOR",
+        "Mean slot occupancy across live replicas below which the "
+        "mx.servefleet autoscaler drains and parks one replica "
+        "(never below servefleet.min_replicas).")
+declare("servefleet.canary_tokens", int, 8,
+        "MXNET_SERVEFLEET_CANARY_TOKENS",
+        "Greedy tokens generated per pinned canary prompt when a "
+        "rolling weight update validates a replica's freshly loaded "
+        "checkpoint before returning it to the router; divergence "
+        "from the checkpoint's canary card triggers auto-rollback.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

@@ -109,6 +109,21 @@ POINTS = {
         "stream.open_backoff) counts stream.open_retries_total, and "
         "exhausting the budget escalates a WorkerLost-style "
         "ShardUnreadable — a structured failure, never a hang",
+    "serve.replica_crash":
+        "a serving replica's host dies mid-stream (probed once per "
+        "fleet supervisor tick): the mx.servefleet router marks the "
+        "replica dead, its KV slots are gone, and every incomplete "
+        "request re-dispatches to a survivor under its idempotency "
+        "key, re-prefilling from the original prompt — no accepted "
+        "request is dropped or double-completed",
+    "serve.replica_stall":
+        "a serving replica's step loop wedges while its lease stays "
+        "fresh (probed once per fleet supervisor tick): after "
+        "servefleet.stall_deadline without decode progress the "
+        "supervisor declares it dead, re-dispatches its requests, and "
+        "then drains the already-dispatched device work — any late "
+        "completion racing the re-dispatch is suppressed by the "
+        "idempotency ledger",
     "insight.drift":
         "one observed step-time sample is stretched 3x (probed at "
         "every insight drift-feed sample): the EWMA+MAD detector must "

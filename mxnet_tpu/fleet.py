@@ -184,6 +184,7 @@ class HealthPlane:
         self._stragglers: set[int] = set()
         self._stop = threading.Event()
         self._thread = None
+        self._thread_lock = threading.Lock()
 
     # -- lease publication ----------------------------------------------
 
@@ -357,15 +358,26 @@ class HealthPlane:
 
     def start(self):
         """Register the /healthz provider and start the daemon renewal
-        thread (one :meth:`beat` per ``fleet.lease_interval``)."""
+        thread (one :meth:`beat` per ``fleet.lease_interval``).
+        Idempotent while the thread runs; safe to call in a tight
+        stop()/start() loop — every start gets a FRESH stop event, so a
+        previous loop that outlived its join timeout can never be
+        revived by the new start clearing a shared event (the old
+        thread-leak bug: two renewal loops beating the same lease)."""
         _telemetry.register_health("fleet", self.healthz)
-        if self._thread is None:
-            self._stop.clear()
+        with self._thread_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            stop_evt = self._stop = threading.Event()
 
             def _loop():
-                while not self._stop.is_set():
+                # close over THIS start's event: once stop() swaps in a
+                # new one, this loop only ever sees its own, already-set
+                # event and exits even if the join that retired it
+                # timed out
+                while not stop_evt.is_set():
                     self.beat()
-                    self._stop.wait(self.interval)
+                    stop_evt.wait(self.interval)
 
             self._thread = threading.Thread(
                 target=_loop, name="mx-fleet-heartbeat", daemon=True)
@@ -373,12 +385,23 @@ class HealthPlane:
         return self
 
     def stop(self):
-        """Clean exit: stop renewing, withdraw the lease file (so peers
-        see a departure, not a loss), unregister from /healthz."""
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        """Clean exit: stop renewing, join the renewal thread, withdraw
+        the lease file (so peers see a departure, not a loss),
+        unregister from /healthz.  Idempotent — a double stop is a
+        no-op — and a thread that fails to join inside the timeout is
+        kept referenced (never orphaned with a live shared event), so
+        restart loops cannot leak renewal threads."""
+        with self._thread_lock:
+            self._stop.set()
+            thread = self._thread
+        if thread is not None:
+            # join OUTSIDE the lock: a start() racing this stop must
+            # never deadlock behind a slow join
+            thread.join(timeout=5.0)
+            if not thread.is_alive():
+                with self._thread_lock:
+                    if self._thread is thread:
+                        self._thread = None
         _telemetry.unregister_health("fleet")
         if self.lease_dir:
             try:

@@ -7,14 +7,18 @@ Three planes (docs/OBSERVABILITY.md "Goodput & SLO budgets"):
   every wall-clock second of a run to exactly one state (``compute``,
   ``input_stall``, ``h2d``, ``compile``, ``checkpoint_save``,
   ``restore``, ``restart``, ``parked``, ``retune``, ``drain``,
-  ``idle``) plus a capacity axis (``degraded_capacity``: running at
+  ``rollover``, ``idle``) plus a capacity axis (``degraded_capacity``: running at
   dp2 when the target layout is dp4 counts 50% of every wall-second
   as badput, scaled from the live/target ``MeshConfig`` sizes).
   Feeds are the planes that already exist: the step-time and
   input-stall histograms (via :func:`telemetry.add_sample_listener`),
   ``TrainState.save``/``load_latest_valid`` brackets,
   ``FleetSupervisor`` degrade/park/re-expand transitions, ``Retuner``
-  re-searches and the serve drain path.  Overlaps are resolved by a
+  re-searches, the serve drain path, and ``mx.servefleet`` rolling
+  weight updates (``rollover`` brackets the whole drain → reload →
+  re-warmup → canary window per replica, outranking the nested drain
+  and compile claims so update downtime is attributed, not lost).
+  Overlaps are resolved by a
   fixed priority order (:data:`PRIORITY`) and un-claimed time is
   ``idle``, so the **conservation oracle** — sum of buckets ==
   elapsed wall clock — holds by construction, epsilon-bounded only by
@@ -86,8 +90,8 @@ _telemetry.declare_metric(
 #: never claimed, it is whatever no feed accounted for — and
 #: ``degraded_capacity`` is the capacity axis, split off every state
 #: but ``parked`` while the live mesh is smaller than the target.
-PRIORITY = ("restart", "restore", "checkpoint_save", "parked", "retune",
-            "drain", "compile", "input_stall", "h2d", "compute")
+PRIORITY = ("restart", "restore", "rollover", "checkpoint_save", "parked",
+            "retune", "drain", "compile", "input_stall", "h2d", "compute")
 
 #: Every bucket a summary can contain.
 STATES = PRIORITY + ("degraded_capacity", "idle")

@@ -44,6 +44,7 @@ from .. import goodput as _goodput
 from .. import insight as _insight
 from .. import pipeline as _pipeline
 from .. import profiler as _profiler
+from .. import servefleet as _servefleet
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..base import MXNetError
@@ -143,15 +144,22 @@ class EngineBusy(MXNetError):
     stopping, or the bounded queue (``serve.max_queue``) is full.
     Structured so callers can backpressure instead of string-matching:
     ``reason`` ("stopping" / "queue_full"), ``queued`` (depth at
-    rejection), ``max_queue`` (the bound; 0 = unbounded)."""
+    rejection), ``max_queue`` (the bound; 0 = unbounded), and
+    ``retry_after_hint`` — the machine-readable backoff in seconds
+    (queue depth x the engine's observed TPOT p50), so a router retries
+    when a slot is plausibly free instead of hammering a saturated
+    replica."""
 
-    def __init__(self, reason, queued, max_queue):
+    def __init__(self, reason, queued, max_queue, retry_after_hint=0.0):
         self.reason = reason
         self.queued = queued
         self.max_queue = max_queue
+        self.retry_after_hint = float(retry_after_hint)
         bound = f", bound {max_queue} (serve.max_queue)" if max_queue else ""
+        hint = (f", retry after ~{self.retry_after_hint:.3f}s"
+                if self.retry_after_hint else "")
         super().__init__(
-            f"serve engine busy ({reason}): {queued} queued{bound}")
+            f"serve engine busy ({reason}): {queued} queued{bound}{hint}")
 
 
 class Request:
@@ -165,7 +173,8 @@ class Request:
     """
 
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "generated",
-                 "slot", "finished", "t_submit", "t_admitted", "t_first",
+                 "slot", "finished", "rejected", "reject_reason",
+                 "t_submit", "t_admitted", "t_first",
                  "t_done", "phases", "_span", "_enq")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id=None):
@@ -176,6 +185,11 @@ class Request:
         self.generated = []
         self.slot = None
         self.finished = False
+        #: structured rejection marker: a queued request discarded by
+        #: stop(drain=False) flips this True (with reject_reason set)
+        #: so a waiting caller observes the outcome instead of hanging
+        self.rejected = False
+        self.reject_reason = None
         self.t_submit = time.perf_counter()
         self.t_admitted = None
         self.t_first = None
@@ -293,14 +307,10 @@ class ServeEngine:
         self._ensure_initialized()
         params = _functional.param_arrays(model)
         self.quantize, weight_mode, kv_int8 = _parse_quantize(quantize)
+        self._weight_mode = weight_mode
         if kv_int8:
             cache_dtype = "int8"
-        if weight_mode == "int8_weights":
-            pt, qt, qdt = _quantize.quantize_params_int8(params)
-        elif weight_mode == "int4_weights":
-            pt, qt, qdt = _quantize.quantize_params_int4(params)
-        else:
-            pt, qt, qdt = params, {}, {}
+        pt, qt, qdt = self._quantize_weights(params)
         self._params = (pt, qt)
         self._qdtypes = qdt
         if _telemetry._active and weight_mode:
@@ -347,9 +357,15 @@ class ServeEngine:
         self._slo_tpot = float(_config.get("serve.slo_tpot_ms")) / 1e3
         self._slo_events = collections.deque(maxlen=2048)
         self._phase_cap = int(_config.get("serve.phase_sampling"))
-        # the ops endpoint's /healthz reflects THIS engine's step-loop
-        # liveness (a process hosts one serving engine; the newest wins).
-        # Bound weakly: a collected engine must not pin a stale check.
+        self._register_health()
+
+    def _register_health(self):
+        """Register this engine's /healthz provider. The ops endpoint's
+        /healthz reflects THIS engine's step-loop liveness (a process
+        hosts one serving engine; the newest wins).  Bound weakly: a
+        collected engine must not pin a stale check.  Re-invoked by
+        :meth:`resume` after a rolling weight update's drain/stop cycle
+        unregistered the provider."""
         import weakref
         ref = weakref.ref(self)
 
@@ -363,6 +379,18 @@ class ServeEngine:
         self._health_name = _telemetry.register_health("serve", _check)
 
     # -- model/param plumbing -------------------------------------------
+
+    def _quantize_weights(self, params):
+        """Run the engine's configured weight-storage mode over a flat
+        ``{name: array}`` tree -> ``(passthrough, quantized, qdtypes)``.
+        Shared by __init__ and :meth:`update_weights` so a weight swap
+        reproduces the storage layout the AOT executables were compiled
+        against."""
+        if self._weight_mode == "int8_weights":
+            return _quantize.quantize_params_int8(params)
+        if self._weight_mode == "int4_weights":
+            return _quantize.quantize_params_int4(params)
+        return params, {}, {}
 
     def _ensure_initialized(self):
         """Materialize deferred params with one tiny eager forward —
@@ -511,11 +539,13 @@ class ServeEngine:
         if self._stopping:
             if _telemetry._active:
                 _telemetry.inc("serve.rejected_total", reason="stopping")
-            raise EngineBusy("stopping", len(self._queue), self._max_queue)
+            raise EngineBusy("stopping", len(self._queue), self._max_queue,
+                             retry_after_hint=self._retry_after_hint())
         if self._max_queue and len(self._queue) >= self._max_queue:
             if _telemetry._active:
                 _telemetry.inc("serve.rejected_total", reason="queue_full")
-            raise EngineBusy("queue_full", len(self._queue), self._max_queue)
+            raise EngineBusy("queue_full", len(self._queue), self._max_queue,
+                             retry_after_hint=self._retry_after_hint())
         req = Request(self._next_id, prompt, max_new_tokens,
                       self.eos_id if eos_id == "engine" else eos_id)
         self._next_id += 1
@@ -662,6 +692,8 @@ class ServeEngine:
             self._params, self._cache, self._state)
         dt = time.perf_counter() - t0
         self._steps += 1
+        if _servefleet._active:
+            _servefleet.note_step(self)
         if _telemetry._active:
             _telemetry.inc("serve.steps_total")
             _telemetry.observe("serve.step_seconds", dt)
@@ -761,6 +793,65 @@ class ServeEngine:
             _telemetry.unregister_health(self._health_name)
         return self
 
+    # -- rolling weight updates (mx.servefleet) --------------------------
+
+    def update_weights(self, params):
+        """Swap the engine's weights in place with a new flat
+        ``{name: jax.Array}`` tree (the :func:`mxnet_tpu.functional.
+        param_arrays` layout) and return the previous ``(passthrough,
+        quantized)`` tuple for :meth:`restore_weights` rollback.
+
+        The new tree is pushed through the SAME quantization mode the
+        engine was built with and validated structurally — names, shapes
+        and dtypes must match what the AOT executables were compiled
+        against, so the swap never invalidates the compiled grid and a
+        subsequent :meth:`warmup` is a cache hit (zero compiles).  The
+        KV cache is untouched: callers drain in-flight requests first
+        (``stop(drain=True)``) because tokens decoded under the old
+        weights must not continue under the new ones."""
+        pt, qt, qdt = self._quantize_weights(dict(params))
+        old_pt, old_qt = self._params
+
+        def _sig(tree):
+            return {k: (tuple(v.shape), str(v.dtype))
+                    for k, v in tree.items()}
+        for label, new, old in (("passthrough", pt, old_pt),
+                                ("quantized", qt, old_qt)):
+            if _sig(new) != _sig(old):
+                missing = sorted(set(old) - set(new))
+                extra = sorted(set(new) - set(old))
+                changed = sorted(
+                    k for k in set(new) & set(old)
+                    if (tuple(new[k].shape), str(new[k].dtype))
+                    != (tuple(old[k].shape), str(old[k].dtype)))
+                raise MXNetError(
+                    f"update_weights: incoming {label} params do not "
+                    f"match the tree the engine compiled against "
+                    f"(missing={missing[:4]}, extra={extra[:4]}, "
+                    f"changed={changed[:4]}) — the compiled grid would "
+                    "be invalid; build a fresh engine for a different "
+                    "architecture")
+        self._params = (pt, qt)
+        self._qdtypes = qdt
+        return (old_pt, old_qt)
+
+    def restore_weights(self, old):
+        """Roll back to a ``(passthrough, quantized)`` tuple previously
+        returned by :meth:`update_weights` — the canary auto-rollback
+        path.  No validation: the tuple came from this engine."""
+        self._params = old
+        return self
+
+    def resume(self):
+        """Re-open a drained engine after a rolling weight update:
+        clears the stopping latch (submit() admits again) and
+        re-registers the /healthz provider that :meth:`stop`
+        unregistered.  The compiled grid, KV cache and slot machinery
+        are untouched."""
+        self._stopping = False
+        self._register_health()
+        return self
+
     def _slo_observe(self, kind, violated):
         """Account one request against the declared SLO objective of
         ``kind`` — the drain-time observation point the burn gauge and
@@ -794,9 +885,27 @@ class ServeEngine:
                                      round(burn, 4), kind=kind)
         return out
 
+    def _tpot_p50(self):
+        """Observed TPOT p50 over the most recent completions — the unit
+        of the EngineBusy ``retry_after_hint``. Falls back to the armed
+        SLO objective (the declared cadence) before any request has
+        finished, then to a conservative 20ms guess."""
+        tpots = sorted(r.tpot for r in self._completed[-256:]
+                       if r.tpot is not None)
+        if tpots:
+            return tpots[len(tpots) // 2]
+        return self._slo_tpot if self._slo_tpot else 0.02
+
+    def _retry_after_hint(self):
+        return self._tpot_p50() * max(1, len(self._queue))
+
     def _reject(self, req, reason):
         """Account a queued request discarded by stop(drain=False): its
-        spans close (rejected=True) and it never reaches a slot."""
+        spans close (rejected=True), ``req.rejected``/``req.reject_reason``
+        flip so a waiting caller observes a structured outcome, and it
+        never reaches a slot."""
+        req.rejected = True
+        req.reject_reason = reason
         if req._enq is not None:
             req._enq.end()
             req._enq = None

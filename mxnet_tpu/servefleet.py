@@ -1,0 +1,891 @@
+"""mx.servefleet — multi-replica serving control plane.
+
+Reference parity: none — the reference stops at the single-process
+engine.  Production serving needs the layer above it: N replicas of ONE
+model behind a router, surviving the three events that kill a naive
+deployment:
+
+- **Failover.**  Sessions ride consistent-hash (rendezvous/HRW)
+  affinity: when a replica dies (``serve.replica_crash``) or wedges
+  while its lease stays fresh (``serve.replica_stall``), only THAT
+  replica's sessions move.  Every incomplete request re-dispatches to a
+  survivor under its idempotency key, re-prefilling from the original
+  prompt — the KV cache died with the replica.  A late completion
+  racing the re-dispatch (the stalled engine's already-dispatched
+  device work is drained AFTER re-dispatch, deliberately) is suppressed
+  by the completion ledger: every accepted request completes exactly
+  once, never zero, never twice.
+- **Rolling weight updates.**  A training fleet publishes a checkpoint
+  (:func:`publish_checkpoint` — staged tmp+rename, never a torn read);
+  :meth:`ServeFleet.rolling_update` walks the replicas one at a time:
+  drain (``stop(drain=True)``), swap weights in place
+  (:meth:`~mxnet_tpu.serve.engine.ServeEngine.update_weights` — same
+  quantize mode, validated shapes, so the AOT grid stays hot),
+  re-``warmup()`` (a cache hit: zero compiles), then a greedy-parity
+  canary on pinned prompts against the checkpoint's canary card.  A
+  divergent canary or ANY post-warmup compile auto-rolls the replica
+  back to the old weights and aborts the rollout — the group never
+  drops below ``servefleet.min_replicas`` live replicas.
+- **SLO-driven scaling.**  The supervisor tick watches the per-engine
+  error-budget burn gauges (PR 17): sustained burn past
+  ``goodput.burn_threshold`` scales out (unpark first, then build up to
+  ``servefleet.max_replicas``); sustained occupancy under
+  ``servefleet.occupancy_floor`` drains and parks a replica, never
+  below the floor.  ``servefleet.scale_patience`` debounces both
+  directions and doubles as the post-action cooldown.
+
+Every replica holds a :class:`~mxnet_tpu.fleet.HealthPlane` lease when
+the fleet is built with a ``lease_dir`` — the same file-backed lease
+the training fleet uses — so a multi-process drill
+(tests/servefleet_worker.py) detects a SIGKILLed replica by lease
+expiry exactly like ``fleet.host_loss``.
+
+Disabled cost: the only hot-path hook is one module-attribute read in
+``ServeEngine.step`` (``if _servefleet._active: note_step(engine)``) —
+the same discipline as mx.fault/mx.goodput, re-gated by
+benchmark/telemetry_overhead.py.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import time
+import weakref
+
+from . import config as _config
+from . import fault as _fault
+from . import fleet as _fleet
+from . import goodput as _goodput
+from . import telemetry as _telemetry
+from . import trace as _trace
+from .base import MXNetError
+
+__all__ = ["ServeFleet", "FleetRequest", "Replica", "rendezvous_route",
+           "canary_card", "publish_checkpoint", "load_checkpoint",
+           "note_step", "endpoint_report"]
+
+_telemetry.declare_metric(
+    "servefleet.replicas_live", "gauge",
+    "serving replicas currently live (routable) in the fleet group")
+_telemetry.declare_metric(
+    "servefleet.requests_total", "counter",
+    "requests accepted by the fleet router (each carries an idempotency "
+    "key; duplicate submits of the same key are absorbed, not re-run)")
+_telemetry.declare_metric(
+    "servefleet.completed_total", "counter",
+    "fleet requests whose FIRST completion was recorded in the ledger — "
+    "exactly one per accepted request, however many replicas raced it")
+_telemetry.declare_metric(
+    "servefleet.failovers_total", "counter",
+    "replicas declared dead by the supervisor, by cause (crash: lease "
+    "expiry / serve.replica_crash; stall: no decode progress past "
+    "servefleet.stall_deadline with a fresh lease)")
+_telemetry.declare_metric(
+    "servefleet.redispatched_total", "counter",
+    "incomplete requests re-dispatched from a dead replica to a "
+    "survivor under their idempotency key (re-prefilled from the "
+    "original prompt — the KV died with the replica)")
+_telemetry.declare_metric(
+    "servefleet.duplicates_suppressed_total", "counter",
+    "late completions discarded by the idempotency ledger because the "
+    "request already completed elsewhere (a stalled replica's drained "
+    "device work racing its own re-dispatch)")
+_telemetry.declare_metric(
+    "servefleet.rolling_updates_total", "counter",
+    "replicas successfully rolled to a new weight generation (drain -> "
+    "in-place swap -> re-warmup with zero compiles -> canary parity)")
+_telemetry.declare_metric(
+    "servefleet.rollbacks_total", "counter",
+    "rolling updates auto-rolled back on this replica: greedy canary "
+    "diverged from the checkpoint's card, or re-warmup compiled")
+_telemetry.declare_metric(
+    "servefleet.scale_events_total", "counter",
+    "autoscaler actions, by dir (out: sustained SLO burn past "
+    "goodput.burn_threshold; in: sustained occupancy under "
+    "servefleet.occupancy_floor)")
+_telemetry.declare_metric(
+    "servefleet.router_moves_total", "counter",
+    "sessions whose rendezvous-hash route changed replica (failover or "
+    "scaling) — affinity means this stays near zero in steady state")
+
+#: hot-path gate — ``ServeEngine.step`` reads this one attribute per
+#: decode step; False (no fleet constructed) keeps the hook a no-op
+_active = False
+#: id(engine) -> Replica, the step-progress watch the stall detector
+#: reads (see :func:`note_step`)
+_watch: dict[int, "Replica"] = {}
+#: live fleets, for the /servefleet ops endpoint
+_fleets: "weakref.WeakSet[ServeFleet]" = weakref.WeakSet()
+
+CHECKPOINT_FORMAT = "mx.servefleet.checkpoint.v1"
+
+
+def note_step(engine):
+    """Record decode-step progress for the replica hosting ``engine`` —
+    called from ``ServeEngine.step`` behind the ``_active`` gate.  This
+    timestamp is what separates *stalled* (pending work, no progress
+    past ``servefleet.stall_deadline``) from merely idle."""
+    rep = _watch.get(id(engine))
+    if rep is not None:
+        rep.last_step = time.monotonic()
+        rep.steps += 1
+
+
+def _gauge(name, value, **labels):
+    if _telemetry._active:
+        _telemetry.set_gauge(name, value, **labels)
+
+
+def _count(name, n=1, **labels):
+    if _telemetry._active:
+        _telemetry.inc(name, n, **labels)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous (HRW) routing
+# ---------------------------------------------------------------------------
+
+def _score(session, rid):
+    h = hashlib.blake2b(f"{session}|{rid}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+def rendezvous_route(session, replica_ids):
+    """Highest-random-weight (rendezvous) hash: pick the replica with
+    the max keyed score.  The property the router needs: when a replica
+    leaves, ONLY the sessions it owned re-rank — every other session
+    keeps its replica (no modulo reshuffle), so failover moves the
+    minimum number of KV-affine sessions.  Deterministic across
+    processes (blake2b, no seed) so the multi-process drill's driver
+    and any observer agree on placement."""
+    ids = list(replica_ids)
+    if not ids:
+        raise MXNetError("rendezvous_route: no live replicas")
+    return max(ids, key=lambda rid: _score(session, rid))
+
+
+def _route_order(session, replica_ids):
+    """All live replicas, best rendezvous score first — the spill order
+    when the affine replica rejects with EngineBusy."""
+    return sorted(replica_ids, key=lambda rid: _score(session, rid),
+                  reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# request + replica records
+# ---------------------------------------------------------------------------
+
+class FleetRequest:
+    """One accepted request's fleet-level record: the idempotency key,
+    the session it routes under, the original prompt (re-dispatch
+    re-prefills from it), the current engine-level request, and any
+    orphaned engine requests left behind on a dead replica whose
+    already-dispatched device work may still complete (the dedupe
+    race).  ``tokens`` is None until the FIRST completion lands."""
+
+    __slots__ = ("key", "session", "prompt", "max_new_tokens", "eos_id",
+                 "engine_req", "orphans", "replica_id", "redispatches",
+                 "tokens", "t_submit", "t_done")
+
+    def __init__(self, key, session, prompt, max_new_tokens, eos_id):
+        self.key = str(key)
+        self.session = str(session)
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.engine_req = None
+        self.orphans = []
+        self.replica_id = None
+        self.redispatches = 0
+        self.tokens = None
+        self.t_submit = time.monotonic()
+        self.t_done = None
+
+    @property
+    def done(self):
+        return self.tokens is not None
+
+    def __repr__(self):
+        state = "done" if self.done else f"replica{self.replica_id}"
+        return (f"FleetRequest(key={self.key!r}, session={self.session!r},"
+                f" {state}, redispatches={self.redispatches})")
+
+
+class Replica:
+    """One engine + its lease + supervisor-visible state.
+
+    States: ``live`` (routable), ``updating`` (mid rolling update,
+    excluded from routing), ``parked`` (drained by scale-in, engine
+    kept warm for instant unpark), ``dead`` (failed over, never
+    revived — scale-out builds a fresh replica instead)."""
+
+    __slots__ = ("rid", "engine", "plane", "state", "wedged",
+                 "last_step", "steps", "generation", "__weakref__")
+
+    def __init__(self, rid, engine, plane=None):
+        self.rid = int(rid)
+        self.engine = engine
+        self.plane = plane
+        self.state = "live"
+        #: the serve.replica_stall injection wedges the step loop while
+        #: the lease keeps renewing — progress stops, liveness doesn't
+        self.wedged = False
+        self.last_step = time.monotonic()
+        self.steps = 0
+        self.generation = 0
+
+    def occupancy(self):
+        live = sum(1 for s in self.engine._slots if s is not None)
+        return live / max(1, self.engine.max_slots)
+
+    def snapshot(self):
+        return {"rid": self.rid, "state": self.state,
+                "generation": self.generation, "steps": self.steps,
+                "wedged": self.wedged,
+                "occupancy": round(self.occupancy(), 4),
+                "queued": len(self.engine._queue),
+                "post_warmup_compiles": self.engine.post_warmup_compiles}
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+class ServeFleet:
+    """N replicas of one model behind a rendezvous-hash router.
+
+    Usage::
+
+        fleet = mx.servefleet.ServeFleet(lambda: build_model(),
+                                         replicas=3, eos_id=50256)
+        fr = fleet.submit(ids, max_new_tokens=64, session="user-7")
+        fleet.run()                     # supervisor tick loop
+        fr.tokens                       # exactly-once result
+        fleet.rolling_update(new_params, canary=card)
+        fleet.close()
+
+    ``model_factory`` builds one model instance per replica (replicas
+    must not share parameter state — a rolling update swaps one replica
+    at a time).  Engine keyword arguments (``max_slots``, ``buckets``,
+    ``eos_id``, ``temperature``, ``quantize``...) pass through to every
+    :class:`~mxnet_tpu.serve.engine.ServeEngine`.  With ``lease_dir``
+    each replica holds a :class:`~mxnet_tpu.fleet.HealthPlane` lease;
+    a lease stale past ``fleet.lease_timeout`` is a detected crash.
+    """
+
+    def __init__(self, model_factory, replicas=2, min_replicas=None,
+                 max_replicas=None, lease_dir=None, warmup=True,
+                 **engine_kwargs):
+        if not callable(model_factory):
+            raise MXNetError("ServeFleet needs a model_factory callable "
+                             "(one fresh model per replica)")
+        replicas = int(replicas)
+        if replicas < 1:
+            raise MXNetError("ServeFleet needs at least one replica")
+        self._model_factory = model_factory
+        self._engine_kwargs = dict(engine_kwargs)
+        self._lease_dir = lease_dir
+        self._warmup = bool(warmup)
+        self.min_replicas = int(min_replicas if min_replicas is not None
+                                else _config.get("servefleet.min_replicas"))
+        cap = int(max_replicas if max_replicas is not None
+                  else _config.get("servefleet.max_replicas"))
+        self.max_replicas = cap if cap > 0 else replicas
+        if self.min_replicas > replicas:
+            raise MXNetError(
+                f"servefleet.min_replicas={self.min_replicas} exceeds the "
+                f"constructed replica count {replicas}")
+        self._replicas: dict[int, Replica] = {}
+        self._requests: "collections.OrderedDict[str, FleetRequest]" = \
+            collections.OrderedDict()
+        self._session_map: dict[str, int] = {}
+        self._overflow = collections.deque()
+        self._next_rid = 0
+        self._next_key = 0
+        self._tick = 0
+        self._generation = 0
+        self._current_params = None
+        # autoscaler debounce/cooldown state
+        self._burn_ticks = 0
+        self._idle_ticks = 0
+        self._cooldown = 0
+        self._scale_events = {"out": 0, "in": 0}
+        for _ in range(replicas):
+            self._build_replica()
+        _fleets.add(self)
+        self._sync_gauges()
+
+    # -- replica lifecycle ----------------------------------------------
+
+    def _build_replica(self):
+        from .serve.engine import ServeEngine
+        global _active
+        rid = self._next_rid
+        self._next_rid += 1
+        eng = ServeEngine(self._model_factory(), **self._engine_kwargs)
+        if self._current_params is not None:
+            # a scale-out after a rolling update must serve the CURRENT
+            # generation, not whatever the factory initialized
+            eng.update_weights(self._current_params)
+        if self._warmup:
+            eng.warmup()
+        plane = None
+        if self._lease_dir:
+            plane = _fleet.HealthPlane(
+                rank=rid, nprocs=self.max_replicas,
+                lease_dir=self._lease_dir).start()
+        rep = Replica(rid, eng, plane)
+        rep.generation = self._generation
+        self._replicas[rid] = rep
+        _watch[id(eng)] = rep
+        _active = True
+        return rep
+
+    def _live(self):
+        return [r for r in self._replicas.values() if r.state == "live"]
+
+    def _parked(self):
+        return [r for r in self._replicas.values() if r.state == "parked"]
+
+    def _sync_gauges(self):
+        _gauge("servefleet.replicas_live", len(self._live()))
+
+    # -- routing + submission -------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=32, session=None, key=None,
+               eos_id="engine"):
+        """Accept one request under an idempotency ``key`` (generated
+        when omitted) and route it by rendezvous hash of ``session``
+        (defaults to the key: no affinity).  Re-submitting an accepted
+        key returns the SAME :class:`FleetRequest` — the idempotent
+        accept that makes client retries safe.  Raises
+        :class:`~mxnet_tpu.serve.engine.EngineBusy` (with the max
+        ``retry_after_hint`` across replicas) only when EVERY live
+        replica rejects."""
+        if key is None:
+            key = f"req-{self._next_key}"
+            self._next_key += 1
+        key = str(key)
+        if key in self._requests:
+            return self._requests[key]
+        if session is None:
+            session = key
+        import numpy as onp
+        prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        eos = (self._engine_kwargs.get("eos_id")
+               if eos_id == "engine" else eos_id)
+        fr = FleetRequest(key, session, prompt, max_new_tokens, eos)
+        self._dispatch(fr, queue_on_busy=False)
+        self._requests[key] = fr
+        _count("servefleet.requests_total")
+        return fr
+
+    def _dispatch(self, fr, queue_on_busy=True):
+        """Route ``fr`` to the best live replica (rendezvous order,
+        spilling on EngineBusy).  With ``queue_on_busy`` an all-busy
+        fleet parks the request in the overflow queue (retried every
+        tick) instead of raising — a failover re-dispatch must never
+        drop an accepted request."""
+        from .serve.engine import EngineBusy
+        live = self._live()
+        if not live:
+            raise MXNetError("servefleet: no live replicas "
+                             f"(min_replicas={self.min_replicas})")
+        last = None
+        for rid in _route_order(fr.session, [r.rid for r in live]):
+            rep = self._replicas[rid]
+            try:
+                req = rep.engine.submit(fr.prompt, fr.max_new_tokens,
+                                        eos_id=fr.eos_id)
+            except EngineBusy as e:
+                last = e if last is None or \
+                    e.retry_after_hint > last.retry_after_hint else last
+                continue
+            fr.engine_req = req
+            fr.replica_id = rid
+            prev = self._session_map.get(fr.session)
+            if prev is not None and prev != rid:
+                _count("servefleet.router_moves_total")
+            self._session_map[fr.session] = rid
+            return True
+        if queue_on_busy:
+            self._overflow.append(fr)
+            return False
+        raise last
+
+    # -- the supervisor tick --------------------------------------------
+
+    def step(self):
+        """One supervisor tick: probe the chaos points, retry overflow,
+        advance every live replica one engine step, detect stalls and
+        stale leases, collect completions into the ledger, run the
+        autoscaler.  The fleet analog of ``ServeEngine.step`` — online
+        callers own this loop."""
+        self._tick += 1
+        now = time.monotonic()
+        if _fault._active:
+            if _fault.fire("serve.replica_crash", step=self._tick):
+                victim = self._victim()
+                if victim is not None:
+                    self._fail(victim, "crash")
+            if _fault.fire("serve.replica_stall", step=self._tick):
+                victim = self._victim()
+                if victim is not None:
+                    victim.wedged = True
+                    _fault.record("servefleet.replica_wedged")
+        self._check_leases()
+        for _ in range(len(self._overflow)):
+            fr = self._overflow.popleft()
+            if not fr.done:
+                self._dispatch(fr)
+        for rep in self._live():
+            if rep.wedged:
+                continue  # the stall drill: lease fresh, loop frozen
+            if rep.engine.pending:
+                rep.engine.step()  # note_step() stamps rep.last_step
+            else:
+                rep.last_step = now  # idle is not a stall
+        deadline = float(_config.get("servefleet.stall_deadline"))
+        for rep in list(self._live()):
+            if rep.engine.pending and \
+                    time.monotonic() - rep.last_step > deadline:
+                self._fail(rep, "stall")
+        self._collect()
+        self._autoscale()
+        return self
+
+    @property
+    def pending(self):
+        return bool(self._overflow) or \
+            any(not fr.done for fr in self._requests.values())
+
+    def run(self, max_ticks=None, tick_interval=0.0):
+        """Tick until every accepted request completed (or ``max_ticks``
+        elapsed).  Completion is ledger-level: a request survives its
+        replica dying mid-stream.  ``tick_interval`` paces the loop
+        (seconds of sleep per tick) — wall-clock detectors like the
+        ``servefleet.stall_deadline`` watchdog need real time to pass,
+        not just iterations."""
+        ticks = 0
+        while self.pending:
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            if tick_interval > 0:
+                time.sleep(tick_interval)
+        return self
+
+    def _victim(self):
+        """Pick the chaos victim deterministically: the live replica
+        carrying the most work (fails the most interesting one)."""
+        live = self._live()
+        if not live:
+            return None
+        return max(live, key=lambda r: (
+            sum(1 for s in r.engine._slots if s is not None)
+            + len(r.engine._queue), -r.rid))
+
+    # -- failover --------------------------------------------------------
+
+    def _check_leases(self):
+        """A live replica whose lease file is stale past the plane
+        timeout is a detected crash — the multi-host analog of
+        ``fleet.host_loss``, driven by the same file-backed lease."""
+        if not self._lease_dir:
+            return
+        timeout = float(_config.get("fleet.lease_timeout"))
+        for rep in list(self._live()):
+            if rep.plane is not None:
+                timeout = rep.plane.timeout
+            path = os.path.join(self._lease_dir,
+                                f"host-{rep.rid}.lease")
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue  # never published / torn mid-write: not proof
+            if time.time() - float(payload.get("time", 0)) > timeout:
+                _count("fleet.lease_expiries_total")
+                self._fail(rep, "crash")
+
+    def _fail(self, rep, cause):
+        """Declare ``rep`` dead and make its work whole: re-dispatch
+        every incomplete request to a survivor under its idempotency
+        key, THEN (stall only) drain the dead engine's already-
+        dispatched device work — deliberately after, so a late orphan
+        completion races its own re-dispatch and the ledger's dedupe is
+        exercised for real, not just in theory.  A crash drops the
+        window outright: the KV and in-flight emits died with the
+        host."""
+        if rep.state == "dead":
+            return
+        with _trace.span("servefleet.failover", category="servefleet",
+                         replica=rep.rid, cause=cause):
+            rep.state = "dead"
+            rep.wedged = False
+            _count("servefleet.failovers_total", cause=cause)
+            _fault.record(f"servefleet.failover_{cause}")
+            if rep.plane is not None:
+                rep.plane.stop()
+            victims = [fr for fr in self._requests.values()
+                       if not fr.done and fr.replica_id == rep.rid]
+            for fr in victims:
+                orphan = fr.engine_req
+                fr.engine_req = None
+                if cause == "stall" and orphan is not None:
+                    fr.orphans.append(orphan)
+                fr.redispatches += 1
+                self._dispatch(fr)
+                _count("servefleet.redispatched_total")
+            if cause == "stall":
+                # flush what the wedged engine had already dispatched:
+                # orphans may complete here and beat their re-dispatch
+                rep.engine.drain()
+            self._collect()
+            # anything a dead-and-drained replica didn't finish never
+            # will — stop watching those orphans
+            for fr in victims:
+                fr.orphans = [o for o in fr.orphans if o.finished]
+        self._sync_gauges()
+
+    # -- the exactly-once ledger ----------------------------------------
+
+    def _record(self, fr, ereq):
+        if fr.tokens is None:
+            fr.tokens = list(ereq.generated)
+            fr.t_done = time.monotonic()
+            _count("servefleet.completed_total")
+        else:
+            _count("servefleet.duplicates_suppressed_total")
+
+    def _collect(self):
+        """Sweep engine-level completions into the fleet ledger.  First
+        finish wins; every later finish of the same key (an orphan or a
+        raced re-dispatch) is counted suppressed and discarded."""
+        for fr in self._requests.values():
+            req = fr.engine_req
+            if req is not None and req.finished:
+                self._record(fr, req)
+                fr.engine_req = None
+            if fr.orphans:
+                still = []
+                for o in fr.orphans:
+                    if o.finished:
+                        self._record(fr, o)
+                    else:
+                        still.append(o)
+                fr.orphans = still
+
+    # -- rolling weight updates -----------------------------------------
+
+    def rolling_update(self, params, canary=None):
+        """Roll every live replica to ``params`` (a flat
+        ``{name: array}`` tree, e.g. a training fleet's published
+        checkpoint) one replica at a time, never dropping the group
+        below ``servefleet.min_replicas`` live replicas.
+
+        Per replica, inside a goodput ``rollover`` bracket: mark
+        ``updating`` (router excludes it), ``stop(drain=True)`` (every
+        accepted request on it finishes under the OLD weights —
+        generations never mix inside one request), swap weights in
+        place, ``resume()`` + ``warmup()`` (an executable-cache hit:
+        zero compiles), then replay the ``canary`` card's pinned
+        prompts greedily and compare token-for-token.  Divergence or
+        any post-warmup compile restores the old weights, counts
+        ``servefleet.rollbacks_total`` and ABORTS the rollout, so a bad
+        checkpoint stops at one replica and the fleet keeps serving the
+        old generation everywhere.
+
+        ``canary`` is a card from :func:`canary_card` /
+        :func:`publish_checkpoint`: ``{"prompts": [...], "expected":
+        [[tok, ...], ...], "tokens": n}``.  Returns a report dict;
+        ``report["rolled_back"]`` tells the publisher its checkpoint
+        was rejected."""
+        params = dict(params)
+        updated, report = [], None
+        for rep in list(self._live()):
+            if len(self._live()) - 1 < self.min_replicas:
+                # taking this replica out for the update would breach
+                # the floor: bring capacity up first or refuse
+                if self._scale_out(reason="rolling_update") is None:
+                    raise MXNetError(
+                        "rolling_update would drop the group below "
+                        f"servefleet.min_replicas={self.min_replicas} "
+                        "and no scale-out capacity remains")
+            tok = _goodput.begin("rollover") if _goodput._active else None
+            with _trace.span("servefleet.rolling_update",
+                             category="servefleet", replica=rep.rid,
+                             generation=self._generation + 1):
+                try:
+                    rep.state = "updating"
+                    self._sync_gauges()
+                    rep.engine.stop(drain=True)
+                    self._collect()
+                    before = rep.engine.post_warmup_compiles
+                    old = rep.engine.update_weights(params)
+                    rep.engine.resume()
+                    rep.engine.warmup()
+                    ok = rep.engine.post_warmup_compiles == before
+                    reason = None if ok else "post_warmup_compiles"
+                    if ok and canary is not None:
+                        ok, reason = self._canary_check(rep, canary)
+                    if not ok:
+                        rep.engine.restore_weights(old)
+                        _count("servefleet.rollbacks_total")
+                        _fault.record("servefleet.rollback")
+                        report = {"updated": updated, "rolled_back": True,
+                                  "replica": rep.rid, "reason": reason}
+                        break
+                    rep.generation = self._generation + 1
+                    _count("servefleet.rolling_updates_total")
+                    updated.append(rep.rid)
+                finally:
+                    rep.state = "live" if rep.state == "updating" \
+                        else rep.state
+                    self._sync_gauges()
+                    _goodput.end(tok)
+        if report is None:
+            self._generation += 1
+            self._current_params = params
+            report = {"updated": updated, "rolled_back": False,
+                      "generation": self._generation}
+        return report
+
+    def _canary_check(self, rep, canary):
+        """Greedy parity on the pinned prompts: the new weights must
+        reproduce the checkpoint's canary card token-for-token."""
+        if rep.engine.temperature != 0:
+            raise MXNetError(
+                "canary parity requires greedy decoding "
+                "(temperature=0); build the fleet engines greedy or "
+                "pass canary=None")
+        n = int(canary.get("tokens")
+                or _config.get("servefleet.canary_tokens"))
+        for prompt, expected in zip(canary["prompts"],
+                                    canary["expected"]):
+            req = rep.engine.submit(prompt, max_new_tokens=n)
+            rep.engine.run()
+            if list(req.generated) != list(expected):
+                return False, (
+                    f"canary diverged on replica {rep.rid}: "
+                    f"{list(req.generated)} != {list(expected)}")
+        return True, None
+
+    # -- SLO-driven scaling ---------------------------------------------
+
+    def _autoscale(self):
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        patience = max(1, int(_config.get("servefleet.scale_patience")))
+        thresh = float(_config.get("goodput.burn_threshold"))
+        live = self._live()
+        if not live:
+            return
+        burns = [max(r.engine.slo_burn().values() or [0.0])
+                 for r in live]
+        if max(burns) > thresh:
+            self._burn_ticks += 1
+        else:
+            self._burn_ticks = 0
+        if self._burn_ticks >= patience:
+            self._burn_ticks = 0
+            if self._scale_out(reason="slo_burn") is not None:
+                self._cooldown = patience
+            return
+        floor = float(_config.get("servefleet.occupancy_floor"))
+        occ = sum(r.occupancy() for r in live) / len(live)
+        if occ < floor and len(live) > self.min_replicas \
+                and not self.pending:
+            self._idle_ticks += 1
+        else:
+            self._idle_ticks = 0
+        if self._idle_ticks >= patience:
+            self._idle_ticks = 0
+            if self._scale_in() is not None:
+                self._cooldown = patience
+
+    def _scale_out(self, reason="slo_burn"):
+        """Add capacity: unpark a drained replica (instant — its grid
+        is still hot) before building a fresh one, bounded by
+        ``servefleet.max_replicas``.  Returns the replica or None."""
+        with _trace.span("servefleet.scale", category="servefleet",
+                         dir="out", reason=reason):
+            parked = self._parked()
+            if parked:
+                rep = parked[0]
+                rep.engine.resume()
+                if rep.plane is not None:
+                    rep.plane.start()
+                rep.state = "live"
+                rep.last_step = time.monotonic()
+            elif len(self._live()) < self.max_replicas:
+                rep = self._build_replica()
+            else:
+                return None
+            _count("servefleet.scale_events_total", dir="out")
+            self._scale_events["out"] += 1
+            self._sync_gauges()
+            return rep
+
+    def _scale_in(self):
+        """Drain and park the least-occupied live replica (engine and
+        compiled grid kept warm; lease withdrawn).  Refuses below
+        ``servefleet.min_replicas``.  Returns the replica or None."""
+        live = self._live()
+        if len(live) <= self.min_replicas:
+            return None
+        with _trace.span("servefleet.scale", category="servefleet",
+                         dir="in"):
+            rep = min(live, key=lambda r: (r.occupancy(), r.rid))
+            rep.state = "parked"
+            rep.engine.stop(drain=True)
+            self._collect()
+            if rep.plane is not None:
+                rep.plane.stop()
+            _count("servefleet.scale_events_total", dir="in")
+            self._scale_events["in"] += 1
+            self._sync_gauges()
+            return rep
+
+    # -- reporting / shutdown -------------------------------------------
+
+    def report(self):
+        reqs = list(self._requests.values())
+        done = [fr for fr in reqs if fr.done]
+        return {
+            "replicas": [r.snapshot() for r in self._replicas.values()],
+            "live": len(self._live()),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "generation": self._generation,
+            "requests": len(reqs),
+            "completed": len(done),
+            "pending": len(reqs) - len(done),
+            "overflow": len(self._overflow),
+            "redispatched": sum(fr.redispatches for fr in reqs),
+            "sessions": len(self._session_map),
+            "scale_events": dict(self._scale_events),
+            "ticks": self._tick,
+        }
+
+    def close(self, drain=False):
+        """Tear the group down: stop every lease, stop every engine
+        (``drain=True`` finishes accepted work first), detach the
+        step-progress watch.  The module hot-path gate drops back to
+        False when the last fleet closes."""
+        global _active
+        if drain:
+            self.run()
+        for rep in self._replicas.values():
+            if rep.plane is not None:
+                rep.plane.stop()
+            if rep.state != "dead":
+                try:
+                    rep.engine.stop(drain=False)
+                except Exception:  # noqa: BLE001 - teardown is best-effort
+                    pass
+            _watch.pop(id(rep.engine), None)
+        self._replicas.clear()
+        _fleets.discard(self)
+        _active = bool(_watch)
+        _gauge("servefleet.replicas_live", 0)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# canary cards + staged checkpoint publish
+# ---------------------------------------------------------------------------
+
+def canary_card(model_or_engine, prompts, tokens=None, **engine_kwargs):
+    """Compute the greedy-parity card a rolling update validates
+    against: for each pinned prompt, the exact token ids the published
+    weights generate greedily.  The publisher runs this ONCE per
+    checkpoint (a scratch engine's compiles are warmup compiles, not
+    serving-path compiles) and ships the card in the checkpoint
+    manifest."""
+    from .serve.engine import ServeEngine
+    n = int(tokens if tokens is not None
+            else _config.get("servefleet.canary_tokens"))
+    eng = model_or_engine
+    if not isinstance(eng, ServeEngine):
+        engine_kwargs.setdefault("temperature", 0.0)
+        eng = ServeEngine(model_or_engine, **engine_kwargs)
+    if eng.temperature != 0:
+        raise MXNetError("canary_card requires greedy decoding "
+                         "(temperature=0)")
+    expected = []
+    for prompt in prompts:
+        req = eng.submit(prompt, max_new_tokens=n)
+        eng.run()
+        expected.append([int(t) for t in req.generated])
+    return {"prompts": [list(map(int, p)) for p in prompts],
+            "tokens": n, "expected": expected}
+
+
+def publish_checkpoint(path, params, canary=None, step=None):
+    """Staged checkpoint publish for serving fleets: write the flat
+    param tree + manifest into a temp directory, fsync, then atomically
+    rename into place — a replica polling ``path`` either sees the
+    previous complete checkpoint or the new complete one, never a torn
+    directory.  ``canary`` (a :func:`canary_card` dict) rides in the
+    manifest so every consumer validates against the SAME pinned
+    outputs."""
+    import jax
+    import numpy as onp
+    path = str(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    arrays = {k: onp.asarray(jax.device_get(v))
+              for k, v in dict(params).items()}
+    onp.savez(os.path.join(tmp, "params.npz"), **arrays)
+    manifest = {"format": CHECKPOINT_FORMAT, "step": step,
+                "params": sorted(arrays), "canary": canary}
+    mpath = os.path.join(tmp, "manifest.json")
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.exists(path):
+        retired = f"{path}.retired.{os.getpid()}"
+        os.rename(path, retired)
+        os.rename(tmp, path)
+        import shutil
+        shutil.rmtree(retired, ignore_errors=True)
+    else:
+        os.rename(tmp, path)
+    return path
+
+
+def load_checkpoint(path):
+    """-> ``(params, canary)`` from a :func:`publish_checkpoint`
+    directory.  Raises :class:`MXNetError` on a missing or
+    wrong-format manifest (a torn publish can never look valid: the
+    rename is atomic, so a readable manifest implies complete
+    params)."""
+    import jax.numpy as jnp
+    import numpy as onp
+    mpath = os.path.join(str(path), "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MXNetError(f"unreadable checkpoint manifest {mpath}: {e}") \
+            from e
+    if manifest.get("format") != CHECKPOINT_FORMAT:
+        raise MXNetError(
+            f"checkpoint {path} has format {manifest.get('format')!r}, "
+            f"expected {CHECKPOINT_FORMAT!r}")
+    data = onp.load(os.path.join(str(path), "params.npz"))
+    params = {k: jnp.asarray(data[k]) for k in data.files}
+    return params, manifest.get("canary")
+
+
+def endpoint_report():
+    """The /servefleet ops endpoint payload: one report per live fleet
+    group in this process."""
+    return {"active": _active,
+            "fleets": [f.report() for f in list(_fleets)]}

@@ -720,6 +720,8 @@ def serve_http(port=None):
       merged fleet view) as JSON.
     - ``GET /goodput``  — the mx.goodput ledger (local bucket waterfall
       + capacity-weighted fleet device-second merge) as JSON.
+    - ``GET /servefleet`` — the mx.servefleet control-plane view (per-
+      replica states, generations, ledger counters) as JSON.
     - ``GET /postmortem?last=N`` — metadata of the newest N mx.blackbox
       postmortem bundles in the resolved bundle directory.
 
@@ -798,6 +800,11 @@ def serve_http(port=None):
                 from . import goodput as _goodput
                 self._send(200, json.dumps(_goodput.endpoint_report()),
                            "application/json")
+            elif url.path == "/servefleet":
+                from . import servefleet as _servefleet
+                self._send(200,
+                           json.dumps(_servefleet.endpoint_report()),
+                           "application/json")
             elif url.path == "/postmortem":
                 from . import blackbox as _blackbox
                 query = urllib.parse.parse_qs(url.query)
@@ -816,7 +823,7 @@ def serve_http(port=None):
                 self._send(404, json.dumps(
                     {"error": f"unknown path {url.path!r}",
                      "paths": ["/metrics", "/healthz", "/insight",
-                               "/goodput",
+                               "/goodput", "/servefleet",
                                "/trace?last=N&category=C",
                                "/postmortem?last=N"]}),
                     "application/json")

@@ -596,6 +596,59 @@ def test_stop_no_drain_discards_queued(metrics):
         "serve.rejected_total") == 2
 
 
+def test_stop_no_drain_every_queued_request_observes_rejection(metrics):
+    """stop(drain=False) must leave NO queued request ambiguous: each
+    one flips rejected=True with a machine-readable reason, so a caller
+    holding the handle distinguishes 'discarded' from 'still running'
+    without string-matching logs."""
+    eng = _engine(max_slots=1)
+    reqs = [eng.submit([1, 2, 3], max_new_tokens=2) for _ in range(5)]
+    eng.stop(drain=False)
+    queued = [r for r in reqs if not r.finished and r.slot is None]
+    assert queued, "expected still-queued requests at stop time"
+    for r in queued:
+        assert r.rejected is True
+        assert r.reject_reason == "stopping"
+    # requests that reached a slot are unfinished but NOT rejected:
+    # their state is 'abandoned in flight', a different contract
+    for r in reqs:
+        if r not in queued:
+            assert not r.rejected
+    by_reason = {k: v for k, v in telemetry.counters().items()
+                 if k.startswith("serve.rejected_total")}
+    assert any('reason="stopping"' in k for k in by_reason), by_reason
+    assert sum(by_reason.values()) == len(queued)
+
+
+def test_engine_busy_carries_retry_after_hint(metrics):
+    """EngineBusy.retry_after_hint = queue depth x observed TPOT p50 —
+    the machine-readable backoff the fleet router consumes instead of
+    hammering a saturated replica."""
+    prev = mx.config.set("serve.max_queue", 2)
+    try:
+        eng = _engine(max_slots=1)
+        # one completed request seeds the TPOT p50 observation
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.run()
+        p50 = eng._tpot_p50()
+        assert p50 > 0
+        eng.submit([1, 2], max_new_tokens=2)
+        eng.submit([3, 4], max_new_tokens=2)
+        with pytest.raises(EngineBusy) as ei:
+            eng.submit([5], max_new_tokens=1)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_hint == pytest.approx(2 * p50)
+        assert f"{ei.value.retry_after_hint:.3f}" in str(ei.value)
+        eng.run()
+        eng.stop()
+        with pytest.raises(EngineBusy) as ei:
+            eng.submit([6], max_new_tokens=1)
+        assert ei.value.reason == "stopping"
+        assert ei.value.retry_after_hint > 0  # floor: one p50 interval
+    finally:
+        mx.config.set("serve.max_queue", prev)
+
+
 def test_engine_healthz_tracks_step_loop(metrics):
     eng = _engine()
     _, checks = telemetry.health()

@@ -1,0 +1,554 @@
+"""mx.servefleet — multi-replica serving control plane (docs/SERVING.md).
+
+Oracles: the exactly-once ledger (every accepted request completes with
+a result recorded exactly once, across crash AND stall failover — the
+mx.stream multiplicity-1 discipline applied to serving), greedy token
+parity against the full-forward reference after re-dispatch (replicas
+share identical weights via a seeded factory), the PR 2 recompile
+detector as the zero-compile rolling-update assertion, and the
+rendezvous-hash minimal-movement property.
+
+The chaos drills here arm the ``serve.replica_crash`` and
+``serve.replica_stall`` injection points single-process; the
+multi-process SIGKILL drill lives in tests/servefleet_worker.py (the CI
+servefleet stage runs both).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, servefleet, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet import HealthPlane
+from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+from mxnet_tpu.serve.engine import EngineBusy
+
+
+def _factory():
+    """Identical weights every call (seeded): replicas must agree so a
+    re-dispatched request reproduces the same greedy tokens."""
+    mx.random.seed(7)
+    net = GPTForCausalLM(vocab_size=97, units=32, hidden_size=64,
+                         num_layers=2, num_heads=2, max_length=32,
+                         dropout=0.0, embed_dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((1, 2), dtype="int32"))
+    return net
+
+
+def _fleet(**kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("buckets", "4,8")
+    kw.setdefault("temperature", 0.0)
+    return servefleet.ServeFleet(_factory, **kw)
+
+
+def _ref_greedy(net, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        lg = net(mx.np.array(onp.array([seq], dtype="int32"))).asnumpy()
+        seq.append(int(lg[0, -1].argmax()))
+    return seq[len(prompt):]
+
+
+def _session_on(rid, replica_ids, prefix="s"):
+    """A session name the rendezvous hash routes to ``rid``."""
+    for i in range(10000):
+        s = f"{prefix}{i}"
+        if servefleet.rendezvous_route(s, replica_ids) == rid:
+            return s
+    raise AssertionError(f"no session found routing to {rid}")
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    fault.clear()
+    fault.reset_stats()
+    yield
+    fault.clear()
+    fault.reset_stats()
+    telemetry.stop_http()
+    telemetry.disable()
+    telemetry.reset()
+    mx.config.reset()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- rendezvous routing -----------------------------------------------------
+
+def test_rendezvous_minimal_movement():
+    """Removing one replica moves ONLY that replica's sessions — the
+    property that makes failover cheap for every surviving session."""
+    ids = [0, 1, 2, 3]
+    sessions = [f"user-{i}" for i in range(300)]
+    before = {s: servefleet.rendezvous_route(s, ids) for s in sessions}
+    after = {s: servefleet.rendezvous_route(s, [0, 1, 3])
+             for s in sessions}
+    for s in sessions:
+        if before[s] != 2:
+            assert after[s] == before[s], s
+        else:
+            assert after[s] in (0, 1, 3)
+    # and it is deterministic (the drill driver recomputes placement)
+    assert before == {s: servefleet.rendezvous_route(s, ids)
+                      for s in sessions}
+
+
+def test_rendezvous_empty_raises():
+    with pytest.raises(MXNetError):
+        servefleet.rendezvous_route("s", [])
+
+
+# -- basic fleet: affinity, parity, idempotent accept -----------------------
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_fleet_completes_with_session_affinity(metrics):
+    fleet = _fleet(replicas=2)
+    try:
+        net = _factory()
+        frs = []
+        for i in range(6):
+            frs.append(fleet.submit(list(range(1, 5)), max_new_tokens=5,
+                                    session=f"aff-{i}"))
+        # affinity: the router honored the rendezvous placement
+        live = [r.rid for r in fleet._live()]
+        for fr in frs:
+            assert fr.replica_id == servefleet.rendezvous_route(
+                fr.session, live)
+        fleet.run(max_ticks=300)
+        ref = _ref_greedy(net, list(range(1, 5)), 5)
+        for fr in frs:
+            assert fr.done and fr.tokens == ref
+        assert telemetry.counters(aggregate=True)[
+            "servefleet.completed_total"] == 6
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_fleet_idempotent_accept_same_key(metrics):
+    fleet = _fleet()
+    try:
+        a = fleet.submit([1, 2, 3], max_new_tokens=3, key="k1")
+        b = fleet.submit([1, 2, 3], max_new_tokens=3, key="k1")
+        assert a is b
+        assert telemetry.counters(aggregate=True)[
+            "servefleet.requests_total"] == 1
+        fleet.run(max_ticks=100)
+        assert a.done
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_fleet_spills_on_busy_and_raises_with_hint(metrics):
+    """A full affine replica spills to the next rendezvous choice; an
+    all-full fleet surfaces EngineBusy WITH the retry_after_hint so the
+    caller backs off instead of hammering."""
+    mx.config.set("serve.max_queue", 1)
+    fleet = _fleet(replicas=2, max_slots=1)
+    try:
+        live = [r.rid for r in fleet._live()]
+        s = _session_on(live[0], live, prefix="pin-")
+        a = fleet.submit([1, 2], max_new_tokens=4, session=s)
+        assert a.replica_id == live[0]
+        # affinity replica's queue is full: spill to the survivor
+        b = fleet.submit([1, 2], max_new_tokens=4, session=s)
+        assert b.replica_id == live[1]
+        with pytest.raises(EngineBusy) as ei:     # every replica full
+            fleet.submit([1, 2], max_new_tokens=2, session=s)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_hint > 0
+        fleet.run(max_ticks=300)
+        assert a.done and b.done
+    finally:
+        fleet.close()
+        mx.config.reset("serve.max_queue")
+
+
+# -- crash failover ---------------------------------------------------------
+
+def test_crash_failover_exactly_once_with_parity(metrics):
+    """Kill a replica mid-stream (serve.replica_crash): every accepted
+    request still completes EXACTLY once, re-prefilled from the
+    original prompt on a survivor, with greedy token parity."""
+    fault.configure("serve.replica_crash:at=2")
+    fleet = _fleet(replicas=3, min_replicas=2)
+    try:
+        net = _factory()
+        prompts = {}
+        frs = []
+        for i in range(8):
+            pr = [1 + (i % 7), 2, 3, 4]
+            fr = fleet.submit(pr, max_new_tokens=6, session=f"c{i}")
+            prompts[fr.key] = pr
+            frs.append(fr)
+        fleet.run(max_ticks=500)
+        counters = telemetry.counters(aggregate=True)
+        assert counters["servefleet.failovers_total"] == 1
+        assert counters["servefleet.redispatched_total"] >= 1
+        assert counters["servefleet.completed_total"] == 8
+        dead = [r for r in fleet._replicas.values() if r.state == "dead"]
+        assert len(dead) == 1 and len(fleet._live()) == 2
+        for fr in frs:
+            assert fr.done
+            assert fr.tokens == _ref_greedy(net, prompts[fr.key], 6), \
+                fr.key
+        # injected fault accounted like any chaos drill
+        assert fault.stats()["injected.serve.replica_crash"] == 1
+    finally:
+        fleet.close()
+
+
+# -- stall failover + duplicate suppression ---------------------------------
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_stall_failover_suppresses_duplicate_completions(metrics):
+    """The stall drill's signature race: the wedged replica's already-
+    dispatched device work is drained AFTER its requests re-dispatch,
+    so the same key can complete twice — the ledger must record exactly
+    one result and count the other suppressed."""
+    mx.config.set("servefleet.stall_deadline", 0.01)
+    fleet = _fleet(replicas=2, max_slots=1, drain_window=32)
+    try:
+        net = _factory()
+        live = [r.rid for r in fleet._live()]
+        victim_rid = live[0]
+        s = _session_on(victim_rid, live, prefix="stall-")
+        fr = fleet.submit([1, 2, 3], max_new_tokens=4, session=s)
+        assert fr.replica_id == victim_rid
+        # dispatch every token into the deferred window (undrained:
+        # drain_window=32 means nothing forces the fetch), then wedge
+        # the victim exactly as the serve.replica_stall injection does
+        for _ in range(8):
+            fleet.step()
+        victim = fleet._replicas[victim_rid]
+        assert not fr.done and victim.engine.pending
+        victim.wedged = True
+        time.sleep(0.03)
+        fleet.run(max_ticks=500, tick_interval=0.002)
+        # the orphan won the race at drain time; the re-dispatched copy
+        # is still decoding on the survivor — tick until it lands so
+        # the ledger gets to suppress it
+        for _ in range(200):
+            if not any(r.engine.pending for r in fleet._live()):
+                break
+            fleet.step()
+        counters = telemetry.counters(aggregate=True)
+        assert fr.done and fr.tokens == _ref_greedy(net, [1, 2, 3], 4)
+        assert counters["servefleet.completed_total"] == 1
+        assert counters["servefleet.failovers_total"] == 1
+        assert counters["servefleet.duplicates_suppressed_total"] >= 1
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_stall_injection_point_drives_failover(metrics):
+    """End-to-end via the armed injection point: serve.replica_stall
+    wedges the busiest replica, the stall deadline declares it dead,
+    work re-dispatches, everything completes exactly once."""
+    fault.configure("serve.replica_stall:at=2")
+    mx.config.set("servefleet.stall_deadline", 0.02)
+    fleet = _fleet(replicas=2, min_replicas=1)
+    try:
+        frs = [fleet.submit([2, 3, 4], max_new_tokens=6,
+                            session=f"w{i}") for i in range(6)]
+        fleet.run(max_ticks=1000, tick_interval=0.003)
+        assert all(fr.done for fr in frs)
+        counters = telemetry.counters(aggregate=True)
+        assert counters["servefleet.completed_total"] == 6
+        assert counters["servefleet.failovers_total"] == 1
+        assert fault.stats()["injected.serve.replica_stall"] == 1
+    finally:
+        fleet.close()
+
+
+# -- rolling weight updates -------------------------------------------------
+
+def _published_params():
+    """A 'trained' parameter tree: the factory weights, perturbed
+    deterministically so the new generation is distinguishable."""
+    from mxnet_tpu import functional
+    net = _factory()
+    net(mx.np.zeros((1, 2), dtype="int32"))  # materialize everything
+    params = dict(functional.param_arrays(net))
+    return {k: v + 0.5 for k, v in params.items()}, net
+
+
+def test_rolling_update_zero_compiles_and_generation(metrics):
+    fleet = _fleet(replicas=2, min_replicas=1)
+    try:
+        new_params, net = _published_params()
+        # canary card computed by the publisher on the NEW weights
+        # (a scratch engine, exactly what a training fleet would run)
+        from mxnet_tpu.serve.engine import ServeEngine
+        card_eng = ServeEngine(_factory(), max_slots=2, buckets="4,8",
+                               temperature=0.0)
+        card_eng.update_weights(new_params)
+        card = servefleet.canary_card(card_eng, [[1, 2, 3, 4]], tokens=4)
+        report = fleet.rolling_update(new_params, canary=card)
+        assert report["rolled_back"] is False
+        assert sorted(report["updated"]) == sorted(
+            r.rid for r in fleet._live())
+        assert report["generation"] == 1
+        for r in fleet._live():
+            assert r.generation == 1
+            assert r.engine.post_warmup_compiles == 0
+        # fleet serves the new generation: parity with the card
+        fr = fleet.submit([1, 2, 3, 4], max_new_tokens=4, session="g1")
+        fleet.run(max_ticks=200)
+        assert fr.tokens == card["expected"][0]
+        counters = telemetry.counters(aggregate=True)
+        assert counters["servefleet.rolling_updates_total"] == 2
+        assert "servefleet.rollbacks_total" not in counters
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_rolling_update_bad_canary_rolls_back_and_aborts(metrics):
+    """A checkpoint whose canary diverges must stop at the FIRST
+    replica: auto-rollback to the old weights, rollout aborted, every
+    replica still serving the old generation with zero compiles."""
+    fleet = _fleet(replicas=3, min_replicas=2)
+    try:
+        net = _factory()
+        old_ref = _ref_greedy(net, [1, 2, 3], 4)
+        good_card = {"prompts": [[1, 2, 3]], "tokens": 4,
+                     "expected": [old_ref]}
+        bad_params, _ = _published_params()  # diverges from good_card
+        report = fleet.rolling_update(bad_params, canary=good_card)
+        assert report["rolled_back"] is True
+        assert report["updated"] == []
+        assert "canary diverged" in report["reason"]
+        assert all(r.generation == 0 for r in fleet._live())
+        assert len(fleet._live()) == 3  # never dipped below the floor
+        # old weights restored: still serving the old tokens
+        fr = fleet.submit([1, 2, 3], max_new_tokens=4, session="after")
+        fleet.run(max_ticks=200)
+        assert fr.tokens == old_ref
+        counters = telemetry.counters(aggregate=True)
+        assert counters["servefleet.rollbacks_total"] == 1
+        assert "servefleet.rolling_updates_total" not in counters
+        for r in fleet._live():
+            assert r.engine.post_warmup_compiles == 0
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_rolling_update_respects_min_replicas_floor(metrics):
+    """With live == min_replicas and no scale-out capacity, taking a
+    replica down for the update would breach the floor: refuse."""
+    fleet = _fleet(replicas=2, min_replicas=2, max_replicas=2)
+    try:
+        params, _ = _published_params()
+        with pytest.raises(MXNetError, match="min_replicas"):
+            fleet.rolling_update(params)
+        assert len(fleet._live()) == 2
+    finally:
+        fleet.close()
+
+
+def test_checkpoint_publish_load_roundtrip(tmp_path, metrics):
+    """Staged publish: atomic rename, canary card in the manifest, and
+    a second publish atomically replaces the first."""
+    params, net = _published_params()
+    card = {"prompts": [[1, 2, 3]], "tokens": 2, "expected": [[5, 5]]}
+    path = str(tmp_path / "ckpt")
+    servefleet.publish_checkpoint(path, params, canary=card, step=10)
+    loaded, canary = servefleet.load_checkpoint(path)
+    assert canary == card
+    assert sorted(loaded) == sorted(params)
+    for k in params:
+        assert onp.array_equal(onp.asarray(loaded[k]),
+                               onp.asarray(params[k])), k
+    # re-publish over the same path (the rolling-update poll target)
+    servefleet.publish_checkpoint(path, params, canary=None, step=11)
+    _, canary2 = servefleet.load_checkpoint(path)
+    assert canary2 is None
+    with pytest.raises(MXNetError, match="manifest"):
+        servefleet.load_checkpoint(str(tmp_path / "nope"))
+
+
+# -- SLO-driven scaling -----------------------------------------------------
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_scale_out_on_sustained_slo_burn(metrics):
+    mx.config.set("serve.slo_ttft_ms", 0.0001)
+    mx.config.set("serve.slo_target", 0.9)
+    mx.config.set("servefleet.scale_patience", 2)
+    fleet = _fleet(replicas=2, max_replicas=3)
+    try:
+        frs = [fleet.submit([1, 2, 3], max_new_tokens=3,
+                            session=f"b{i}") for i in range(4)]
+        fleet.run(max_ticks=300)
+        assert all(fr.done for fr in frs)
+        # every TTFT violated the micro-SLO: burn >> threshold on the
+        # replicas that served; tick the supervisor past the patience
+        for _ in range(6):
+            fleet.step()
+        assert len(fleet._live()) == 3
+        counters = telemetry.counters()
+        assert counters.get(
+            'servefleet.scale_events_total{dir="out"}', 0) >= 1
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_scale_in_parks_and_burn_unparks(metrics):
+    mx.config.set("servefleet.occupancy_floor", 1.0)  # idle < full
+    mx.config.set("servefleet.scale_patience", 2)
+    fleet = _fleet(replicas=3, min_replicas=2)
+    try:
+        for _ in range(6):   # idle ticks past patience
+            fleet.step()
+        assert len(fleet._live()) == 2
+        parked = fleet._parked()
+        assert len(parked) == 1
+        counters = telemetry.counters()
+        assert counters.get(
+            'servefleet.scale_events_total{dir="in"}', 0) == 1
+        # scale-out prefers unparking (grid still hot: no compiles)
+        rep = fleet._scale_out(reason="test")
+        assert rep is parked[0] and rep.state == "live"
+        assert rep.engine.post_warmup_compiles == 0
+        assert len(fleet._live()) == 3
+        # parked floor respected: never below min_replicas
+        mx.config.set("servefleet.occupancy_floor", 1.0)
+        for _ in range(20):
+            fleet.step()
+        assert len(fleet._live()) >= 2
+    finally:
+        fleet.close()
+
+
+# -- HealthPlane renewal-thread hygiene (the PR's bugfix) -------------------
+
+def test_healthplane_tight_restart_loop_leaks_no_threads(tmp_path):
+    """start()/stop() in a tight loop must never leak mx-fleet-heartbeat
+    threads or revive an old loop via the shared stop event — the
+    in-process restart pattern a serving supervisor runs."""
+    plane = HealthPlane(rank=0, nprocs=1, lease_dir=str(tmp_path),
+                        interval=0.005)
+    for _ in range(30):
+        plane.start()
+        plane.stop()
+    time.sleep(0.05)
+    alive = [t for t in threading.enumerate()
+             if t.name == "mx-fleet-heartbeat" and t.is_alive()]
+    assert alive == [], alive
+    plane.stop()          # double-stop is a no-op
+    # start-start is idempotent: exactly one renewal thread
+    plane.start()
+    first = plane._thread
+    plane.start()
+    assert plane._thread is first
+    alive = [t for t in threading.enumerate()
+             if t.name == "mx-fleet-heartbeat" and t.is_alive()]
+    assert len(alive) == 1
+    plane.stop()
+    time.sleep(0.05)
+    assert not any(t.name == "mx-fleet-heartbeat" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_healthplane_stop_joins_renewal_thread(tmp_path):
+    plane = HealthPlane(rank=0, nprocs=1, lease_dir=str(tmp_path),
+                        interval=0.005)
+    plane.start()
+    t = plane._thread
+    assert t.is_alive()
+    plane.stop()
+    assert not t.is_alive()      # joined, not abandoned
+    assert plane._thread is None
+
+
+# -- leases + ops endpoint --------------------------------------------------
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_fleet_replicas_hold_leases_and_stale_lease_fails_over(
+        tmp_path, metrics):
+    """Each replica renews a host-<rid>.lease; a lease stale past the
+    plane timeout is a detected crash (the multi-process drill's
+    detection path, exercised in-process by stopping one plane)."""
+    fleet = _fleet(replicas=2, min_replicas=1,
+                   lease_dir=str(tmp_path))
+    try:
+        live = [r.rid for r in fleet._live()]
+        for rid in live:
+            path = tmp_path / f"host-{rid}.lease"
+            for _ in range(200):  # daemon loop's first beat: async
+                if path.exists():
+                    break
+                time.sleep(0.01)
+            assert path.exists(), rid
+        victim = fleet._replicas[live[0]]
+        fr = fleet.submit([1, 2, 3], max_new_tokens=4,
+                          session=_session_on(live[0], live, "lease-"))
+        # freeze the victim's renewals and age its lease past timeout
+        victim.plane._stop.set()
+        victim.plane.timeout = 0.01
+        stale = {"rank": victim.rid, "pid": 0, "step": 0,
+                 "time": time.time() - 1.0}
+        (tmp_path / f"host-{victim.rid}.lease").write_text(
+            json.dumps(stale))
+        fleet.run(max_ticks=300, tick_interval=0.002)
+        assert victim.state == "dead"
+        assert fr.done
+        counters = telemetry.counters(aggregate=True)
+        assert counters["servefleet.failovers_total"] == 1
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_servefleet_ops_endpoint(metrics):
+    fleet = _fleet(replicas=2)
+    try:
+        fr = fleet.submit([1, 2, 3], max_new_tokens=3, session="ep")
+        fleet.run(max_ticks=100)
+        assert fr.done
+        srv = telemetry.serve_http(0)
+        port = srv.server_address[1]
+        status, body = _get(port, "/servefleet")
+        assert status == 200
+        d = json.loads(body)
+        assert d["active"] is True
+        assert len(d["fleets"]) == 1
+        rep = d["fleets"][0]
+        assert rep["live"] == 2 and rep["completed"] == 1
+        # and the 404 page advertises the path
+        status, body = _get(port, "/nope")
+        assert status == 404 and "/servefleet" in body
+    finally:
+        telemetry.stop_http()
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_close_drops_hot_path_gate(metrics):
+    fleet = _fleet(replicas=2)
+    assert servefleet._active is True
+    fleet.close()
+    assert servefleet._active is False
+    assert servefleet.endpoint_report()["fleets"] == []

@@ -19,6 +19,10 @@ Usage:
 
     # gpt2-124m shapes (accelerator-sized; slow on CPU)
     python tools/serve.py --model gpt2_124m --demo 8
+
+    # multi-replica fleet: 3 replicas, kill one mid-stream, report
+    JAX_PLATFORMS=cpu python tools/serve.py --demo 12 --replicas 3 \
+        --fault serve.replica_crash:at=3
 """
 from __future__ import annotations
 
@@ -65,6 +69,16 @@ def main(argv=None):
                         "int8_kv — comma-combinable, e.g. "
                         "'int4_weights,int8_kv'")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="serve through a mx.servefleet group of N "
+                        "replicas (session-affinity router, failover, "
+                        "exactly-once ledger) instead of one engine")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="fleet mode: servefleet.min_replicas floor")
+    p.add_argument("--fault", default=None, metavar="SPEC",
+                   help="fleet mode: arm a mx.fault spec, e.g. "
+                        "serve.replica_crash:at=3 or "
+                        "serve.replica_stall:at=2")
     args = p.parse_args(argv)
 
     import numpy as onp
@@ -83,6 +97,8 @@ def main(argv=None):
         p.error("no work: pass --prompt and/or --demo N")
 
     telemetry.enable()
+    if args.replicas:
+        return fleet_main(args, prompts)
     eng = mx.serve.load(net, max_slots=args.slots, eos_id=args.eos_id,
                         temperature=args.temperature, seed=args.seed,
                         quantize=args.quantize)
@@ -105,6 +121,43 @@ def main(argv=None):
     st["tokens_per_s"] = round(st["tokens_out"] / wall, 1)
     print(json.dumps(st))
     return 1 if st["post_warmup_compiles"] else 0
+
+
+def fleet_main(args, prompts):
+    """--replicas N path: the same workload through a mx.servefleet
+    group, optionally with an armed chaos spec (--fault) so the
+    failover path is drivable from the command line."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault
+
+    if args.fault:
+        fault.configure(args.fault)
+    fleet = mx.servefleet.ServeFleet(
+        lambda: build_model(args.model), replicas=args.replicas,
+        min_replicas=args.min_replicas, max_slots=args.slots,
+        eos_id=args.eos_id, temperature=args.temperature,
+        seed=args.seed, quantize=args.quantize)
+    t0 = time.perf_counter()
+    frs = [fleet.submit(ids, max_new_tokens=args.max_new,
+                        session=f"cli-{i}")
+           for i, ids in enumerate(prompts)]
+    fleet.run(tick_interval=0.001)
+    wall = time.perf_counter() - t0
+    for fr in frs:
+        print(json.dumps({"key": fr.key, "session": fr.session,
+                          "prompt": fr.prompt, "tokens": fr.tokens,
+                          "replica": fr.replica_id,
+                          "redispatches": fr.redispatches}))
+    report = fleet.report()
+    report["wall_s"] = round(wall, 4)
+    print(json.dumps(report))
+    incomplete = report["pending"]
+    compiles = sum(r["post_warmup_compiles"]
+                   for r in report["replicas"])
+    fleet.close()
+    if args.fault:
+        fault.clear()
+    return 1 if (incomplete or compiles) else 0
 
 
 if __name__ == "__main__":
