@@ -530,6 +530,13 @@ declare("servefleet.canary_tokens", int, 8,
         "rolling weight update validates a replica's freshly loaded "
         "checkpoint before returning it to the router; divergence "
         "from the checkpoint's canary card triggers auto-rollback.")
+declare("servefleet.ledger_retain", int, 1024,
+        "MXNET_SERVEFLEET_LEDGER_RETAIN",
+        "Completed requests the mx.servefleet exactly-once ledger keeps "
+        "(most recent first) to absorb duplicate client submits of an "
+        "already-finished idempotency key; older completions are "
+        "evicted so a long-running fleet's memory and per-tick sweep "
+        "stay bounded.  In-flight requests are never evicted.")
 
 
 # -- dmlc::Parameter analog -------------------------------------------------

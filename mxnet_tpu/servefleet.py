@@ -16,7 +16,8 @@ deployment:
   by the completion ledger: every accepted request completes exactly
   once, never zero, never twice.
 - **Rolling weight updates.**  A training fleet publishes a checkpoint
-  (:func:`publish_checkpoint` — staged tmp+rename, never a torn read);
+  (:func:`publish_checkpoint` — versioned data dir + atomic symlink
+  swap, never a torn or missing read);
   :meth:`ServeFleet.rolling_update` walks the replicas one at a time:
   drain (``stop(drain=True)``), swap weights in place
   (:meth:`~mxnet_tpu.serve.engine.ServeEngine.update_weights` — same
@@ -49,6 +50,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import itertools
 import json
 import os
 import time
@@ -298,8 +300,19 @@ class ServeFleet:
                 f"servefleet.min_replicas={self.min_replicas} exceeds the "
                 f"constructed replica count {replicas}")
         self._replicas: dict[int, Replica] = {}
-        self._requests: "collections.OrderedDict[str, FleetRequest]" = \
+        #: the exactly-once ledger, split so its cost stays bounded on a
+        #: long-running fleet: in-flight requests (plus done ones still
+        #: owed a duplicate-suppression sweep) live in ``_inflight``;
+        #: settled requests move to ``_completed``, an LRU capped at
+        #: ``servefleet.ledger_retain`` keys kept to absorb duplicate
+        #: client submits.  Lifetime totals ride separate counters so
+        #: :meth:`report` never needs the full history.
+        self._inflight: dict[str, FleetRequest] = {}
+        self._completed: "collections.OrderedDict[str, FleetRequest]" = \
             collections.OrderedDict()
+        self._accepted_total = 0
+        self._completed_total = 0
+        self._redispatched_total = 0
         self._session_map: dict[str, int] = {}
         self._overflow = collections.deque()
         self._next_rid = 0
@@ -368,8 +381,10 @@ class ServeFleet:
             key = f"req-{self._next_key}"
             self._next_key += 1
         key = str(key)
-        if key in self._requests:
-            return self._requests[key]
+        if key in self._inflight:
+            return self._inflight[key]
+        if key in self._completed:
+            return self._completed[key]
         if session is None:
             session = key
         import numpy as onp
@@ -378,7 +393,8 @@ class ServeFleet:
                if eos_id == "engine" else eos_id)
         fr = FleetRequest(key, session, prompt, max_new_tokens, eos)
         self._dispatch(fr, queue_on_busy=False)
-        self._requests[key] = fr
+        self._inflight[key] = fr
+        self._accepted_total += 1
         _count("servefleet.requests_total")
         return fr
 
@@ -391,6 +407,12 @@ class ServeFleet:
         from .serve.engine import EngineBusy
         live = self._live()
         if not live:
+            # the last replica just died: queueing keeps the "never
+            # drop an accepted request" promise — the supervisor tick
+            # rebuilds capacity and retries the overflow queue
+            if queue_on_busy:
+                self._overflow.append(fr)
+                return False
             raise MXNetError("servefleet: no live replicas "
                              f"(min_replicas={self.min_replicas})")
         last = None
@@ -436,6 +458,11 @@ class ServeFleet:
                     victim.wedged = True
                     _fault.record("servefleet.replica_wedged")
         self._check_leases()
+        if not self._live() and self.pending:
+            # every replica is dead but accepted work is still owed:
+            # dead replicas are never revived — unpark or build a fresh
+            # one so the overflow queue can drain
+            self._scale_out(reason="fleet_dead")
         for _ in range(len(self._overflow)):
             fr = self._overflow.popleft()
             if not fr.done:
@@ -459,7 +486,7 @@ class ServeFleet:
     @property
     def pending(self):
         return bool(self._overflow) or \
-            any(not fr.done for fr in self._requests.values())
+            any(not fr.done for fr in self._inflight.values())
 
     def run(self, max_ticks=None, tick_interval=0.0):
         """Tick until every accepted request completed (or ``max_ticks``
@@ -530,7 +557,7 @@ class ServeFleet:
             _fault.record(f"servefleet.failover_{cause}")
             if rep.plane is not None:
                 rep.plane.stop()
-            victims = [fr for fr in self._requests.values()
+            victims = [fr for fr in self._inflight.values()
                        if not fr.done and fr.replica_id == rep.rid]
             for fr in victims:
                 orphan = fr.engine_req
@@ -538,8 +565,15 @@ class ServeFleet:
                 if cause == "stall" and orphan is not None:
                     fr.orphans.append(orphan)
                 fr.redispatches += 1
+                self._redispatched_total += 1
                 self._dispatch(fr)
                 _count("servefleet.redispatched_total")
+            if not self._live():
+                # the whole group is down; victims sit safely in the
+                # overflow queue and the next tick rebuilds capacity —
+                # record the condition once rather than raising out of
+                # the victims loop with failover half-done
+                _fault.record("servefleet.fleet_dead")
             if cause == "stall":
                 # flush what the wedged engine had already dispatched:
                 # orphans may complete here and beat their re-dispatch
@@ -557,6 +591,7 @@ class ServeFleet:
         if fr.tokens is None:
             fr.tokens = list(ereq.generated)
             fr.t_done = time.monotonic()
+            self._completed_total += 1
             _count("servefleet.completed_total")
         else:
             _count("servefleet.duplicates_suppressed_total")
@@ -564,8 +599,13 @@ class ServeFleet:
     def _collect(self):
         """Sweep engine-level completions into the fleet ledger.  First
         finish wins; every later finish of the same key (an orphan or a
-        raced re-dispatch) is counted suppressed and discarded."""
-        for fr in self._requests.values():
+        raced re-dispatch) is counted suppressed and discarded.  A
+        request with no engine-level copy left in flight settles into
+        the capped completed LRU (``servefleet.ledger_retain``) so the
+        per-tick sweep only ever walks genuinely open work."""
+        retain = max(0, int(_config.get("servefleet.ledger_retain")))
+        settled = []
+        for fr in self._inflight.values():
             req = fr.engine_req
             if req is not None and req.finished:
                 self._record(fr, req)
@@ -578,6 +618,15 @@ class ServeFleet:
                     else:
                         still.append(o)
                 fr.orphans = still
+            # done with no copy still running anywhere: nothing left to
+            # suppress, safe to leave the hot sweep
+            if fr.done and fr.engine_req is None and not fr.orphans:
+                settled.append(fr.key)
+        for key in settled:
+            self._completed[key] = self._inflight.pop(key)
+            self._completed.move_to_end(key)
+        while len(self._completed) > retain:
+            self._completed.popitem(last=False)
 
     # -- rolling weight updates -----------------------------------------
 
@@ -605,8 +654,36 @@ class ServeFleet:
         ``report["rolled_back"]`` tells the publisher its checkpoint
         was rejected."""
         params = dict(params)
+        if canary is not None:
+            # validate the card and the engines UP FRONT, before any
+            # replica is drained or its weights swapped: failing later
+            # (inside _canary_check) would strand one replica live on
+            # un-canaried new weights with no rollback
+            if not isinstance(canary, dict) or \
+                    "prompts" not in canary or "expected" not in canary:
+                raise MXNetError(
+                    "rolling_update canary must be a canary_card dict "
+                    "with 'prompts' and 'expected'")
+            hot = [r.rid for r in self._replicas.values()
+                   if r.state in ("live", "parked", "updating")
+                   and r.engine.temperature != 0]
+            if hot:
+                raise MXNetError(
+                    "canary parity requires greedy decoding "
+                    "(temperature=0); build the fleet engines greedy "
+                    f"or pass canary=None (sampling replicas: {hot})")
+        target = self._generation + 1
         updated, report = [], None
-        for rep in list(self._live()):
+        # re-derive the worklist every iteration instead of snapshotting
+        # it: a replica added or unparked mid-rollout (the floor-guard
+        # _scale_out below) comes up on the OLD generation and must be
+        # rolled too — a successful rollout leaves EVERY live replica on
+        # the new generation, never a silent mix
+        while report is None:
+            stale = [r for r in self._live() if r.generation < target]
+            if not stale:
+                break
+            rep = stale[0]
             if len(self._live()) - 1 < self.min_replicas:
                 # taking this replica out for the update would breach
                 # the floor: bring capacity up first or refuse
@@ -639,7 +716,7 @@ class ServeFleet:
                         report = {"updated": updated, "rolled_back": True,
                                   "replica": rep.rid, "reason": reason}
                         break
-                    rep.generation = self._generation + 1
+                    rep.generation = target
                     _count("servefleet.rolling_updates_total")
                     updated.append(rep.rid)
                 finally:
@@ -648,7 +725,7 @@ class ServeFleet:
                     self._sync_gauges()
                     _goodput.end(tok)
         if report is None:
-            self._generation += 1
+            self._generation = target
             self._current_params = params
             report = {"updated": updated, "rolled_back": False,
                       "generation": self._generation}
@@ -656,12 +733,18 @@ class ServeFleet:
 
     def _canary_check(self, rep, canary):
         """Greedy parity on the pinned prompts: the new weights must
-        reproduce the checkpoint's canary card token-for-token."""
+        reproduce the checkpoint's canary card token-for-token.
+
+        Never raises: ``rolling_update`` validated the card and engine
+        temperatures before touching any replica, so a failure here is
+        a verdict — returned as ``(False, reason)`` and routed through
+        the normal restore_weights rollback path, never an exception
+        that would strand the replica on un-canaried weights."""
         if rep.engine.temperature != 0:
-            raise MXNetError(
-                "canary parity requires greedy decoding "
-                "(temperature=0); build the fleet engines greedy or "
-                "pass canary=None")
+            return False, (
+                f"replica {rep.rid} engine is sampling "
+                "(temperature != 0); canary parity requires greedy "
+                "decoding")
         n = int(canary.get("tokens")
                 or _config.get("servefleet.canary_tokens"))
         for prompt, expected in zip(canary["prompts"],
@@ -718,6 +801,14 @@ class ServeFleet:
             if parked:
                 rep = parked[0]
                 rep.engine.resume()
+                if rep.generation != self._generation and \
+                        self._current_params is not None:
+                    # parked through a completed rolling update: bring
+                    # it onto the current generation before it takes
+                    # traffic (mid-rollout unparks keep the old weights
+                    # and are rolled by the update's own worklist)
+                    rep.engine.update_weights(self._current_params)
+                    rep.generation = self._generation
                 if rep.plane is not None:
                     rep.plane.start()
                 rep.state = "live"
@@ -754,19 +845,18 @@ class ServeFleet:
     # -- reporting / shutdown -------------------------------------------
 
     def report(self):
-        reqs = list(self._requests.values())
-        done = [fr for fr in reqs if fr.done]
         return {
             "replicas": [r.snapshot() for r in self._replicas.values()],
             "live": len(self._live()),
             "min_replicas": self.min_replicas,
             "max_replicas": self.max_replicas,
             "generation": self._generation,
-            "requests": len(reqs),
-            "completed": len(done),
-            "pending": len(reqs) - len(done),
+            "requests": self._accepted_total,
+            "completed": self._completed_total,
+            "pending": self._accepted_total - self._completed_total,
             "overflow": len(self._overflow),
-            "redispatched": sum(fr.redispatches for fr in reqs),
+            "redispatched": self._redispatched_total,
+            "ledger_retained": len(self._completed),
             "sessions": len(self._session_map),
             "scale_events": dict(self._scale_events),
             "ticks": self._tick,
@@ -826,37 +916,58 @@ def canary_card(model_or_engine, prompts, tokens=None, **engine_kwargs):
             "tokens": n, "expected": expected}
 
 
+#: per-process publish counter — makes every versioned data directory
+#: name unique (pid disambiguates across processes)
+_publish_seq = itertools.count()
+
+
 def publish_checkpoint(path, params, canary=None, step=None):
     """Staged checkpoint publish for serving fleets: write the flat
-    param tree + manifest into a temp directory, fsync, then atomically
-    rename into place — a replica polling ``path`` either sees the
-    previous complete checkpoint or the new complete one, never a torn
-    directory.  ``canary`` (a :func:`canary_card` dict) rides in the
-    manifest so every consumer validates against the SAME pinned
-    outputs."""
+    param tree + manifest into a versioned data directory
+    (``<path>.g<pid>.<seq>``), fsync, then atomically swap a symlink at
+    ``path`` over it (``os.replace`` of a prepared link is ONE rename)
+    — a replica polling ``path`` resolves either the previous complete
+    checkpoint or the new complete one; ``path`` is never missing and
+    never a torn directory, however the reader races the publisher.
+    The superseded data directory is removed after the swap.  ``canary``
+    (a :func:`canary_card` dict) rides in the manifest so every
+    consumer validates against the SAME pinned outputs."""
     import jax
     import numpy as onp
+    import shutil
     path = str(path)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    os.makedirs(tmp, exist_ok=True)
+    data = f"{path}.g{os.getpid()}.{next(_publish_seq)}"
+    os.makedirs(data, exist_ok=True)
     arrays = {k: onp.asarray(jax.device_get(v))
               for k, v in dict(params).items()}
-    onp.savez(os.path.join(tmp, "params.npz"), **arrays)
+    onp.savez(os.path.join(data, "params.npz"), **arrays)
     manifest = {"format": CHECKPOINT_FORMAT, "step": step,
                 "params": sorted(arrays), "canary": canary}
-    mpath = os.path.join(tmp, "manifest.json")
+    mpath = os.path.join(data, "manifest.json")
     with open(mpath, "w") as f:
         json.dump(manifest, f)
         f.flush()
         os.fsync(f.fileno())
-    if os.path.exists(path):
-        retired = f"{path}.retired.{os.getpid()}"
-        os.rename(path, retired)
-        os.rename(tmp, path)
-        import shutil
-        shutil.rmtree(retired, ignore_errors=True)
-    else:
-        os.rename(tmp, path)
+    # prepare the link first, then swap: the replace is the publish
+    lnk = f"{path}.lnk.{os.getpid()}"
+    if os.path.lexists(lnk):
+        os.remove(lnk)
+    os.symlink(os.path.basename(data), lnk)
+    prev = None
+    if os.path.islink(path):
+        prev = os.path.join(os.path.dirname(path) or ".",
+                            os.readlink(path))
+    elif os.path.isdir(path):
+        # legacy in-place directory (pre-symlink layout): a link can't
+        # be renamed over a real directory, so move it aside first —
+        # the only case with a (syscall-wide) missing window, which
+        # load_checkpoint's bounded retry absorbs; every publish from
+        # here on leaves a symlink and swaps atomically
+        prev = f"{path}.g{os.getpid()}.legacy{next(_publish_seq)}"
+        os.rename(path, prev)
+    os.replace(lnk, path)
+    if prev is not None:
+        shutil.rmtree(prev, ignore_errors=True)
     return path
 
 
@@ -864,17 +975,29 @@ def load_checkpoint(path):
     """-> ``(params, canary)`` from a :func:`publish_checkpoint`
     directory.  Raises :class:`MXNetError` on a missing or
     wrong-format manifest (a torn publish can never look valid: the
-    rename is atomic, so a readable manifest implies complete
-    params)."""
+    link swap is atomic, so a readable manifest implies complete
+    params).  A transiently missing manifest is retried briefly before
+    failing — the one racy window left is a publisher migrating a
+    legacy pre-symlink checkpoint directory into the versioned
+    layout."""
     import jax.numpy as jnp
     import numpy as onp
     mpath = os.path.join(str(path), "manifest.json")
-    try:
-        with open(mpath) as f:
-            manifest = json.load(f)
-    except (OSError, ValueError) as e:
-        raise MXNetError(f"unreadable checkpoint manifest {mpath}: {e}") \
-            from e
+    manifest, err = None, None
+    for _ in range(3):
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            break
+        except FileNotFoundError as e:
+            err = e
+            time.sleep(0.01)
+        except (OSError, ValueError) as e:
+            raise MXNetError(
+                f"unreadable checkpoint manifest {mpath}: {e}") from e
+    if manifest is None:
+        raise MXNetError(
+            f"unreadable checkpoint manifest {mpath}: {err}") from err
     if manifest.get("format") != CHECKPOINT_FORMAT:
         raise MXNetError(
             f"checkpoint {path} has format {manifest.get('format')!r}, "

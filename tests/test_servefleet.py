@@ -14,6 +14,8 @@ multi-process SIGKILL drill lives in tests/servefleet_worker.py (the CI
 servefleet stage runs both).
 """
 import json
+import os
+import shutil
 import threading
 import time
 import urllib.error
@@ -366,6 +368,134 @@ def test_rolling_update_respects_min_replicas_floor(metrics):
         assert len(fleet._live()) == 2
     finally:
         fleet.close()
+
+
+def test_rolling_update_covers_mid_rollout_scale_out(metrics):
+    """A replica built by the floor-guard scale-out DURING the rollout
+    comes up on the old generation — a successful rollout must roll it
+    too, never reporting success while the fleet serves mixed weight
+    generations."""
+    fleet = _fleet(replicas=2, min_replicas=2, max_replicas=3)
+    try:
+        new_params, _ = _published_params()
+        report = fleet.rolling_update(new_params)
+        assert report["rolled_back"] is False
+        live = fleet._live()
+        assert len(live) == 3          # the floor guard built one
+        assert all(r.generation == 1 for r in live)
+        assert sorted(report["updated"]) == sorted(r.rid for r in live)
+    finally:
+        fleet.close()
+
+
+def test_sole_replica_crash_queues_then_rebuilds(metrics):
+    """min_replicas=1 and the only replica crashes mid-stream: the
+    victims park in the overflow queue (never an exception from inside
+    the failover loop), the next tick rebuilds capacity, and every
+    accepted request still completes exactly once with parity."""
+    fault.configure("serve.replica_crash:at=2")
+    fleet = _fleet(replicas=1, min_replicas=1)
+    try:
+        net = _factory()
+        frs = [fleet.submit([1, 2, 3], max_new_tokens=4,
+                            session=f"solo{i}") for i in range(3)]
+        fleet.run(max_ticks=500)
+        ref = _ref_greedy(net, [1, 2, 3], 4)
+        for fr in frs:
+            assert fr.done and fr.tokens == ref
+        counters = telemetry.counters(aggregate=True)
+        assert counters["servefleet.completed_total"] == 3
+        assert counters["servefleet.failovers_total"] == 1
+        assert fault.stats()["servefleet.fleet_dead"] == 1
+        # dead replicas are never revived: a fresh one took over
+        assert len(fleet._live()) == 1
+        assert sum(1 for r in fleet._replicas.values()
+                   if r.state == "dead") == 1
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_ledger_evicts_completed_beyond_retain(metrics):
+    """The exactly-once ledger stays bounded: settled requests move to
+    an LRU capped at servefleet.ledger_retain, lifetime totals keep
+    counting, and a retained key still absorbs a duplicate submit."""
+    mx.config.set("servefleet.ledger_retain", 4)
+    fleet = _fleet(replicas=2)
+    try:
+        frs = {}
+        for i in range(10):
+            frs[f"key-{i}"] = fleet.submit(
+                [1, 2, 3], max_new_tokens=2, key=f"key-{i}",
+                session=f"L{i}")
+            fleet.run(max_ticks=200)
+        assert all(fr.done for fr in frs.values())
+        assert fleet._inflight == {}
+        assert len(fleet._completed) == 4
+        again = fleet.submit([1, 2, 3], max_new_tokens=2, key="key-9")
+        assert again is frs["key-9"]
+        rep = fleet.report()
+        assert rep["requests"] == 10 and rep["completed"] == 10
+        assert rep["ledger_retained"] == 4
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow  # full surface rides the servefleet CI stage (MXNET_TEST_SLOW=1)
+def test_rolling_update_validates_canary_at_entry(metrics):
+    """A sampling engine or a malformed card aborts the rollout BEFORE
+    any replica is drained or swapped — nothing is left live on
+    un-canaried new weights and nothing needs rolling back."""
+    fleet = _fleet(replicas=2, temperature=0.8)
+    try:
+        params, _ = _published_params()
+        card = {"prompts": [[1, 2, 3]], "tokens": 2,
+                "expected": [[1, 1]]}
+        with pytest.raises(MXNetError, match="greedy"):
+            fleet.rolling_update(params, canary=card)
+        assert fleet._generation == 0
+        assert all(r.generation == 0 and r.state == "live"
+                   for r in fleet._live())
+        counters = telemetry.counters(aggregate=True)
+        assert "servefleet.rollbacks_total" not in counters
+        assert "servefleet.rolling_updates_total" not in counters
+    finally:
+        fleet.close()
+    fleet = _fleet(replicas=2)
+    try:
+        params, _ = _published_params()
+        with pytest.raises(MXNetError, match="canary_card"):
+            fleet.rolling_update(params, canary={"prompts": [[1]]})
+        assert fleet._generation == 0
+    finally:
+        fleet.close()
+
+
+def test_checkpoint_publish_swaps_symlink_never_missing(tmp_path,
+                                                        metrics):
+    """Publishing over an existing checkpoint is ONE os.replace of a
+    prepared symlink — path always resolves to a complete versioned
+    data dir, the superseded dir is removed, and a legacy real
+    directory migrates into the symlink layout."""
+    params, _ = _published_params()
+    path = str(tmp_path / "ckpt")
+    servefleet.publish_checkpoint(path, params, step=1)
+    assert os.path.islink(path)
+    first_target = os.path.realpath(path)
+    servefleet.publish_checkpoint(path, params, step=2)
+    assert os.path.islink(path)
+    assert os.path.realpath(path) != first_target
+    assert not os.path.exists(first_target)   # superseded dir removed
+    loaded, _ = servefleet.load_checkpoint(path)
+    assert sorted(loaded) == sorted(params)
+    # legacy in-place directory (pre-symlink layout) migrates cleanly
+    legacy = str(tmp_path / "legacy")
+    shutil.copytree(os.path.realpath(path), legacy)
+    assert os.path.isdir(legacy) and not os.path.islink(legacy)
+    servefleet.publish_checkpoint(legacy, params, step=3)
+    assert os.path.islink(legacy)
+    loaded, _ = servefleet.load_checkpoint(legacy)
+    assert sorted(loaded) == sorted(params)
 
 
 def test_checkpoint_publish_load_roundtrip(tmp_path, metrics):
