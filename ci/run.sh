@@ -432,8 +432,14 @@ mesh() {
 serve() {
     echo "== serve: continuous-batching inference suite (docs/SERVING.md) =="
     python -m pytest tests/test_serve.py -q
+    echo "== serve: prefix-cache / speculative / SLO-class suite (docs/SERVING.md \"Prefix caching\") =="
+    # MXNET_TEST_SLOW=1: the quantized/compose/foreign-draft combos are
+    # nightly-bucketed out of tier-1 but stay PR-blocking here
+    MXNET_TEST_SLOW=1 python -m pytest tests/test_serve_prefix.py -q
     echo "== serve: throughput benchmark (>=2x vs sequential, 0 post-warmup recompiles) =="
     JAX_PLATFORMS=cpu python benchmark/serve_throughput.py --assert
+    echo "== serve: multi-tenant benchmark (>=1.5x prefix speedup, hit-rate floor, spec parity, gold<=bronze p99 TTFT) =="
+    JAX_PLATFORMS=cpu python benchmark/serve_throughput.py --tenants 3 --assert
 }
 
 insight() {

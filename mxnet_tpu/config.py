@@ -492,6 +492,44 @@ declare("serve.slo_target", float, 0.99, "MXNET_SERVE_SLO_TARGET",
         "Fraction of requests that must meet the serve SLO "
         "objectives; 1 - target is the error budget the "
         "serve.slo_burn_rate gauges burn against.")
+declare("serve.prefix_cache", int, 0, "MXNET_SERVE_PREFIX_CACHE",
+        "Enable the engine's radix prefix cache (1 = on): requests "
+        "sharing a cached token-block prefix copy the matching KV rows "
+        "inside the fixed donated cache allocation and prefill only "
+        "the suffix. Off by default — enabling adds a block-copy and a "
+        "per-bucket suffix-prefill executable to the warmup grid.")
+declare("serve.prefix_block", int, 16, "MXNET_SERVE_PREFIX_BLOCK",
+        "Tokens per KV block in the prefix cache's radix index (and in "
+        "mx.servefleet's prefix-fingerprint router): reuse happens at "
+        "whole-block granularity, so smaller blocks match more but "
+        "index more.")
+declare("serve.prefix_capacity", int, 0, "MXNET_SERVE_PREFIX_CAPACITY",
+        "Max blocks the prefix cache's radix index may hold before "
+        "LRU-evicting refcount-0 leaves; 0 = unbounded (the natural "
+        "bound is max_slots * max_seq / prefix_block — the index only "
+        "ever points at rows of the fixed cache allocation).")
+declare("serve.spec_tokens", int, 4, "MXNET_SERVE_SPEC_TOKENS",
+        "Speculative-decoding proposal length k: the draft model "
+        "proposes k tokens greedily per round and the big model "
+        "verifies all k in one batched call. Used only when the "
+        "engine was built with a draft model.")
+declare("serve.slo_classes", str, "", "MXNET_SERVE_SLO_CLASSES",
+        "Multi-tenant SLO classes, comma-separated, highest priority "
+        "first (e.g. 'gold,bronze'). Admission dequeues strict-"
+        "priority with starvation aging (serve.class_aging_ms); '' = "
+        "one implicit 'default' class (plain FIFO, the single-tenant "
+        "behaviour).")
+declare("serve.class_aging_ms", float, 0.0, "MXNET_SERVE_CLASS_AGING_MS",
+        "Starvation-aging knob for SLO-class admission: a queued "
+        "request waiting longer than this is promoted ahead of "
+        "strict priority (oldest aged request first). 0 = pure "
+        "strict priority (low classes can starve under overload).")
+declare("serve.class_max_queue", str, "", "MXNET_SERVE_CLASS_MAX_QUEUE",
+        "Per-class queue budgets as 'class=N,class=N' (e.g. "
+        "'gold=8,bronze=64'): submit() rejects a class past its own "
+        "budget with EngineBusy(queue_full) even when the global "
+        "serve.max_queue still has room. Classes absent from the spec "
+        "fall back to the global bound.")
 declare("serve.phase_sampling", int, 64, "MXNET_SERVE_PHASE_SAMPLING",
         "Per-request cap on always-on phase timing samples "
         "(queue_wait/prefill/decode_step) kept for stats()['phases'] "

@@ -124,6 +124,13 @@ POINTS = {
         "then drains the already-dispatched device work — any late "
         "completion racing the re-dispatch is suppressed by the "
         "idempotency ledger",
+    "serve.prefix_evict":
+        "a hot cached prefix is force-evicted from the radix index "
+        "between a request's admission-time match and the KV row copy "
+        "(probed once per prefix-cache hit): the engine falls back to "
+        "a full prefill of the whole prompt — the output stays token-"
+        "for-token identical, only the reuse saving is lost, counted "
+        "in serve.prefix_misses_total",
     "insight.drift":
         "one observed step-time sample is stretched 3x (probed at "
         "every insight drift-feed sample): the EWMA+MAD detector must "

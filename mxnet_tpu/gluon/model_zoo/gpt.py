@@ -82,6 +82,39 @@ class GPTModel(HybridBlock):
         x, caches = self.decoder.decode_step(x, caches, positions)
         return self.final_ln(x), caches
 
+    def prefill_suffix(self, inputs, caches, slot, start):
+        """Prefix-cache suffix prefill: ``inputs`` (1, Ls) is the
+        prompt suffix; rows [0, start) of cache slot ``slot`` already
+        hold a copied prefix, so positions offset by ``start`` and the
+        suffix attends the cached rows.  Returns
+        (hidden (1, Ls, units), caches)."""
+        b, s = inputs.shape
+        pos = np.arange(s, dtype="int32").reshape(1, s) + start
+        pos = np.minimum(pos, self._max_length - 1)
+        x = self.word_embed(inputs) + self.position_embed(pos)
+        x, caches = self.decoder.prefill_suffix(x, caches, slot, start)
+        return self.final_ln(x), caches
+
+    def decode_multi(self, tokens, caches, positions):
+        """Advance every slot t tokens at once (the speculative-decode
+        verify): tokens (slots, t) int32, slot i's token j landing at
+        cache row positions[i] + j.  Returns
+        (hidden (slots, t, units), caches)."""
+        n, t = tokens.shape
+        pos = np.arange(t, dtype="int32").reshape(1, t) \
+            + positions.reshape(-1, 1)
+        pos = np.minimum(pos, self._max_length - 1)
+        x = self.word_embed(tokens) + self.position_embed(pos)
+        x, caches = self.decoder.decode_multi(x, caches, positions)
+        return self.final_ln(x), caches
+
+    def copy_cache_rows(self, caches, src_slot, src_row, dst_slot,
+                        dst_row, rows):
+        """Copy ``rows`` KV rows between slots in every layer's cache —
+        the prefix-cache block-copy surface."""
+        return self.decoder.copy_cache_rows(
+            caches, src_slot, src_row, dst_slot, dst_row, rows)
+
 
 class GPTForCausalLM(HybridBlock):
     """Next-token LM head over GPTModel, weight-tied to the embedding.
@@ -117,6 +150,22 @@ class GPTForCausalLM(HybridBlock):
         h, caches = self.backbone.decode_step(tokens, caches, positions)
         w = self.backbone.word_embed.weight.data()
         return np.dot(h[:, 0], w.T), caches
+
+    def prefill_suffix(self, inputs, caches, slot, start):
+        h, caches = self.backbone.prefill_suffix(inputs, caches, slot,
+                                                 start)
+        w = self.backbone.word_embed.weight.data()
+        return np.dot(h, w.T), caches
+
+    def decode_multi(self, tokens, caches, positions):
+        h, caches = self.backbone.decode_multi(tokens, caches, positions)
+        w = self.backbone.word_embed.weight.data()
+        return np.dot(h, w.T), caches
+
+    def copy_cache_rows(self, caches, src_slot, src_row, dst_slot,
+                        dst_row, rows):
+        return self.backbone.copy_cache_rows(
+            caches, src_slot, src_row, dst_slot, dst_row, rows)
 
 
 def gpt2_124m(vocab_size=50257, **kwargs):

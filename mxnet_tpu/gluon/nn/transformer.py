@@ -116,6 +116,52 @@ class MultiHeadAttention(HybridBlock):
             q, k, v, kv[0], kv[1], positions, self._heads)
         return self.out_proj(out), (k_cache, v_cache)
 
+    def prefill_suffix(self, x, kv, slot, start):
+        """Prefix-cache suffix prefill: x (1, Ls, units) is the prompt
+        *suffix*; rows [0, start) of ``slot`` already hold a copied
+        prefix the suffix attends to (docs/SERVING.md
+        "Prefix caching")."""
+        from ...ops.attention import (suffix_prefill_attention,
+                                      suffix_prefill_attention_q8)
+        q = self.query_proj(x)
+        k = self.key_proj(x)
+        v = self.value_proj(x)
+        if self._cache_is_q8(kv):
+            (kc, ks), (vc, vs) = kv
+            out, kc, ks, vc, vs = suffix_prefill_attention_q8(
+                q, k, v, kc, ks, vc, vs, slot, start, self._heads)
+            return self.out_proj(out), ((kc, ks), (vc, vs))
+        out, k_cache, v_cache = suffix_prefill_attention(
+            q, k, v, kv[0], kv[1], slot, start, self._heads)
+        return self.out_proj(out), (k_cache, v_cache)
+
+    def decode_multi(self, x, kv, positions):
+        """k-token cached decode (the speculative-decoding verify):
+        x is (slots, t, units), slot i's token j landing at cache row
+        positions[i] + j with causal visibility."""
+        from ...ops.attention import (decode_multi_attention,
+                                      decode_multi_attention_q8)
+        q = self.query_proj(x)
+        k = self.key_proj(x)
+        v = self.value_proj(x)
+        if self._cache_is_q8(kv):
+            (kc, ks), (vc, vs) = kv
+            out, kc, ks, vc, vs = decode_multi_attention_q8(
+                q, k, v, kc, ks, vc, vs, positions, self._heads)
+            return self.out_proj(out), ((kc, ks), (vc, vs))
+        out, k_cache, v_cache = decode_multi_attention(
+            q, k, v, kv[0], kv[1], positions, self._heads)
+        return self.out_proj(out), (k_cache, v_cache)
+
+    def copy_cache_rows(self, kv, src_slot, src_row, dst_slot, dst_row,
+                        rows):
+        """Copy ``rows`` KV rows between slots — the prefix-cache block
+        copy.  Works on the fp and the int8 (values, scales) layouts
+        alike (scales copy with their rows)."""
+        from ...ops.attention import copy_cache_rows
+        return copy_cache_rows(kv, src_slot, src_row, dst_slot, dst_row,
+                               rows)
+
 
 class PositionwiseFFN(HybridBlock):
     """Transformer FFN block (dense → act → dense), gluon-nlp layout."""
@@ -264,6 +310,31 @@ class TransformerEncoderCell(HybridBlock):
         x = self.attn_ln(x + h)
         return self.ffn_ln(x + self.ffn(x)), kv
 
+    def prefill_suffix(self, x, kv, slot, start):
+        if self._pre_norm:
+            h, kv = self.attention.prefill_suffix(self.attn_ln(x), kv,
+                                                  slot, start)
+            x = x + h
+            return x + self.ffn(self.ffn_ln(x)), kv
+        h, kv = self.attention.prefill_suffix(x, kv, slot, start)
+        x = self.attn_ln(x + h)
+        return self.ffn_ln(x + self.ffn(x)), kv
+
+    def decode_multi(self, x, kv, positions):
+        if self._pre_norm:
+            h, kv = self.attention.decode_multi(self.attn_ln(x), kv,
+                                                positions)
+            x = x + h
+            return x + self.ffn(self.ffn_ln(x)), kv
+        h, kv = self.attention.decode_multi(x, kv, positions)
+        x = self.attn_ln(x + h)
+        return self.ffn_ln(x + self.ffn(x)), kv
+
+    def copy_cache_rows(self, kv, src_slot, src_row, dst_slot, dst_row,
+                        rows):
+        return self.attention.copy_cache_rows(
+            kv, src_slot, src_row, dst_slot, dst_row, rows)
+
 
 class TransformerDecoderCell(HybridBlock):
     """One decoder layer: causal self-attn, cross-attn, FFN (post-norm)."""
@@ -330,6 +401,26 @@ class TransformerEncoder(HybridBlock):
             x, kv = cell.decode_step(x, kv, positions)
             out.append(kv)
         return x, out
+
+    def prefill_suffix(self, x, caches, slot, start):
+        out = []
+        for cell, kv in zip(self._layers, caches):
+            x, kv = cell.prefill_suffix(x, kv, slot, start)
+            out.append(kv)
+        return x, out
+
+    def decode_multi(self, x, caches, positions):
+        out = []
+        for cell, kv in zip(self._layers, caches):
+            x, kv = cell.decode_multi(x, kv, positions)
+            out.append(kv)
+        return x, out
+
+    def copy_cache_rows(self, caches, src_slot, src_row, dst_slot,
+                        dst_row, rows):
+        return [cell.copy_cache_rows(kv, src_slot, src_row, dst_slot,
+                                     dst_row, rows)
+                for cell, kv in zip(self._layers, caches)]
 
 
 def valid_length_mask(valid_length, seq_len):

@@ -128,6 +128,208 @@ def write_prefill_kv_q8(k_cache, k_scale, v_cache, v_scale, key, value,
                         slot), name="write_prefill_kv_q8")
 
 
+def copy_cache_rows(cache, src_slot, src_row, dst_slot, dst_row, rows):
+    """Copy ``rows`` cache rows (one prefix-cache block) between slots.
+
+    ``cache`` is any pytree whose leaves are (max_slots, max_seq, ...)
+    arrays — the fp32 (k, v) pairs and the int8 ((values, scales), ...)
+    layout alike, since the per-(slot, row, head) scales share the
+    leading two axes and copy with their rows.  Slot/row operands may be
+    traced scalars, so ONE compiled executable serves every (src, dst)
+    pair; ``rows`` must be static (the serve.prefix_block bucket).  The
+    engine's block-copy executable is this function jitted with the
+    caches donated."""
+    def one(leaf):
+        tail = (0,) * (leaf.ndim - 2)
+        sizes = (1, rows) + leaf.shape[2:]
+        blk = jax.lax.dynamic_slice(
+            leaf, (src_slot, src_row) + tail, sizes)
+        return jax.lax.dynamic_update_slice(
+            leaf, blk, (dst_slot, dst_row) + tail)
+
+    return jax.tree_util.tree_map(one, cache)
+
+
+def gather_cache_rows(cache, src_slots, src_rows, dst_slot):
+    """Rebuild one destination slot from per-row source coordinates:
+    row ``r`` of ``dst_slot`` becomes row ``src_rows[r]`` of slot
+    ``src_slots[r]``, for every leaf of ``cache`` (same pytree contract
+    as :func:`copy_cache_rows`).  ONE gather plus ONE slot-sized write
+    per leaf — a whole matched prefix path (blocks scattered across
+    donor slots) lands in a single pass, where a per-block
+    dynamic_update_slice chain would rewrite the full cache buffer once
+    per block.  Rows the caller wants untouched are encoded as identity
+    coordinates (``dst_slot``, own row); the gather reads them back
+    unchanged.  All operands may be traced; shapes are static."""
+    def one(leaf):
+        rows = leaf[src_slots, src_rows]
+        return jax.lax.dynamic_update_slice(
+            leaf, rows[None], (dst_slot,) + (0,) * (leaf.ndim - 1))
+
+    return jax.tree_util.tree_map(one, cache)
+
+
+def suffix_prefill_attention(q, k, v, k_cache, v_cache, slot, start, heads):
+    """Prefix-cache suffix prefill: causal attention of a prompt
+    *suffix* (1, Ls, heads*dim) over cache slot ``slot`` whose rows
+    [0, start) already hold a copied prefix.  Writes the suffix K/V at
+    rows [start, start + Ls) and lets query i attend every cache row
+    <= start + i — the copied prefix plus the causal suffix.  ``slot``
+    and ``start`` may be traced; the caller guarantees
+    start + Ls <= max_seq (the engine falls back to full prefill
+    otherwise)."""
+    def fn(q, k, v, kc, vc, s, st):
+        _, ls, hd = q.shape
+        d = hd // heads
+        max_seq = kc.shape[1]
+        s32 = jnp.int32(s) if not hasattr(s, "astype") else \
+            s.astype(jnp.int32)
+        st32 = jnp.int32(st) if not hasattr(st, "astype") else \
+            st.astype(jnp.int32)
+        kh = k.reshape(1, ls, heads, d).astype(kc.dtype)
+        vh = v.reshape(1, ls, heads, d).astype(vc.dtype)
+        kc = jax.lax.dynamic_update_slice(kc, kh, (s32, st32, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vh, (s32, st32, 0, 0))
+        kslot = jax.lax.dynamic_slice(
+            kc, (s32, 0, 0, 0), (1, max_seq, heads, d))[0]
+        vslot = jax.lax.dynamic_slice(
+            vc, (s32, 0, 0, 0), (1, max_seq, heads, d))[0]
+        qh = q.reshape(ls, heads, d)
+        scale = 1.0 / (d ** 0.5)
+        scores = jnp.einsum("qhd,shd->hqs", qh,
+                            kslot.astype(q.dtype)) * scale
+        visible = (jnp.arange(max_seq)[None, :]
+                   <= (st32 + jnp.arange(ls))[:, None])
+        scores = jnp.where(visible[None, :, :], scores, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        out = jnp.einsum("hqs,shd->qhd", att, vslot.astype(q.dtype))
+        return out.reshape(1, ls, hd), kc, vc
+
+    return _invoke(fn, (q, k, v, k_cache, v_cache, slot, start),
+                   name="suffix_prefill_attention")
+
+
+def suffix_prefill_attention_q8(q, k, v, k_cache, k_scale, v_cache,
+                                v_scale, slot, start, heads):
+    """int8-cache variant of :func:`suffix_prefill_attention`: the
+    suffix rows quantize with their own per-(row, head) scales before
+    the write (scales land beside the copied prefix's scales), and the
+    slot's cached K/V dequantizes into the score/value einsums."""
+    def fn(q, k, v, kc, ks, vc, vs, s, st):
+        _, ls, hd = q.shape
+        d = hd // heads
+        max_seq = kc.shape[1]
+        s32 = jnp.int32(s) if not hasattr(s, "astype") else \
+            s.astype(jnp.int32)
+        st32 = jnp.int32(st) if not hasattr(st, "astype") else \
+            st.astype(jnp.int32)
+        kq, ksc = _quantize_kv_rows(k.reshape(1, ls, heads, d))
+        vq, vsc = _quantize_kv_rows(v.reshape(1, ls, heads, d))
+        kc = jax.lax.dynamic_update_slice(kc, kq, (s32, st32, 0, 0))
+        ks = jax.lax.dynamic_update_slice(ks, ksc, (s32, st32, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vq, (s32, st32, 0, 0))
+        vs = jax.lax.dynamic_update_slice(vs, vsc, (s32, st32, 0, 0))
+        kslot = jax.lax.dynamic_slice(
+            kc, (s32, 0, 0, 0), (1, max_seq, heads, d))[0].astype(q.dtype)
+        kssl = jax.lax.dynamic_slice(
+            ks, (s32, 0, 0, 0), (1, max_seq, heads, 1))[0].astype(q.dtype)
+        vslot = jax.lax.dynamic_slice(
+            vc, (s32, 0, 0, 0), (1, max_seq, heads, d))[0].astype(q.dtype)
+        vssl = jax.lax.dynamic_slice(
+            vs, (s32, 0, 0, 0), (1, max_seq, heads, 1))[0].astype(q.dtype)
+        qh = q.reshape(ls, heads, d)
+        scale = 1.0 / (d ** 0.5)
+        scores = jnp.einsum("qhd,shd->hqs", qh, kslot * kssl) * scale
+        visible = (jnp.arange(max_seq)[None, :]
+                   <= (st32 + jnp.arange(ls))[:, None])
+        scores = jnp.where(visible[None, :, :], scores, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        out = jnp.einsum("hqs,shd->qhd", att, vslot * vssl)
+        return out.reshape(1, ls, hd), kc, ks, vc, vs
+
+    return _invoke(fn, (q, k, v, k_cache, k_scale, v_cache, v_scale,
+                        slot, start), name="suffix_prefill_attention_q8")
+
+
+def decode_multi_attention(query, key, value, k_cache, v_cache, positions,
+                           heads):
+    """k-token cached attention — the speculative-decoding verify step.
+
+    ``query``/``key``/``value`` are (slots, t, heads*dim) projections of
+    t tokens per slot; slot i's token j lands at cache row
+    positions[i] + j (scatter rows clip at max_seq - 1 like
+    :func:`decode_attention` — clipped writes only ever touch rows above
+    the slot's position counter, which are rewritten before becoming
+    visible).  Query j attends rows <= positions + j, so the t tokens
+    verify causally in ONE batched call."""
+    def fn(q, k, v, kc, vc, pos):
+        n, t, hd = q.shape
+        d = hd // heads
+        max_seq = kc.shape[1]
+        rows = jnp.clip(pos.astype(jnp.int32)[:, None] + jnp.arange(t),
+                        0, max_seq - 1)
+        lane = jnp.arange(n)[:, None]
+        kc = kc.at[lane, rows].set(k.reshape(n, t, heads, d)
+                                   .astype(kc.dtype))
+        vc = vc.at[lane, rows].set(v.reshape(n, t, heads, d)
+                                   .astype(vc.dtype))
+        qh = q.reshape(n, t, heads, d)
+        scale = 1.0 / (d ** 0.5)
+        scores = jnp.einsum("nqhd,nshd->nhqs", qh,
+                            kc.astype(q.dtype)) * scale
+        limit = pos.astype(jnp.int32)[:, None] + jnp.arange(t)
+        visible = (jnp.arange(max_seq)[None, None, :]
+                   <= limit[:, :, None])[:, None, :, :]
+        scores = jnp.where(visible, scores, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        out = jnp.einsum("nhqs,nshd->nqhd", att, vc.astype(q.dtype))
+        return out.reshape(n, t, hd), kc, vc
+
+    return _invoke(fn, (query, key, value, k_cache, v_cache, positions),
+                   name="decode_multi_attention")
+
+
+def decode_multi_attention_q8(query, key, value, k_cache, k_scale, v_cache,
+                              v_scale, positions, heads):
+    """int8-cache variant of :func:`decode_multi_attention`: each of the
+    t written rows quantizes with its own (slot, row, head) scale, the
+    dequant fusing into the einsums exactly like
+    :func:`decode_attention_q8`."""
+    def fn(q, k, v, kc, ks, vc, vs, pos):
+        n, t, hd = q.shape
+        d = hd // heads
+        max_seq = kc.shape[1]
+        rows = jnp.clip(pos.astype(jnp.int32)[:, None] + jnp.arange(t),
+                        0, max_seq - 1)
+        lane = jnp.arange(n)[:, None]
+        kq, ksc = _quantize_kv_rows(k.reshape(n, t, heads, d))
+        vq, vsc = _quantize_kv_rows(v.reshape(n, t, heads, d))
+        kc = kc.at[lane, rows].set(kq)
+        ks = ks.at[lane, rows].set(ksc)
+        vc = vc.at[lane, rows].set(vq)
+        vs = vs.at[lane, rows].set(vsc)
+        qh = q.reshape(n, t, heads, d)
+        scale = 1.0 / (d ** 0.5)
+        kf = kc.astype(q.dtype) * ks.astype(q.dtype)
+        scores = jnp.einsum("nqhd,nshd->nhqs", qh, kf) * scale
+        limit = pos.astype(jnp.int32)[:, None] + jnp.arange(t)
+        visible = (jnp.arange(max_seq)[None, None, :]
+                   <= limit[:, :, None])[:, None, :, :]
+        scores = jnp.where(visible, scores, -1e30)
+        att = jax.nn.softmax(scores.astype(jnp.float32),
+                             axis=-1).astype(q.dtype)
+        vf = vc.astype(q.dtype) * vs.astype(q.dtype)
+        out = jnp.einsum("nhqs,nshd->nqhd", att, vf)
+        return out.reshape(n, t, hd), kc, ks, vc, vs
+
+    return _invoke(fn, (query, key, value, k_cache, k_scale, v_cache,
+                        v_scale, positions),
+                   name="decode_multi_attention_q8")
+
+
 def decode_attention_q8(query, key, value, k_cache, k_scale, v_cache,
                         v_scale, positions, heads):
     """int8-cache variant of :func:`decode_attention`: the cache crosses

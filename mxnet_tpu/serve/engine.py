@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from .. import config as _config
+from .. import fault as _fault
 from .. import functional as _functional
 from .. import goodput as _goodput
 from .. import insight as _insight
@@ -49,6 +50,7 @@ from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..base import MXNetError
 from . import quantize as _quantize
+from .prefix import RadixIndex
 
 __all__ = ["Request", "ServeEngine", "EngineBusy", "load"]
 
@@ -117,6 +119,59 @@ _telemetry.declare_metric(
     "past goodput.burn_threshold the engine's /healthz goes red (the "
     "autoscaler admission signal)")
 
+_telemetry.declare_metric(
+    "serve.prefix_hits_total", "counter",
+    "admissions that reused a cached KV prefix (radix prefix cache): "
+    "matched blocks were row-copied and only the suffix prefilled")
+_telemetry.declare_metric(
+    "serve.prefix_misses_total", "counter",
+    "admissions that prefilled the whole prompt (no cached prefix, a "
+    "suffix that would overrun max_seq, or a serve.prefix_evict "
+    "injection between match and copy)")
+_telemetry.declare_metric(
+    "serve.prefix_tokens_reused_total", "counter",
+    "prompt tokens whose KV was row-copied from the prefix cache "
+    "instead of recomputed by prefill")
+_telemetry.declare_metric(
+    "serve.prefix_evictions_total", "counter",
+    "KV blocks dropped from the radix index (slot reuse, LRU capacity "
+    "pressure, or the serve.prefix_evict chaos injection)")
+_telemetry.declare_metric(
+    "serve.prefix_blocks", "gauge",
+    "KV blocks currently indexed by the engine's radix prefix cache")
+_telemetry.declare_metric(
+    "serve.spec_rounds_total", "counter",
+    "speculative-decoding rounds dispatched (one draft propose + one "
+    "batched big-model verify per round)")
+_telemetry.declare_metric(
+    "serve.spec_proposed_total", "counter",
+    "draft tokens proposed by speculative decoding (k per live slot "
+    "per round)")
+_telemetry.declare_metric(
+    "serve.spec_accepted_total", "counter",
+    "draft proposals the big-model verify accepted (the emitted "
+    "correction token is not counted)")
+_telemetry.declare_metric(
+    "serve.spec_acceptance_rate", "gauge",
+    "trailing draft-acceptance ratio (accepted / proposed) — the "
+    "knob that decides whether speculation pays for its draft")
+_telemetry.declare_metric(
+    "serve.class_ttft_seconds", "histogram",
+    "per-SLO-class time to first token (labelled slo_class; the "
+    "unlabelled serve.ttft_seconds carries the aggregate)",
+    buckets=_telemetry.TIME_BUCKETS)
+_telemetry.declare_metric(
+    "serve.class_tpot_seconds", "histogram",
+    "per-SLO-class time per output token (labelled slo_class)",
+    buckets=_telemetry.TIME_BUCKETS)
+_telemetry.declare_metric(
+    "serve.class_queue_depth", "gauge",
+    "queued requests per SLO class (labelled slo_class)")
+_telemetry.declare_metric(
+    "serve.aged_admissions_total", "counter",
+    "admissions where starvation aging (serve.class_aging_ms) "
+    "promoted a request ahead of strict class priority")
+
 #: weight-storage modes ServeEngine(quantize=...) understands; combine
 #: with "," (e.g. "int4_weights,int8_kv")
 QUANTIZE_MODES = ("int8_weights", "int4_weights", "int8_kv")
@@ -175,13 +230,22 @@ class Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "generated",
                  "slot", "finished", "rejected", "reject_reason",
                  "t_submit", "t_admitted", "t_first",
-                 "t_done", "phases", "_span", "_enq")
+                 "t_done", "phases", "_span", "_enq",
+                 "slo_class", "prefix_tokens", "_nodes")
 
-    def __init__(self, rid, prompt, max_new_tokens, eos_id=None):
+    def __init__(self, rid, prompt, max_new_tokens, eos_id=None,
+                 slo_class="default"):
         self.id = rid
         self.prompt = list(prompt)
         self.max_new_tokens = max(1, int(max_new_tokens))
         self.eos_id = eos_id
+        #: admission-priority class (serve.slo_classes; "default" when
+        #: the engine runs classless)
+        self.slo_class = slo_class
+        #: prompt tokens served from the radix prefix cache (KV rows
+        #: copied instead of recomputed); 0 = full prefill
+        self.prefix_tokens = 0
+        self._nodes = ()   # pinned radix path, released at _finish
         self.generated = []
         self.slot = None
         self.finished = False
@@ -284,7 +348,8 @@ class ServeEngine:
 
     def __init__(self, model, max_slots=None, max_seq=None, buckets=None,
                  eos_id=None, temperature=0.0, seed=0, quantize=None,
-                 drain_window=None, cache_dtype="float32"):
+                 drain_window=None, cache_dtype="float32", draft=None,
+                 prefix_cache=None):
         for attr in ("init_cache", "prefill", "decode_step"):
             if not callable(getattr(model, attr, None)):
                 raise MXNetError(
@@ -341,6 +406,8 @@ class ServeEngine:
             drain_window if drain_window is not None
             else _config.get("serve.drain_window"))
         self._exe = {}
+        import types
+        self._aux_exe_owner = types.SimpleNamespace()
         self._warmed = False
         self.compiles = 0
         self.post_warmup_compiles = 0
@@ -357,6 +424,86 @@ class ServeEngine:
         self._slo_tpot = float(_config.get("serve.slo_tpot_ms")) / 1e3
         self._slo_events = collections.deque(maxlen=2048)
         self._phase_cap = int(_config.get("serve.phase_sampling"))
+        # -- SLO classes: strict-priority admission over one queue ------
+        spec = str(_config.get("serve.slo_classes") or "")
+        self._classes = [c.strip() for c in spec.split(",") if c.strip()] \
+            or ["default"]
+        if len(set(self._classes)) != len(self._classes):
+            raise MXNetError(
+                f"duplicate class in serve.slo_classes {spec!r}")
+        self._class_rank = {c: i for i, c in enumerate(self._classes)}
+        self._class_bounds = {}
+        bspec = str(_config.get("serve.class_max_queue") or "")
+        for part in (p.strip() for p in bspec.split(",") if p.strip()):
+            cls, _, bound = part.partition("=")
+            cls = cls.strip()
+            if cls not in self._class_rank or not bound.strip().isdigit():
+                raise MXNetError(
+                    f"bad serve.class_max_queue entry {part!r} (classes: "
+                    f"{', '.join(self._classes)})")
+            self._class_bounds[cls] = int(bound)
+        self._aging = float(_config.get("serve.class_aging_ms")) / 1e3
+        self._aged_admissions = 0
+        # -- radix prefix cache -----------------------------------------
+        if prefix_cache is None:
+            prefix_cache = bool(_config.get("serve.prefix_cache"))
+        self._prefix = None
+        self._prefix_block = int(_config.get("serve.prefix_block"))
+        if prefix_cache:
+            if self._prefix_block <= 0:
+                raise MXNetError("serve.prefix_block must be positive")
+            for attr in ("prefill_suffix", "copy_cache_rows"):
+                if not callable(getattr(model, attr, None)):
+                    raise MXNetError(
+                        f"model {type(model).__name__} has no {attr}(); "
+                        "the prefix cache needs the suffix-prefill block "
+                        "surface (docs/SERVING.md 'Prefix caching')")
+            self._prefix = RadixIndex(
+                self._prefix_block,
+                int(_config.get("serve.prefix_capacity")))
+        # -- speculative decoding (draft model) -------------------------
+        self.draft = draft
+        self._spec_k = 0
+        self._draft_params = None
+        self._draft_cache = None
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if draft is not None:
+            if self.temperature != 0.0:
+                raise MXNetError(
+                    "speculative decoding needs temperature=0: the "
+                    "verify keeps greedy output token-for-token "
+                    "identical, which has no sampled analogue here")
+            if not callable(getattr(model, "decode_multi", None)):
+                raise MXNetError(
+                    f"model {type(model).__name__} has no decode_multi();"
+                    " the speculative verify needs the multi-token "
+                    "decode surface (docs/SERVING.md)")
+            for attr in ("init_cache", "prefill", "decode_step"):
+                if not callable(getattr(draft, attr, None)):
+                    raise MXNetError(
+                        f"draft {type(draft).__name__} has no {attr}(); "
+                        "the draft must expose the same KV-cache "
+                        "surface as the served model")
+            if self._prefix is not None:
+                for attr in ("prefill_suffix", "copy_cache_rows"):
+                    if not callable(getattr(draft, attr, None)):
+                        raise MXNetError(
+                            f"draft {type(draft).__name__} has no "
+                            f"{attr}(); combining the prefix cache with "
+                            "speculative decoding needs it on the draft "
+                            "too (its KV rows are copied alongside)")
+            self._spec_k = max(2, int(_config.get("serve.spec_tokens")))
+            self._ensure_initialized(draft)
+            # draft weights stay float: the draft is small by design and
+            # the verify keeps output quality pinned to the big model
+            self._draft_params = _functional.param_arrays(draft)
+            dcache = draft.init_cache(self.max_slots, self.max_seq,
+                                      dtype=cache_dtype)
+            self._draft_cache = jax.tree_util.tree_map(
+                _functional._raw, dcache,
+                is_leaf=lambda x: hasattr(x, "_data"))
         self._register_health()
 
     def _register_health(self):
@@ -392,14 +539,15 @@ class ServeEngine:
             return _quantize.quantize_params_int4(params)
         return params, {}, {}
 
-    def _ensure_initialized(self):
+    def _ensure_initialized(self, model=None):
         """Materialize deferred params with one tiny eager forward —
         shape inference must not happen inside an AOT trace."""
+        model = self.model if model is None else model
         needs = any(p._data is None
-                    for p in self.model.collect_params().values())
+                    for p in model.collect_params().values())
         if needs:
             from .. import numpy as np
-            self.model(np.zeros((1, min(2, self.max_seq)), dtype="int32"))
+            model(np.zeros((1, min(2, self.max_seq)), dtype="int32"))
 
     def _full_params(self):
         pt, qt = self._params
@@ -418,7 +566,12 @@ class ServeEngine:
     def _compile(self, kind, build_args):
         """AOT lower+compile one step executable, accounted through the
         PR 2 recompile detector (telemetry.note_compile) so a post-warmup
-        compile trips RecompileWarning exactly like a re-tracing block."""
+        compile trips RecompileWarning exactly like a re-tracing block.
+        The base grid (decode + prefill buckets) counts against the
+        engine; the prefix/spec surface (copy + suffix buckets + spec)
+        is a second planned grid and counts against its own owner, so a
+        fully-featured warmup does not trip the per-block signature
+        heuristic while real post-warmup escapes still do."""
         t0 = time.perf_counter()
         jitted, args = build_args()
         exe = jitted.lower(*args).compile()
@@ -428,7 +581,9 @@ class ServeEngine:
             self.post_warmup_compiles += 1
             if _telemetry._active:
                 _telemetry.inc("serve.post_warmup_compiles_total")
-        _telemetry.note_compile(self, f"serve.{kind}", dt,
+        owner = self if (kind == "decode" or kind.startswith("prefill")) \
+            else self._aux_exe_owner
+        _telemetry.note_compile(owner, f"serve.{kind}", dt,
                                 signatures=len(self._exe) + 1)
         if _insight._active:
             # attribution capture from the AOT executable we already
@@ -484,6 +639,181 @@ class ServeEngine:
         }
         return cache, new_state, (tok, done)
 
+    # cache trees ride the copy / suffix / spec executables as ONE
+    # donated pytree so a spec engine's draft cache moves with the big
+    # model's — one dispatch, one donation story
+    def _cache_tree(self):
+        if self.draft is not None:
+            return (self._cache, self._draft_cache)
+        return self._cache
+
+    def _set_cache_tree(self, tree):
+        if self.draft is not None:
+            self._cache, self._draft_cache = tree
+        else:
+            self._cache = tree
+
+    def _copy_blocks(self, caches, src_slots, src_rows, dst_slot):
+        """Traced matched-path copy: row r of ``dst_slot`` becomes row
+        src_rows[r] of slot src_slots[r] (shape (max_seq,), so the
+        executable never depends on the match length).  Rows past the
+        matched prefix are encoded by the caller as identity
+        coordinates.  ONE gather per leaf, inlined into the
+        suffix-prefill executables — a prefix-hit admission is ONE
+        dispatch, same as a miss, or the copy overhead eats the reuse
+        win."""
+        from ..ops import attention as _att
+        return _att.gather_cache_rows(caches, src_slots, src_rows,
+                                      dst_slot)
+
+    def _suffix_fn(self, params, cache, state, suffix, src_slots,
+                   src_rows, slot, start, length, limit):
+        """Prefix-cache admission, fused: copy the matched KV block
+        path into rows [0, start) of ``slot``, then run only the
+        ``length``-token suffix (padded to its bucket) and sample from
+        its last real row."""
+        pt, qt = params
+        full = (_quantize.dequantize_params(pt, qt, self._qdtypes)
+                if qt else pt)
+        cache = self._copy_blocks(cache, src_slots, src_rows, slot)
+        key, kf, ks = jax.random.split(state["key"], 3)
+        (logits, cache), _ = _functional.functional_call(
+            self.model, full, suffix[None, :], cache, slot, start,
+            rng_key=kf, method="prefill_suffix")
+        tok = self._sample(logits[0, length - 1][None, :], ks)[0]
+        end = start + length
+        hit_eos = (tok == self.eos_id) if self.eos_id is not None \
+            else jnp.array(False)
+        done = hit_eos | (end >= limit)
+        new_state = {
+            "tokens": state["tokens"].at[slot].set(tok),
+            "positions": state["positions"].at[slot].set(end),
+            "done": state["done"].at[slot].set(done),
+            "limits": state["limits"].at[slot].set(limit),
+            "key": key,
+        }
+        return cache, new_state, (tok, done)
+
+    def _prefill_spec_fn(self, params, dparams, caches, state, prompt,
+                         slot, length, limit):
+        """Spec-mode prefill: the prompt also runs through the draft so
+        its cache holds the same context the big model's does."""
+        cache, dcache = caches
+        pt, qt = params
+        full = (_quantize.dequantize_params(pt, qt, self._qdtypes)
+                if qt else pt)
+        key, kf, ks = jax.random.split(state["key"], 3)
+        (logits, cache), _ = _functional.functional_call(
+            self.model, full, prompt[None, :], cache, slot,
+            rng_key=kf, method="prefill")
+        (_, dcache), _ = _functional.functional_call(
+            self.draft, dparams, prompt[None, :], dcache, slot,
+            rng_key=kf, method="prefill")
+        tok = self._sample(logits[0, length - 1][None, :], ks)[0]
+        hit_eos = (tok == self.eos_id) if self.eos_id is not None \
+            else jnp.array(False)
+        done = hit_eos | (length >= limit)
+        new_state = {
+            "tokens": state["tokens"].at[slot].set(tok),
+            "positions": state["positions"].at[slot].set(length),
+            "done": state["done"].at[slot].set(done),
+            "limits": state["limits"].at[slot].set(limit),
+            "key": key,
+        }
+        return (cache, dcache), new_state, (tok, done)
+
+    def _suffix_spec_fn(self, params, dparams, caches, state, suffix,
+                        src_slots, src_rows, slot, start, length,
+                        limit):
+        caches = self._copy_blocks(caches, src_slots, src_rows, slot)
+        cache, dcache = caches
+        pt, qt = params
+        full = (_quantize.dequantize_params(pt, qt, self._qdtypes)
+                if qt else pt)
+        key, kf, ks = jax.random.split(state["key"], 3)
+        (logits, cache), _ = _functional.functional_call(
+            self.model, full, suffix[None, :], cache, slot, start,
+            rng_key=kf, method="prefill_suffix")
+        (_, dcache), _ = _functional.functional_call(
+            self.draft, dparams, suffix[None, :], dcache, slot, start,
+            rng_key=kf, method="prefill_suffix")
+        tok = self._sample(logits[0, length - 1][None, :], ks)[0]
+        end = start + length
+        hit_eos = (tok == self.eos_id) if self.eos_id is not None \
+            else jnp.array(False)
+        done = hit_eos | (end >= limit)
+        new_state = {
+            "tokens": state["tokens"].at[slot].set(tok),
+            "positions": state["positions"].at[slot].set(end),
+            "done": state["done"].at[slot].set(done),
+            "limits": state["limits"].at[slot].set(limit),
+            "key": key,
+        }
+        return (cache, dcache), new_state, (tok, done)
+
+    def _spec_fn(self, params, dparams, caches, state):
+        """One speculative round, ONE dispatch: the draft proposes k
+        tokens greedily against its own cache, then the big model
+        verifies all k in a single batched ``decode_multi`` call.
+
+        Acceptance is the standard greedy rule — proposal i stands iff
+        every earlier proposal matched the big model's argmax — and the
+        first disagreement is replaced by the big model's own token, so
+        the emitted stream is token-for-token the non-speculative greedy
+        output.  A slot emits between 1 and k tokens per round (0 when
+        already done); rows written past the accepted point are garbage
+        the next round overwrites before anything attends to them."""
+        cache, dcache = caches
+        pt, qt = params
+        full = (_quantize.dequantize_params(pt, qt, self._qdtypes)
+                if qt else pt)
+        n, k = self.max_slots, self._spec_k
+        key, kf = jax.random.split(state["key"], 2)
+        pos0 = state["positions"]
+        cur = state["tokens"]
+        drafts = []
+        for i in range(k):
+            (dlogits, dcache), _ = _functional.functional_call(
+                self.draft, dparams, cur[:, None], dcache, pos0 + i,
+                rng_key=kf, method="decode_step")
+            cur = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            drafts.append(cur)
+        d = jnp.stack(drafts, axis=1)                      # (n, k)
+        seq = jnp.concatenate([state["tokens"][:, None], d[:, :k - 1]],
+                              axis=1)
+        (logits, cache), _ = _functional.functional_call(
+            self.model, full, seq, cache, pos0,
+            rng_key=kf, method="decode_multi")
+        b = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (n, k)
+        ones = jnp.ones((n, 1), bool)
+        ok = jnp.concatenate(
+            [ones, jnp.cumprod((d[:, :k - 1] == b[:, :k - 1])
+                               .astype(jnp.int32), axis=1).astype(bool)],
+            axis=1)
+        pos_i = pos0[:, None] + 1 + jnp.arange(k)[None, :]
+        hit_eos = (b == self.eos_id) if self.eos_id is not None \
+            else jnp.zeros(b.shape, bool)
+        stop = hit_eos | (pos_i >= state["limits"][:, None])
+        before_stop = jnp.concatenate(
+            [ones, jnp.cumprod((~stop[:, :k - 1]).astype(jnp.int32),
+                               axis=1).astype(bool)], axis=1)
+        live = ~state["done"]
+        valid = ok & before_stop & live[:, None]
+        toks = jnp.where(valid, b, -1)
+        nvalid = valid.sum(axis=1)          # >= 1 for every live slot
+        last = jnp.maximum(nvalid - 1, 0)[:, None]
+        last_tok = jnp.take_along_axis(b, last, axis=1)[:, 0]
+        last_stop = jnp.take_along_axis(stop, last, axis=1)[:, 0]
+        new_done = state["done"] | (live & last_stop)
+        new_state = {
+            "tokens": jnp.where(live, last_tok, state["tokens"]),
+            "positions": jnp.where(live, pos0 + nvalid, pos0),
+            "done": new_done,
+            "limits": state["limits"],
+            "key": key,
+        }
+        return (cache, dcache), new_state, (toks, new_done)
+
     def _decode_exe(self):
         exe = self._exe.get("decode")
         if exe is None:
@@ -499,23 +829,76 @@ class ServeEngine:
         exe = self._exe.get(key)
         if exe is None:
             def build():
-                jitted = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
                 scalar = jax.ShapeDtypeStruct((), jnp.int32)
+                prompt = jax.ShapeDtypeStruct((bucket,), jnp.int32)
+                if self.draft is not None:
+                    jitted = jax.jit(self._prefill_spec_fn,
+                                     donate_argnums=(2, 3))
+                    return jitted, (_sds(self._params),
+                                    _sds(self._draft_params),
+                                    _sds(self._cache_tree()),
+                                    _sds(self._state), prompt,
+                                    scalar, scalar, scalar)
+                jitted = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
                 return jitted, (_sds(self._params), _sds(self._cache),
-                                _sds(self._state),
-                                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                                _sds(self._state), prompt,
                                 scalar, scalar, scalar)
             exe = self._exe[key] = self._compile(f"prefill_{bucket}", build)
         return exe
 
+    def _suffix_exe(self, bucket):
+        key = ("suffix", bucket)
+        exe = self._exe.get(key)
+        if exe is None:
+            def build():
+                scalar = jax.ShapeDtypeStruct((), jnp.int32)
+                suffix = jax.ShapeDtypeStruct((bucket,), jnp.int32)
+                vec = jax.ShapeDtypeStruct((self.max_seq,), jnp.int32)
+                if self.draft is not None:
+                    jitted = jax.jit(self._suffix_spec_fn,
+                                     donate_argnums=(2, 3))
+                    return jitted, (_sds(self._params),
+                                    _sds(self._draft_params),
+                                    _sds(self._cache_tree()),
+                                    _sds(self._state), suffix,
+                                    vec, vec,
+                                    scalar, scalar, scalar, scalar)
+                jitted = jax.jit(self._suffix_fn, donate_argnums=(1, 2))
+                return jitted, (_sds(self._params), _sds(self._cache),
+                                _sds(self._state), suffix,
+                                vec, vec,
+                                scalar, scalar, scalar, scalar)
+            exe = self._exe[key] = self._compile(f"suffix_{bucket}", build)
+        return exe
+
+    def _spec_exe(self):
+        exe = self._exe.get("spec")
+        if exe is None:
+            def build():
+                jitted = jax.jit(self._spec_fn, donate_argnums=(2, 3))
+                return jitted, (_sds(self._params),
+                                _sds(self._draft_params),
+                                _sds(self._cache_tree()),
+                                _sds(self._state))
+            exe = self._exe["spec"] = self._compile("spec", build)
+        return exe
+
     def warmup(self):
-        """Compile the full executable grid (decode + one prefill per
-        bucket). After this the engine never compiles again for any
-        request mix whose prompts fit the buckets — the recompile-guard
-        regression test pins that down."""
-        self._decode_exe()
+        """Compile the full executable grid: decode (or the speculative
+        propose+verify round when a draft is attached) + one prefill per
+        bucket, plus one fused block-copy + suffix-prefill per bucket
+        when the prefix cache is on. After this the engine never
+        compiles again for any request mix whose prompts fit the
+        buckets — the recompile-guard regression test pins that down."""
+        if self.draft is not None:
+            self._spec_exe()
+        else:
+            self._decode_exe()
         for b in self.buckets:
             self._prefill_exe(b)
+        if self._prefix is not None:
+            for b in self.buckets:
+                self._suffix_exe(b)
         self._warmed = True
         return self
 
@@ -529,13 +912,21 @@ class ServeEngine:
             f"prompt length {length} exceeds the largest bucket "
             f"{self.buckets[-1]} (serve.buckets, max_seq={self.max_seq})")
 
-    def submit(self, prompt, max_new_tokens=32, eos_id="engine"):
+    def submit(self, prompt, max_new_tokens=32, eos_id="engine",
+               slo_class=None):
         """Enqueue one request; returns its :class:`Request` handle.
-        Admission happens inside :meth:`step` when a slot frees up."""
+        Admission happens inside :meth:`step` when a slot frees up.
+        ``slo_class`` names one of ``serve.slo_classes`` (priority
+        admission); ``None`` takes the lowest-priority (last) class."""
         prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
         if not prompt:
             raise MXNetError("empty prompt")
         self.bucket_for(len(prompt))  # validate now, not at admission
+        cls = self._classes[-1] if slo_class is None else str(slo_class)
+        if cls not in self._class_rank:
+            raise MXNetError(
+                f"unknown slo_class {cls!r} (serve.slo_classes: "
+                f"{', '.join(self._classes)})")
         if self._stopping:
             if _telemetry._active:
                 _telemetry.inc("serve.rejected_total", reason="stopping")
@@ -546,8 +937,17 @@ class ServeEngine:
                 _telemetry.inc("serve.rejected_total", reason="queue_full")
             raise EngineBusy("queue_full", len(self._queue), self._max_queue,
                              retry_after_hint=self._retry_after_hint())
+        bound = self._class_bounds.get(cls, 0)
+        if bound and sum(1 for r in self._queue
+                         if r.slo_class == cls) >= bound:
+            if _telemetry._active:
+                _telemetry.inc("serve.rejected_total",
+                               reason="class_queue_full")
+            raise EngineBusy("class_queue_full", len(self._queue), bound,
+                             retry_after_hint=self._retry_after_hint())
         req = Request(self._next_id, prompt, max_new_tokens,
-                      self.eos_id if eos_id == "engine" else eos_id)
+                      self.eos_id if eos_id == "engine" else eos_id,
+                      slo_class=cls)
         self._next_id += 1
         self._queue.append(req)
         if _trace._active:
@@ -570,6 +970,11 @@ class ServeEngine:
             self._free.append(req.slot)
             self._free.sort(reverse=True)
             req.slot = None
+        if req._nodes:
+            # unpin the request's radix path — its blocks become
+            # LRU-evictable again
+            self._prefix.release(list(req._nodes))
+            req._nodes = ()
         self._completed.append(req)
         if req._enq is not None:  # finished without ever being admitted
             req._enq.end()
@@ -582,8 +987,11 @@ class ServeEngine:
             _telemetry.inc("serve.tokens_total", len(req.generated))
             if req.tpot is not None:
                 _telemetry.observe("serve.tpot_seconds", req.tpot)
+                _telemetry.observe("serve.class_tpot_seconds", req.tpot,
+                                   slo_class=req.slo_class)
         if self._slo_tpot and req.tpot is not None:
-            self._slo_observe("tpot", req.tpot > self._slo_tpot)
+            self._slo_observe("tpot", req.tpot > self._slo_tpot,
+                              req.slo_class)
 
     def _prefill_sink(self, req):
         def sink(fetched):
@@ -594,8 +1002,11 @@ class ServeEngine:
             req.generated.append(tok)
             if _telemetry._active and req.ttft is not None:
                 _telemetry.observe("serve.ttft_seconds", req.ttft)
+                _telemetry.observe("serve.class_ttft_seconds", req.ttft,
+                                   slo_class=req.slo_class)
             if self._slo_ttft and req.ttft is not None:
-                self._slo_observe("ttft", req.ttft > self._slo_ttft)
+                self._slo_observe("ttft", req.ttft > self._slo_ttft,
+                                  req.slo_class)
             if done:
                 self._finish(req)
             if _trace._active and span_ctx is not None:
@@ -625,45 +1036,214 @@ class ServeEngine:
                                 request=req.id)
         return sink
 
+    def _next_request(self):
+        """Dequeue under strict class priority (``serve.slo_classes``
+        order, FIFO within a class), with the starvation-aging escape
+        hatch: once a request waits past ``serve.class_aging_ms`` it
+        competes on age alone, so a saturated high class cannot starve
+        the low classes forever."""
+        q = self._queue
+        if len(self._classes) == 1 or len(q) == 1:
+            return q.popleft()
+        best, best_rank = None, len(self._classes)
+        for r in q:
+            rank = self._class_rank[r.slo_class]
+            if rank < best_rank:
+                best, best_rank = r, rank
+                if rank == 0:
+                    break
+        req = best
+        if self._aging:
+            now = time.perf_counter()
+            aged = [r for r in q if (now - r.t_submit) >= self._aging]
+            if aged:
+                oldest = min(aged, key=lambda r: r.t_submit)
+                if oldest is not best:
+                    req = oldest
+                    self._aged_admissions += 1
+                    if _telemetry._active:
+                        _telemetry.inc("serve.aged_admissions_total")
+        q.remove(req)
+        return req
+
+    def _pick_slot(self):
+        """Free-slot choice.  Without the prefix cache: lowest slot
+        (the original behaviour).  With it: the *coldest* free slot —
+        the one whose newest indexed block is oldest, never-indexed
+        first — so admissions overwrite the least-reusable KV rows."""
+        if self._prefix is None or len(self._free) == 1:
+            return self._free.pop()
+        slot = min(self._free,
+                   key=lambda s: (self._prefix.slot_heat(s), s))
+        self._free.remove(slot)
+        return slot
+
+    def _spec_sink(self, slot_map):
+        """Drain sink for a speculative round: each live slot carries up
+        to k token ids (-1 padded past the accepted point).  Acceptance
+        accounting happens here, host-side — a live slot always emits at
+        least one token (the big model's own), so ``emitted - 1`` is the
+        number of draft proposals that survived the verify."""
+        def sink(fetched):
+            t0u = _profiler.now_us() if _trace._active else 0
+            toks, done = fetched
+            k, proposed, accepted = self._spec_k, 0, 0
+            for slot, req in slot_map.items():
+                if req.finished:
+                    continue  # finished in an older entry of this window
+                span_ctx = (req._span.context
+                            if req._span is not None else None)
+                emitted = [int(t) for t in toks[slot] if int(t) >= 0]
+                req.generated.extend(emitted)
+                if emitted:
+                    # rows with no emit were already done on device —
+                    # the draft proposed nothing real for them
+                    proposed += k
+                    accepted += len(emitted) - 1
+                if bool(done[slot]):
+                    self._finish(req)
+                if _trace._active and span_ctx is not None and emitted:
+                    _trace.emit("serve.drain", t0u,
+                                _profiler.now_us() - t0u,
+                                parent=span_ctx, category="serve",
+                                request=req.id, tokens=len(emitted))
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
+            if _telemetry._active and proposed:
+                _telemetry.inc("serve.spec_proposed_total", proposed)
+                _telemetry.inc("serve.spec_accepted_total", accepted)
+                _telemetry.set_gauge(
+                    "serve.spec_acceptance_rate",
+                    round(self._spec_accepted
+                          / max(1, self._spec_proposed), 4))
+        return sink
+
     def _admit(self):
         admitted = 0
         while self._queue and self._free:
-            req = self._queue.popleft()
-            slot = self._free.pop()
-            length = len(req.prompt)
+            self._dispatch_prefill(self._next_request(), self._pick_slot())
+            admitted += 1
+        return admitted
+
+    def _dispatch_prefill(self, req, slot):
+        """Admit ``req`` into ``slot``.  With the prefix cache on, the
+        longest indexed prompt prefix is row-copied from its donor slot
+        (block granular) and only the suffix runs through prefill; the
+        whole prompt is then (re)indexed under this slot and pinned
+        until the request finishes."""
+        length = len(req.prompt)
+        limit = min(length + req.max_new_tokens - 1, self.max_seq - 1)
+        t0u = _profiler.now_us() if _trace._active else 0
+        t0p = time.perf_counter()
+        nodes, start, sbucket = (), 0, None
+        if self._prefix is not None:
+            nodes = tuple(self._prefix.match(req.prompt))
+            if nodes and _fault._active \
+                    and _fault.fire("serve.prefix_evict"):
+                # chaos: the matched prefix vanishes between match and
+                # copy — the engine must fall back to a full prefill
+                dropped = self._prefix.evict_path(list(nodes))
+                if dropped and _telemetry._active:
+                    _telemetry.inc("serve.prefix_evictions_total", dropped)
+                nodes = ()
+            if nodes:
+                start = len(nodes) * self._prefix_block
+                sbucket = self.bucket_for(length - start)
+                if start + sbucket > self.max_seq:
+                    # the padded suffix would overrun the cache rows
+                    nodes, start, sbucket = (), 0, None
+        if nodes:
+            # the destination slot's stale rows leave the index first
+            evicted = self._prefix.evict_slot(slot)
+            if evicted and _telemetry._active:
+                _telemetry.inc("serve.prefix_evictions_total", evicted)
+            # per-row source coordinates for the matched prefix; rows
+            # past it are identity (dest slot, own row) — untouched
+            blk = self._prefix_block
+            src_slots = onp.full((self.max_seq,), slot, dtype=onp.int32)
+            src_rows = onp.arange(self.max_seq, dtype=onp.int32)
+            for i, node in enumerate(nodes):
+                src_slots[i * blk:(i + 1) * blk] = node.slot
+                src_rows[i * blk:(i + 1) * blk] = onp.arange(
+                    node.row, node.row + blk, dtype=onp.int32)
+            suffix = req.prompt[start:]
+            padded = onp.zeros((sbucket,), dtype=onp.int32)
+            padded[:len(suffix)] = suffix
+            exe = self._suffix_exe(sbucket)
+            if self.draft is not None:
+                tree, self._state, emit = exe(
+                    self._params, self._draft_params, self._cache_tree(),
+                    self._state, jnp.asarray(padded),
+                    jnp.asarray(src_slots), jnp.asarray(src_rows),
+                    jnp.int32(slot), jnp.int32(start),
+                    jnp.int32(len(suffix)), jnp.int32(limit))
+                self._set_cache_tree(tree)
+            else:
+                self._cache, self._state, emit = exe(
+                    self._params, self._cache, self._state,
+                    jnp.asarray(padded),
+                    jnp.asarray(src_slots), jnp.asarray(src_rows),
+                    jnp.int32(slot), jnp.int32(start),
+                    jnp.int32(len(suffix)), jnp.int32(limit))
+            req.prefix_tokens = start
+            self._prefix.hits += 1
+            self._prefix.tokens_reused += start
+            bucket = sbucket
+            if _telemetry._active:
+                _telemetry.inc("serve.prefix_hits_total")
+                _telemetry.inc("serve.prefix_tokens_reused_total", start)
+        else:
+            if self._prefix is not None:
+                evicted = self._prefix.evict_slot(slot)
+                if evicted and _telemetry._active:
+                    _telemetry.inc("serve.prefix_evictions_total",
+                                   evicted)
+                self._prefix.misses += 1
+                if _telemetry._active:
+                    _telemetry.inc("serve.prefix_misses_total")
             bucket = self.bucket_for(length)
             padded = onp.zeros((bucket,), dtype=onp.int32)
             padded[:length] = req.prompt
-            limit = min(length + req.max_new_tokens - 1, self.max_seq - 1)
             exe = self._prefill_exe(bucket)
-            t0u = _profiler.now_us() if _trace._active else 0
-            t0p = time.perf_counter()
-            self._cache, self._state, emit = exe(
-                self._params, self._cache, self._state,
-                jnp.asarray(padded), jnp.int32(slot), jnp.int32(length),
-                jnp.int32(limit))
-            req.slot = slot
-            req.t_admitted = time.perf_counter()
-            if req._enq is not None:
-                req._enq.end()
-                req._enq = None
-            if _trace._active and req._span is not None:
-                duru = _profiler.now_us() - t0u
-                _trace.emit("serve.prefill", t0u, duru,
-                            parent=req._span.context, category="serve",
-                            request=req.id, slot=slot, bucket=bucket)
-            if _trace._active or self._phase_cap:
-                self._phase_note(req, "queue_wait",
-                                 req.t_admitted - req.t_submit)
-                self._phase_note(req, "prefill",
-                                 req.t_admitted - t0p)
-            self._slots[slot] = req
-            self._window.push(emit, self._prefill_sink(req))
-            admitted += 1
+            if self.draft is not None:
+                tree, self._state, emit = exe(
+                    self._params, self._draft_params, self._cache_tree(),
+                    self._state, jnp.asarray(padded), jnp.int32(slot),
+                    jnp.int32(length), jnp.int32(limit))
+                self._set_cache_tree(tree)
+            else:
+                self._cache, self._state, emit = exe(
+                    self._params, self._cache, self._state,
+                    jnp.asarray(padded), jnp.int32(slot),
+                    jnp.int32(length), jnp.int32(limit))
+        if self._prefix is not None:
+            path = self._prefix.insert(req.prompt, slot)
+            self._prefix.acquire(path)
+            req._nodes = tuple(path)
             if _telemetry._active:
-                _telemetry.inc("serve.admitted_total")
-                _telemetry.inc("serve.prefill_tokens_total", bucket)
-        return admitted
+                _telemetry.set_gauge("serve.prefix_blocks",
+                                     len(self._prefix))
+        req.slot = slot
+        req.t_admitted = time.perf_counter()
+        if req._enq is not None:
+            req._enq.end()
+            req._enq = None
+        if _trace._active and req._span is not None:
+            duru = _profiler.now_us() - t0u
+            _trace.emit("serve.prefill", t0u, duru,
+                        parent=req._span.context, category="serve",
+                        request=req.id, slot=slot, bucket=bucket,
+                        prefix_tokens=req.prefix_tokens)
+        if _trace._active or self._phase_cap:
+            self._phase_note(req, "queue_wait",
+                             req.t_admitted - req.t_submit)
+            self._phase_note(req, "prefill",
+                             req.t_admitted - t0p)
+        self._slots[slot] = req
+        self._window.push(emit, self._prefill_sink(req))
+        if _telemetry._active:
+            _telemetry.inc("serve.admitted_total")
+            _telemetry.inc("serve.prefill_tokens_total", bucket)
 
     # -- the serve loop --------------------------------------------------
 
@@ -674,22 +1254,42 @@ class ServeEngine:
         idle (nothing queued, running, or pending drain)."""
         self._last_step_time = time.monotonic()
         if self._queue and not self._free and len(self._window):
-            # starved for slots: reclaim just enough, oldest first
-            self._window.drain_oldest(1)
+            # starved for slots: reclaim just enough, oldest first —
+            # one per queued request, so a deep queue refills the whole
+            # slot grid in one step instead of trickling one admission
+            # per decode dispatch
+            self._window.drain_oldest(min(len(self._queue),
+                                          len(self._window)))
         admitted = self._admit()
         live = {i: r for i, r in enumerate(self._slots) if r is not None}
         if _telemetry._active:
             _telemetry.set_gauge("serve.queue_depth", len(self._queue))
             _telemetry.set_gauge("serve.slot_occupancy", len(live))
+            if len(self._classes) > 1:
+                depth = {c: 0 for c in self._classes}
+                for r in self._queue:
+                    depth[r.slo_class] += 1
+                for c, v in depth.items():
+                    _telemetry.set_gauge("serve.class_queue_depth", v,
+                                         slo_class=c)
         if not live:
             if len(self._window):
                 self._window.drain()
                 return True
             return admitted > 0
-        exe = self._decode_exe()
-        t0 = time.perf_counter()
-        self._cache, self._state, emit = exe(
-            self._params, self._cache, self._state)
+        if self.draft is not None:
+            exe = self._spec_exe()
+            t0 = time.perf_counter()
+            tree, self._state, emit = exe(
+                self._params, self._draft_params, self._cache_tree(),
+                self._state)
+            self._set_cache_tree(tree)
+            self._spec_rounds += 1
+        else:
+            exe = self._decode_exe()
+            t0 = time.perf_counter()
+            self._cache, self._state, emit = exe(
+                self._params, self._cache, self._state)
         dt = time.perf_counter() - t0
         self._steps += 1
         if _servefleet._active:
@@ -697,6 +1297,8 @@ class ServeEngine:
         if _telemetry._active:
             _telemetry.inc("serve.steps_total")
             _telemetry.observe("serve.step_seconds", dt)
+            if self.draft is not None:
+                _telemetry.inc("serve.spec_rounds_total")
         if _trace._active:
             # one span per live request per step: the dispatch wall time
             # was measured anyway, so re-stamp it on the shared clock
@@ -712,7 +1314,9 @@ class ServeEngine:
         elif self._phase_cap:
             for req in live.values():
                 self._phase_note(req, "decode_step", dt)
-        self._window.push(emit, self._decode_sink(live))
+        sink = self._spec_sink(live) if self.draft is not None \
+            else self._decode_sink(live)
+        self._window.push(emit, sink)
         return True
 
     def _phase_note(self, req, key, val):
@@ -852,13 +1456,15 @@ class ServeEngine:
         self._register_health()
         return self
 
-    def _slo_observe(self, kind, violated):
+    def _slo_observe(self, kind, violated, slo_class="default"):
         """Account one request against the declared SLO objective of
         ``kind`` — the drain-time observation point the burn gauge and
         autoscaler admission signal ride."""
-        self._slo_events.append((time.monotonic(), kind, bool(violated)))
+        self._slo_events.append(
+            (time.monotonic(), kind, bool(violated), slo_class))
         if violated and _telemetry._active:
-            _telemetry.inc("serve.slo_violations_total", kind=kind)
+            _telemetry.inc("serve.slo_violations_total", kind=kind,
+                           slo_class=slo_class)
 
     def slo_burn(self, window=300.0):
         """Per-kind error-budget burn rate over the trailing ``window``
@@ -874,7 +1480,7 @@ class ServeEngine:
                             ("tpot", self._slo_tpot)):
             if not armed:
                 continue
-            hits = [v for (t, k, v) in self._slo_events
+            hits = [v for (t, k, v, _c) in self._slo_events
                     if k == kind and t >= cut]
             if not hits:
                 continue
@@ -985,7 +1591,7 @@ class ServeEngine:
         out["phases"] = phases
         if self._slo_ttft or self._slo_tpot:
             viol = {}
-            for (_t, kind, v) in self._slo_events:
+            for (_t, kind, v, _c) in self._slo_events:
                 if v:
                     viol[kind] = viol.get(kind, 0) + 1
             out["slo"] = {
@@ -1002,7 +1608,49 @@ class ServeEngine:
             out["weight_bytes_fp"] = was
             out["quantized_params"] = len(qt)
             out["passthrough_params"] = len(pt)
+        if self._prefix is not None:
+            out["prefix"] = self._prefix.stats()
+        if self.draft is not None:
+            rate = (self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else None)
+            out["spec"] = {
+                "k": self._spec_k,
+                "rounds": self._spec_rounds,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "acceptance_rate": None if rate is None
+                else round(rate, 4),
+            }
+        if len(self._classes) > 1 or self._aging:
+            per = {}
+            for cls in self._classes:
+                rs = [r for r in done if r.slo_class == cls]
+                ct = sorted(r.ttft for r in rs if r.ttft is not None)
+                cp = sorted(r.tpot for r in rs if r.tpot is not None)
+                per[cls] = {
+                    "completed": len(rs),
+                    "queued": sum(1 for r in self._queue
+                                  if r.slo_class == cls),
+                    "ttft": {"p50": pct(ct, 50), "p99": pct(ct, 99)},
+                    "tpot": {"p50": pct(cp, 50), "p99": pct(cp, 99)},
+                }
+            out["classes"] = per
+            out["aged_admissions"] = self._aged_admissions
         return out
+
+    @property
+    def prefix_hits(self):
+        """Host counter of prefix-cache admission hits — the per-replica
+        number mx.servefleet snapshots into /servefleet and report()."""
+        return self._prefix.hits if self._prefix is not None else 0
+
+    @property
+    def spec_acceptance(self):
+        """Trailing draft-acceptance ratio, None without a draft or
+        before the first speculative round drained."""
+        if self.draft is None or not self._spec_proposed:
+            return None
+        return self._spec_accepted / self._spec_proposed
 
 
 def load(model, max_slots=None, quantize=None, warmup=False, **kwargs):
@@ -1011,7 +1659,10 @@ def load(model, max_slots=None, quantize=None, warmup=False, **kwargs):
     ``quantize`` enables low-bit decode storage — "int8_weights",
     "int4_weights", "int8_kv", comma-combinable (docs/SERVING.md);
     ``warmup=True`` compiles the full bucket grid before returning so
-    the first request never pays a compile.
+    the first request never pays a compile.  ``prefix_cache=True`` (or
+    ``serve.prefix_cache=1``) turns on radix prefix-cache KV reuse;
+    ``draft=small_model`` turns on speculative decoding (greedy-exact,
+    ``serve.spec_tokens`` proposals per round).
     """
     eng = ServeEngine(model, max_slots=max_slots, quantize=quantize,
                       **kwargs)

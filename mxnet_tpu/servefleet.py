@@ -111,6 +111,11 @@ _telemetry.declare_metric(
     "servefleet.router_moves_total", "counter",
     "sessions whose rendezvous-hash route changed replica (failover or "
     "scaling) — affinity means this stays near zero in steady state")
+_telemetry.declare_metric(
+    "servefleet.prefix_routed_total", "counter",
+    "sessionless requests routed by prompt-prefix fingerprint (hash of "
+    "the first serve.prefix_block tokens), steering shared-prefix "
+    "traffic to the replica whose radix cache already holds the rows")
 
 #: hot-path gate — ``ServeEngine.step`` reads this one attribute per
 #: decode step; False (no fleet constructed) keeps the hook a no-op
@@ -188,15 +193,17 @@ class FleetRequest:
     race).  ``tokens`` is None until the FIRST completion lands."""
 
     __slots__ = ("key", "session", "prompt", "max_new_tokens", "eos_id",
-                 "engine_req", "orphans", "replica_id", "redispatches",
-                 "tokens", "t_submit", "t_done")
+                 "slo_class", "engine_req", "orphans", "replica_id",
+                 "redispatches", "tokens", "t_submit", "t_done")
 
-    def __init__(self, key, session, prompt, max_new_tokens, eos_id):
+    def __init__(self, key, session, prompt, max_new_tokens, eos_id,
+                 slo_class=None):
         self.key = str(key)
         self.session = str(session)
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        self.slo_class = slo_class
         self.engine_req = None
         self.orphans = []
         self.replica_id = None
@@ -248,7 +255,8 @@ class Replica:
                 "wedged": self.wedged,
                 "occupancy": round(self.occupancy(), 4),
                 "queued": len(self.engine._queue),
-                "post_warmup_compiles": self.engine.post_warmup_compiles}
+                "post_warmup_compiles": self.engine.post_warmup_compiles,
+                "prefix_hits": self.engine.prefix_hits}
 
 
 # ---------------------------------------------------------------------------
@@ -368,15 +376,19 @@ class ServeFleet:
     # -- routing + submission -------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, session=None, key=None,
-               eos_id="engine"):
+               eos_id="engine", slo_class=None):
         """Accept one request under an idempotency ``key`` (generated
-        when omitted) and route it by rendezvous hash of ``session``
-        (defaults to the key: no affinity).  Re-submitting an accepted
-        key returns the SAME :class:`FleetRequest` — the idempotent
-        accept that makes client retries safe.  Raises
+        when omitted) and route it by rendezvous hash of ``session``.
+        A sessionless request routes by *prompt-prefix fingerprint* —
+        the blake2b hash of its first ``serve.prefix_block`` tokens —
+        so shared-prefix traffic converges on the replica whose radix
+        prefix cache already holds those KV rows.  Re-submitting an
+        accepted key returns the SAME :class:`FleetRequest` — the
+        idempotent accept that makes client retries safe.  Raises
         :class:`~mxnet_tpu.serve.engine.EngineBusy` (with the max
         ``retry_after_hint`` across replicas) only when EVERY live
-        replica rejects."""
+        replica rejects.  ``slo_class`` rides through to the engine's
+        priority admission (serve.slo_classes)."""
         if key is None:
             key = f"req-{self._next_key}"
             self._next_key += 1
@@ -385,13 +397,19 @@ class ServeFleet:
             return self._inflight[key]
         if key in self._completed:
             return self._completed[key]
-        if session is None:
-            session = key
         import numpy as onp
         prompt = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        if session is None:
+            block = max(1, int(_config.get("serve.prefix_block")))
+            h = hashlib.blake2b(
+                ",".join(str(t) for t in prompt[:block]).encode(),
+                digest_size=8)
+            session = f"px-{h.hexdigest()}"
+            _count("servefleet.prefix_routed_total")
         eos = (self._engine_kwargs.get("eos_id")
                if eos_id == "engine" else eos_id)
-        fr = FleetRequest(key, session, prompt, max_new_tokens, eos)
+        fr = FleetRequest(key, session, prompt, max_new_tokens, eos,
+                          slo_class=slo_class)
         self._dispatch(fr, queue_on_busy=False)
         self._inflight[key] = fr
         self._accepted_total += 1
@@ -420,7 +438,8 @@ class ServeFleet:
             rep = self._replicas[rid]
             try:
                 req = rep.engine.submit(fr.prompt, fr.max_new_tokens,
-                                        eos_id=fr.eos_id)
+                                        eos_id=fr.eos_id,
+                                        slo_class=fr.slo_class)
             except EngineBusy as e:
                 last = e if last is None or \
                     e.retry_after_hint > last.retry_after_hint else last

@@ -274,8 +274,8 @@ def test_decode_loop_is_sync_free():
 
 
 def test_starved_queue_drains_bounded():
-    """When the queue is starved for slots the engine reclaims via the
-    oldest window entry only — bounded, not a full drain."""
+    """When the queue is starved for slots the engine reclaims oldest
+    window entries, bounded by the queue depth — not a full drain."""
     mx.random.seed(10)
     net = _tiny()
     eng = _engine(net, max_slots=1, drain_window=8)

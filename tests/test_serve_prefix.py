@@ -1,0 +1,415 @@
+"""Engine-level serving throughput features (docs/SERVING.md):
+radix prefix-cache KV reuse, speculative decoding, multi-tenant SLO
+classes.
+
+Oracles: greedy token parity — a prefix-cache engine, a speculative
+engine (any draft), and both combined must emit token-for-token what
+the plain engine emits (the plain engine itself is pinned to the
+full-forward reference in tests/test_serve.py); the radix index's
+host-side invariants (strict-prefix match, block-granular split, LRU
+leaf eviction, refcounts never negative); strict-priority admission
+order with starvation aging; and ZERO post-warmup compiles in every
+new mode — prefix on, draft attached, both, quantized — via the PR 2
+recompile detector accounting.
+
+The ``serve.prefix_evict`` chaos drill proves a vanished prefix
+degrades to a full prefill (token parity intact), never a wrong
+answer.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, servefleet, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo.gpt import GPTForCausalLM
+from mxnet_tpu.serve.engine import EngineBusy
+from mxnet_tpu.serve.prefix import RadixIndex
+
+
+def _tiny(seed=7, **kw):
+    mx.random.seed(seed)
+    cfg = dict(vocab_size=97, units=32, hidden_size=64, num_layers=2,
+               num_heads=2, max_length=32, dropout=0.0, embed_dropout=0.0)
+    cfg.update(kw)
+    net = GPTForCausalLM(**cfg)
+    net.initialize()
+    net(mx.np.zeros((1, 2), dtype="int32"))
+    return net
+
+
+def _engine(net=None, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("buckets", "4,8")
+    kw.setdefault("temperature", 0.0)
+    return mx.serve.load(net if net is not None else _tiny(), **kw)
+
+
+@pytest.fixture(scope="module")
+def net():
+    """One deterministic tiny GPT for the whole module — every engine
+    warmup is an XLA compile bill, so the net is shared."""
+    return _tiny()
+
+
+@pytest.fixture(scope="module")
+def plain(net):
+    """One warmed cache-off/draft-off engine: the greedy-parity
+    baseline for every prefix/spec variant in the module (deterministic
+    greedy ⇒ safe to reuse across tests)."""
+    return _engine(net, warmup=True)
+
+
+@pytest.fixture
+def block4():
+    prev = mx.config.set("serve.prefix_block", 4)
+    yield 4
+    mx.config.set("serve.prefix_block", prev)
+
+
+@pytest.fixture
+def metrics():
+    telemetry.enable()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _shared_prefix_work(n=8, prefix_tokens=4, seed=0):
+    """Prompts sharing one ``prefix_tokens``-token prefix + a 2..4-token
+    random suffix — the prefix cache's bread and butter."""
+    rng = onp.random.RandomState(seed)
+    shared = rng.randint(1, 97, size=prefix_tokens).tolist()
+    return [shared + rng.randint(1, 97, size=rng.randint(2, 5)).tolist()
+            for _ in range(n)]
+
+
+def _run(eng, prompts, max_new=6, **submit_kw):
+    reqs = [eng.submit(p, max_new_tokens=max_new, **submit_kw)
+            for p in prompts]
+    eng.run()
+    assert eng.post_warmup_compiles == 0, \
+        f"{eng.post_warmup_compiles} post-warmup compiles"
+    return [r.output_ids for r in reqs]
+
+
+# -- radix index unit oracles ------------------------------------------------
+
+def test_radix_insert_then_match_strict_prefix():
+    idx = RadixIndex(block=4)
+    tokens = list(range(1, 13))          # 3 full blocks
+    path = idx.insert(tokens, slot=0)
+    assert len(path) == 3 and len(idx) == 3
+    # a longer prompt sharing the prefix matches all three blocks
+    assert len(idx.match(tokens + [50])) == 3
+    # strict: the SAME 12 tokens may only match 2 blocks — at least one
+    # token must remain for the suffix prefill to forward
+    assert len(idx.match(tokens)) == 2
+    # partial blocks never index or match
+    assert len(idx.match(tokens[:6])) == 1
+    assert idx.match([99, 98, 97, 96]) == []
+
+
+def test_radix_diverging_suffix_splits():
+    idx = RadixIndex(block=4)
+    a = [1, 2, 3, 4, 5, 6, 7, 8]
+    b = [1, 2, 3, 4, 9, 9, 9, 9]         # shares block 0, diverges
+    pa = idx.insert(a, slot=0)
+    pb = idx.insert(b, slot=1)
+    assert pa[0] is pb[0]                 # the shared block is one node
+    assert pa[1] is not pb[1] and len(idx) == 3
+    # dedup: the shared node keeps its original (slot, row) location
+    assert pb[0].slot == 0 and pb[1].slot == 1
+
+
+def test_radix_lru_evicts_only_unpinned_leaves():
+    idx = RadixIndex(block=2, capacity=2)
+    pa = idx.insert([1, 2, 3, 4], slot=0)     # fills capacity
+    idx.acquire(pa)
+    # pinned path cannot be evicted: the insert stops early instead
+    pb = idx.insert([5, 6, 7, 8], slot=1)
+    assert pb == [] and idx.evictions == 0
+    idx.release(pa)
+    idx.match([1, 2, 9])                      # bump block (1,2)'s LRU
+    pb = idx.insert([5, 6], slot=1)
+    # the cold leaf (3,4) went, the hot (1,2) stayed
+    assert len(pb) == 1 and idx.evictions == 1
+    assert len(idx.match([1, 2, 9])) == 1
+
+
+def test_radix_refcount_underflow_raises():
+    idx = RadixIndex(block=2)
+    path = idx.insert([1, 2, 3, 4], slot=0)
+    idx.acquire(path)
+    idx.release(path)
+    with pytest.raises(MXNetError, match="refcount"):
+        idx.release(path)
+    # released-then-evicted nodes are skipped, not raised on
+    idx.acquire(path)
+    idx.evict_slot(0)
+    idx.release(path)
+
+
+def test_radix_evict_slot_drops_whole_subtree():
+    idx = RadixIndex(block=2)
+    idx.insert([1, 2, 3, 4], slot=0)
+    idx.insert([1, 2, 5, 6], slot=1)      # child of slot-0's block
+    assert idx.evict_slot(0) == 3         # parent AND both children
+    assert len(idx) == 0 and idx.match([1, 2, 9]) == []
+
+
+# -- prefix-cache engine parity ----------------------------------------------
+
+def test_prefix_cache_token_parity_and_hits(net, plain, block4, metrics):
+    prompts = _shared_prefix_work()
+    base = _run(plain, prompts)
+    eng = _engine(net, prefix_cache=True, warmup=True)
+    assert _run(eng, prompts) == base
+    st = eng.stats()["prefix"]
+    assert st["hits"] >= 4 and st["tokens_reused"] >= 4 * st["hits"]
+    assert telemetry.counters()["serve.prefix_hits_total"] == st["hits"]
+    assert telemetry.counters()["serve.prefix_tokens_reused_total"] \
+        == st["tokens_reused"]
+
+
+@pytest.mark.slow
+def test_prefix_cache_disjoint_prompts_all_miss(net, plain, block4):
+    rng = onp.random.RandomState(3)
+    prompts = [rng.randint(1, 97, size=7).tolist() for _ in range(4)]
+    eng = _engine(net, prefix_cache=True, warmup=True)
+    base = _run(plain, prompts)
+    assert _run(eng, prompts) == base
+    st = eng.stats()["prefix"]
+    assert st["hits"] == 0 and st["misses"] == 4
+
+
+@pytest.mark.slow
+def test_prefix_cache_with_int4_weights_int8_kv(net, block4):
+    prompts = _shared_prefix_work()
+    q = "int4_weights,int8_kv"
+    base = _run(_engine(net, quantize=q, warmup=True), prompts)
+    eng = _engine(net, quantize=q, prefix_cache=True, warmup=True)
+    assert _run(eng, prompts) == base
+    assert eng.stats()["prefix"]["hits"] >= 4
+
+
+def test_prefix_cache_needs_suffix_surface(block4):
+    class NoSuffix:
+        max_length = 32
+        init_cache = prefill = decode_step = staticmethod(
+            lambda *a, **k: None)
+        collect_params = staticmethod(dict)
+    with pytest.raises(MXNetError, match="prefill_suffix"):
+        mx.serve.ServeEngine(NoSuffix(), max_slots=2, prefix_cache=True)
+
+
+# -- speculative decoding ----------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [2, 4])
+def test_spec_self_draft_greedy_parity(net, plain, k):
+    rng = onp.random.RandomState(1)
+    prompts = [rng.randint(1, 97, size=rng.randint(2, 9)).tolist()
+               for _ in range(6)]
+    base = _run(plain, prompts, max_new=8)
+    prev = mx.config.set("serve.spec_tokens", k)
+    try:
+        eng = _engine(net, draft=net, warmup=True)
+        assert eng._spec_k == k
+        assert _run(eng, prompts, max_new=8) == base
+        st = eng.stats()["spec"]
+        # the correction token is never counted accepted, so even the
+        # self-draft's perfect agreement caps at (k-1)/k
+        assert 0.0 < st["acceptance_rate"] <= (k - 1) / k
+    finally:
+        mx.config.set("serve.spec_tokens", prev)
+
+
+@pytest.mark.slow
+def test_spec_foreign_draft_greedy_parity(net, plain):
+    """A draft with DIFFERENT weights: acceptance drops, output must
+    not — the verify pass is what decides every token."""
+    draft = _tiny(seed=8)
+    rng = onp.random.RandomState(2)
+    prompts = [rng.randint(1, 97, size=rng.randint(2, 9)).tolist()
+               for _ in range(6)]
+    base = _run(plain, prompts, max_new=8)
+    eng = _engine(net, draft=draft, warmup=True)
+    assert _run(eng, prompts, max_new=8) == base
+
+
+@pytest.mark.slow
+def test_spec_fewer_dispatches_than_tokens(net):
+    """The throughput mechanism, asserted structurally: at high
+    acceptance (self-draft) one propose+verify dispatch emits multiple
+    tokens, so decode rounds land well under tokens decoded.  (Wall
+    clock is left to benchmark/serve_throughput.py --tenants: on CPU
+    the draft's compute isn't cheaper than the target's, so the win is
+    dispatch-bound, not FLOP-bound.)"""
+    rng = onp.random.RandomState(4)
+    prompts = [rng.randint(1, 97, size=4).tolist() for _ in range(4)]
+    eng = _engine(net, draft=net, warmup=True)
+    _run(eng, prompts, max_new=12)
+    st = eng.stats()
+    tokens = st["tokens_out"]
+    rounds = st["spec"]["rounds"]
+    assert rounds * 2 <= tokens, (rounds, tokens)
+
+
+def test_spec_rejects_sampling_temperature(net):
+    with pytest.raises(MXNetError, match="temperature"):
+        _engine(net, draft=net, temperature=0.8)
+
+
+@pytest.mark.slow
+def test_prefix_and_spec_compose(net, plain, block4):
+    prompts = _shared_prefix_work()
+    base = _run(plain, prompts)
+    eng = _engine(net, prefix_cache=True, draft=net, warmup=True)
+    assert _run(eng, prompts) == base
+    st = eng.stats()
+    assert st["prefix"]["hits"] >= 4
+    assert st["spec"]["rounds"] > 0
+
+
+# -- SLO classes -------------------------------------------------------------
+
+def _classes(spec, **extra):
+    prev = {"serve.slo_classes": mx.config.set("serve.slo_classes", spec)}
+    for k, v in extra.items():
+        prev[k] = mx.config.set(k, v)
+    return prev
+
+
+def _restore(prev):
+    for k, v in prev.items():
+        mx.config.set(k, v)
+
+
+def test_slo_strict_priority_admission_order(net):
+    prev = _classes("gold,bronze")
+    try:
+        eng = _engine(net, max_slots=1, warmup=True)
+        rng = onp.random.RandomState(5)
+        bronze = [eng.submit(rng.randint(1, 97, size=3).tolist(),
+                             max_new_tokens=2, slo_class="bronze")
+                  for _ in range(3)]
+        gold = [eng.submit(rng.randint(1, 97, size=3).tolist(),
+                           max_new_tokens=2, slo_class="gold")
+                for _ in range(3)]
+        # untagged requests land in the LAST (lowest) class
+        assert eng.submit([3, 5, 7], max_new_tokens=2).slo_class \
+            == "bronze"
+        eng.run()
+        # every gold admission precedes every bronze one: on a 1-slot
+        # engine nothing was admitted before the golds were queued
+        assert max(r.t_admitted for r in gold) \
+            < min(r.t_admitted for r in bronze)
+        # FIFO within a class
+        assert [r.t_admitted for r in gold] == sorted(
+            r.t_admitted for r in gold)
+        cls = eng.stats()["classes"]
+        assert cls["gold"]["completed"] == 3
+        assert cls["bronze"]["completed"] == 4   # 3 tagged + 1 untagged
+    finally:
+        _restore(prev)
+
+
+def test_slo_unknown_class_rejected(net):
+    prev = _classes("gold,bronze")
+    try:
+        eng = _engine(net, warmup=False)
+        with pytest.raises(MXNetError, match="unknown slo_class"):
+            eng.submit([3, 5, 7], slo_class="platinum")
+    finally:
+        _restore(prev)
+
+
+@pytest.mark.slow
+def test_slo_aging_overrides_strict_priority(net, metrics):
+    """A bronze request older than serve.class_aging_ms must win one
+    admission from a fresher gold — starvation is bounded."""
+    import time
+    prev = _classes("gold,bronze", **{"serve.class_aging_ms": 30.0})
+    try:
+        eng = _engine(net, max_slots=1, warmup=True)
+        rng = onp.random.RandomState(6)
+        br = eng.submit(rng.randint(1, 97, size=3).tolist(),
+                        max_new_tokens=2, slo_class="bronze")
+        time.sleep(0.05)                      # bronze crosses the knob
+        g = eng.submit(rng.randint(1, 97, size=3).tolist(),
+                       max_new_tokens=2, slo_class="gold")
+        eng.run()
+        assert br.t_admitted < g.t_admitted
+        assert eng.stats()["aged_admissions"] >= 1
+        assert telemetry.counters()["serve.aged_admissions_total"] >= 1
+    finally:
+        _restore(prev)
+
+
+@pytest.mark.slow
+def test_slo_per_class_queue_bound(net):
+    prev = _classes("gold,bronze", **{"serve.class_max_queue": "gold=1"})
+    try:
+        eng = _engine(net, max_slots=1, warmup=True)
+        eng.submit([3, 5, 7], max_new_tokens=2)   # occupies the slot
+        eng.step()
+        eng.submit([4, 6, 8], max_new_tokens=2, slo_class="gold")
+        with pytest.raises(EngineBusy) as ei:
+            eng.submit([5, 7, 9], max_new_tokens=2, slo_class="gold")
+        assert ei.value.reason == "class_queue_full"
+        # bronze is NOT bounded by gold's budget
+        eng.submit([6, 8, 10], max_new_tokens=2, slo_class="bronze")
+        eng.run()
+    finally:
+        _restore(prev)
+
+
+# -- chaos: serve.prefix_evict ----------------------------------------------
+
+@pytest.mark.slow
+def test_prefix_evict_injection_falls_back_to_full_prefill(
+        net, plain, block4, metrics):
+    """Arm ``serve.prefix_evict``: every matched prefix vanishes
+    between match and copy.  The engine must degrade to full prefills —
+    zero hits, token parity intact — never serve stale or garbage KV."""
+    prompts = _shared_prefix_work()
+    base = _run(plain, prompts)
+    fault.configure("serve.prefix_evict:prob=1")
+    try:
+        eng = _engine(net, prefix_cache=True, warmup=True)
+        assert _run(eng, prompts) == base
+        st = eng.stats()["prefix"]
+        assert st["hits"] == 0
+        assert fault.stats().get("injected.serve.prefix_evict", 0) >= 1
+        assert telemetry.counters().get(
+            "serve.prefix_evictions_total", 0) >= 1
+    finally:
+        fault.clear()
+
+
+# -- servefleet prefix-fingerprint routing -----------------------------------
+
+@pytest.mark.slow
+def test_fleet_prefix_fingerprint_routing(block4, metrics):
+    """Sessionless requests sharing a prompt prefix must land on the
+    same replica (session derived from the first block's fingerprint),
+    so the fleet concentrates each tenant's KV reuse."""
+    def factory():
+        return _tiny()
+
+    fleet = servefleet.ServeFleet(factory, replicas=2, max_slots=2,
+                                  buckets="4,8", temperature=0.0)
+    try:
+        prompts = _shared_prefix_work(n=6, prefix_tokens=4, seed=9)
+        frs = [fleet.submit(p, max_new_tokens=2) for p in prompts]
+        fleet.run(tick_interval=0.001)
+        sessions = {fr.session for fr in frs}
+        assert len(sessions) == 1 and sessions.pop().startswith("px-")
+        assert len({fr.replica_id for fr in frs}) == 1
+        assert telemetry.counters()["servefleet.prefix_routed_total"] == 6
+        report = fleet.report()
+        assert all("prefix_hits" in r for r in report["replicas"])
+    finally:
+        fleet.close()

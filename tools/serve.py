@@ -17,6 +17,10 @@ Usage:
     # int4 weights + int8 KV cache (the bandwidth-min decode config)
     python tools/serve.py --demo 8 --quantize int4_weights,int8_kv
 
+    # radix prefix-cache KV reuse + speculative decoding
+    JAX_PLATFORMS=cpu python tools/serve.py --demo 8 \
+        --prefix-cache on --draft tiny
+
     # gpt2-124m shapes (accelerator-sized; slow on CPU)
     python tools/serve.py --model gpt2_124m --demo 8
 
@@ -68,6 +72,17 @@ def main(argv=None):
                    help="low-bit storage: int8_weights, int4_weights, "
                         "int8_kv — comma-combinable, e.g. "
                         "'int4_weights,int8_kv'")
+    p.add_argument("--prefix-cache", default="off", choices=["on", "off"],
+                   help="radix prefix-cache KV reuse: shared prompt "
+                        "prefixes are row-copied instead of re-prefilled")
+    p.add_argument("--draft", default=None, choices=["tiny", "self"],
+                   help="speculative decoding draft: 'tiny' builds a "
+                        "fresh tiny model, 'self' drafts with the served "
+                        "model itself (perfect acceptance — a plumbing "
+                        "check, not a speedup)")
+    p.add_argument("--slo-class", default=None, metavar="CLS",
+                   help="submit every request under this SLO class "
+                        "(one of serve.slo_classes)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--replicas", type=int, default=0, metavar="N",
                    help="serve through a mx.servefleet group of N "
@@ -99,14 +114,21 @@ def main(argv=None):
     telemetry.enable()
     if args.replicas:
         return fleet_main(args, prompts)
+    draft = None
+    if args.draft == "self":
+        draft = net
+    elif args.draft == "tiny":
+        draft = build_model("tiny")
     eng = mx.serve.load(net, max_slots=args.slots, eos_id=args.eos_id,
                         temperature=args.temperature, seed=args.seed,
-                        quantize=args.quantize)
+                        quantize=args.quantize, draft=draft,
+                        prefix_cache=(args.prefix_cache == "on"))
     t0 = time.perf_counter()
     eng.warmup()
     warmup_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    reqs = [eng.submit(ids, max_new_tokens=args.max_new) for ids in prompts]
+    reqs = [eng.submit(ids, max_new_tokens=args.max_new,
+                       slo_class=args.slo_class) for ids in prompts]
     eng.run()
     wall = time.perf_counter() - t0
 
@@ -119,6 +141,11 @@ def main(argv=None):
     st["warmup_s"] = round(warmup_s, 3)
     st["wall_s"] = round(wall, 4)
     st["tokens_per_s"] = round(st["tokens_out"] / wall, 1)
+    hit_rate = st.get("prefix", {}).get("hit_rate")
+    accept = st.get("spec", {}).get("acceptance_rate")
+    print(json.dumps({"cache_hit_rate": hit_rate,
+                      "spec_acceptance_rate": accept,
+                      "tokens_per_s": st["tokens_per_s"]}))
     print(json.dumps(st))
     return 1 if st["post_warmup_compiles"] else 0
 
@@ -139,7 +166,7 @@ def fleet_main(args, prompts):
         seed=args.seed, quantize=args.quantize)
     t0 = time.perf_counter()
     frs = [fleet.submit(ids, max_new_tokens=args.max_new,
-                        session=f"cli-{i}")
+                        session=f"cli-{i}", slo_class=args.slo_class)
            for i, ids in enumerate(prompts)]
     fleet.run(tick_interval=0.001)
     wall = time.perf_counter() - t0
