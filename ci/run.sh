@@ -422,6 +422,13 @@ zero() {
     JAX_PLATFORMS=cpu python benchmark/zero_memory.py
 }
 
+fp8() {
+    echo "== fp8: delayed-scaling fp8 training + compressed collectives suite (docs/PRECISION.md) =="
+    python -m pytest tests/test_fp8.py -q
+    echo "== fp8: parity / byte-cut / recompile / checkpoint gate (>=2x dp cut, <=5% loss delta) =="
+    JAX_PLATFORMS=cpu python benchmark/fp8_train.py
+}
+
 mesh() {
     echo "== mesh: composed-parallelism suite (docs/PERFORMANCE.md 'Composing parallelism') =="
     python -m pytest tests/test_mesh_compose.py tests/test_parallel.py -q
@@ -704,6 +711,7 @@ case "$stage" in
     resilience) resilience ;;
     pipeline) pipeline ;;
     zero) zero ;;
+    fp8) fp8 ;;
     mesh) mesh ;;
     serve) serve ;;
     autotune) autotune ;;
@@ -717,6 +725,6 @@ case "$stage" in
     lint) lint ;;
     nightly) nightly ;;
     tpu) tpu ;;
-    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; mesh; serve; autotune; quantize; trace; insight; blackbox; stream; goodput; servefleet; lint ;;
+    all) sanity; unit; native; contracts; chaos; telemetry; resilience; pipeline; zero; fp8; mesh; serve; autotune; quantize; trace; insight; blackbox; stream; goodput; servefleet; lint ;;
     *) echo "unknown stage $stage"; exit 2 ;;
 esac

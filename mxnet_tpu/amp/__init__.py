@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from ..base import np_dtype
 from .loss_scaler import LossScaler  # noqa: F401
 from . import lists  # noqa: F401
+from . import fp8  # noqa: F401
 
 _state = threading.local()
 

@@ -178,7 +178,8 @@ def check(module, ctx):
 
 _STAGE_RE = re.compile(r"^\s*-\s*stage:\s*(\S+)")
 _SCHED_RE = re.compile(r"^\s*schedule:")
-_CASE_RE = re.compile(r"^\s*([a-z_]+)\)")
+# [a-z0-9_]: stage names may carry digits (e.g. the fp8 stage)
+_CASE_RE = re.compile(r"^\s*([a-z][a-z0-9_]*)\)")
 
 
 def _parse_matrix(path):

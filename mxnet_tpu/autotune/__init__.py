@@ -50,14 +50,15 @@ from .learned import (LearnedCostModel, load_telemetry_records, rank_gate,
 from .persist import (cache_dir, kernel_key, load_trials, load_winner,
                       model_fingerprint, save_winner, winner_key,
                       winners_path)
-from .search import (SearchResult, TrialOOM, TrialResult, last_summary,
-                     search, trial_compile_scope, tune_estimator)
+from .search import (SearchResult, TrialOOM, TrialParity, TrialResult,
+                     last_summary, search, trial_compile_scope,
+                     tune_estimator)
 from .space import Candidate, SearchSpace
 
 __all__ = [
     "Candidate", "SearchSpace", "CostModel", "ModelStats",
     "REMAT_MEM_FRACTION", "REMAT_FLOPS_FACTOR",
-    "SearchResult", "TrialResult", "TrialOOM",
+    "SearchResult", "TrialResult", "TrialOOM", "TrialParity",
     "search", "tune_estimator", "trial_compile_scope", "last_summary",
     "cache_dir", "winners_path", "model_fingerprint", "winner_key",
     "load_winner", "save_winner",

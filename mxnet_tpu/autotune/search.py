@@ -39,8 +39,9 @@ from .persist import (load_winner, model_fingerprint, save_winner,
                       winner_key, winners_path)
 from .space import Candidate, SearchSpace
 
-__all__ = ["TrialOOM", "TrialResult", "SearchResult", "search",
-           "tune_estimator", "trial_compile_scope", "last_summary"]
+__all__ = ["TrialOOM", "TrialParity", "TrialResult", "SearchResult",
+           "search", "tune_estimator", "trial_compile_scope",
+           "last_summary"]
 
 #: summary of the most recent search in this process — surfaced as the
 #: "autotune" plane of TrainingTelemetry run reports
@@ -50,6 +51,14 @@ _LAST = None
 class TrialOOM(MXNetError):
     """A measured trial exhausted device memory (real RESOURCE_EXHAUSTED,
     or injected via the ``autotune.trial_oom`` fault point)."""
+
+
+class TrialParity(MXNetError):
+    """A reduced-precision candidate failed its loss-parity probe against
+    the fp32 reference (relative loss delta beyond
+    ``autotune.fp8_parity_tol``).  The candidate is disqualified — fp8
+    ships only on shape buckets where trials PROVE parity — but the
+    search continues (status "parity" in the trial record)."""
 
 
 def _is_oom(exc):
@@ -105,7 +114,7 @@ class TrialResult:
                  seconds=0.0, error=None):
         self.candidate = candidate
         self.items_per_s = items_per_s
-        self.status = status          # ok | oom | error | cached
+        self.status = status          # ok | oom | error | parity | cached
         self.seconds = seconds
         self.error = error
 
@@ -236,6 +245,37 @@ def _sync(loss):
     return float(onp.asarray(getattr(loss, "_data", loss)))
 
 
+def _parity_probe(c, fp8_step, block, loss_fn, optimizer, mesh,
+                  batch_specs, batch, n_labels, param_specs, dp_axis,
+                  steps=2):
+    """Run the fp8 candidate and an identically-configured fp32 reference
+    a few steps on the SAME batch and compare losses; raises TrialParity
+    beyond ``autotune.fp8_parity_tol``.  Doubles as extra fp8 warmup —
+    the throughput measurement that follows is unaffected by the probe
+    having advanced the trial's (hermetic) weights."""
+    from ..parallel.train import ShardedTrainStep
+    import jax.numpy as jnp
+    tol = float(_config.get("autotune.fp8_parity_tol"))
+    ref = ShardedTrainStep(
+        block, loss_fn, _clone_optimizer(optimizer), mesh, batch_specs,
+        n_labels=n_labels, param_specs=param_specs,
+        steps_per_call=c.steps_per_call, zero=c.zero,
+        grad_accum=c.grad_accum, remat=c.remat, dp_axis=dp_axis)
+    ref.trainable = {n: jnp.copy(v) for n, v in ref.trainable.items()}
+    ref.aux = {n: jnp.copy(v) for n, v in ref.aux.items()}
+    ref._insight_label = fp8_step._insight_label + ":parity_ref"
+    for _ in range(max(1, steps)):
+        l8 = fp8_step(*batch)
+        lref = ref(*batch)
+    l8, lref = _sync(l8), _sync(lref)
+    denom = max(abs(lref), 1e-8)
+    rel = abs(l8 - lref) / denom
+    if not math.isfinite(l8) or rel > tol:
+        raise TrialParity(
+            f"fp8 parity probe failed for {c!r}: fp8 loss {l8:.6g} vs "
+            f"fp32 {lref:.6g} (rel delta {rel:.3g} > tol {tol})")
+
+
 def _measure_candidate(candidate, block, loss_fn, optimizer, mesh,
                        batch_specs, sample_batch, n_labels, param_specs,
                        dp_axis, trial_seconds, warmup, max_calls=200):
@@ -256,11 +296,17 @@ def _measure_candidate(candidate, block, loss_fn, optimizer, mesh,
         batch_specs = mesh.batch_specs(*[a.ndim for a in sample_batch])
         param_specs = None
         dp_axis = "dp"
+    # the precision axis maps onto the training step: "fp8" builds a real
+    # fp8 step (delayed scaling state and all), every other value runs
+    # the fp32 training path (bf16/int8* are inference-search formats)
+    precision = getattr(c, "precision", "fp32")
+    step_precision = "fp8" if precision == "fp8" else "fp32"
     step = ShardedTrainStep(
         block, loss_fn, _clone_optimizer(optimizer), mesh, batch_specs,
         n_labels=n_labels, param_specs=param_specs,
         steps_per_call=c.steps_per_call, zero=c.zero,
-        grad_accum=c.grad_accum, remat=c.remat, dp_axis=dp_axis)
+        grad_accum=c.grad_accum, remat=c.remat, dp_axis=dp_axis,
+        precision=step_precision)
     # Hermeticity: the constructor's device_put can ALIAS the block's own
     # param buffers (a same-layout put is a no-op), and the step donates
     # its inputs — without a copy, the first trial call would delete the
@@ -272,7 +318,14 @@ def _measure_candidate(candidate, block, loss_fn, optimizer, mesh,
     # own cost-analysis entry instead of masquerading as the train step
     step._insight_label = (f"autotune.trial[bs{c.batch_size}"
                            f"x{c.steps_per_call},ga{c.grad_accum},"
-                           f"zero{c.zero}]")
+                           f"zero{c.zero},{step_precision}]")
+    if step_precision == "fp8":
+        # loss-parity gate BEFORE timing: fp8 may only win a bucket where
+        # its loss curve tracks the fp32 reference within
+        # autotune.fp8_parity_tol — a fast format with broken numerics
+        # must not be selected (raises TrialParity -> status "parity")
+        _parity_probe(c, step, block, loss_fn, optimizer, mesh,
+                      batch_specs, batch, n_labels, param_specs, dp_axis)
     # first call = trace + compile; account it through the detector so
     # the trial-scoped limit governs it like any hybridized compile
     t0 = time.perf_counter()
@@ -395,13 +448,17 @@ def search(block, loss_fn, optimizer, mesh, batch_specs, sample_batch,
                 trials.append(TrialResult(
                     c, float(ips), "ok", time.perf_counter() - t0))
             except Exception as e:  # a dead candidate must not kill the search
-                status = "oom" if _is_oom(e) else "error"
+                status = ("oom" if _is_oom(e)
+                          else "parity" if isinstance(e, TrialParity)
+                          else "error")
                 trials.append(TrialResult(
                     c, None, status, time.perf_counter() - t0,
                     error=f"{type(e).__name__}: {e}"[:300]))
                 if status == "oom":
                     _telemetry.inc("autotune.trials_oom_total")
                     _fault.record("autotune.trial_oom")
+                elif status == "parity":
+                    _telemetry.inc("autotune.trials_parity_total")
             if sp is not None:
                 last = trials[-1]
                 sp.end(status=last.status,

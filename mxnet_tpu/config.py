@@ -303,6 +303,35 @@ declare("quantize.fused_matmul", str, "auto", "MXNET_QUANTIZE_FUSED_MATMUL",
 declare("quantize.fp8_format", str, "e4m3", "MXNET_QUANTIZE_FP8_FORMAT",
         "fp8 activation/weight format for the fp8 matmul variant: 'e4m3' "
         "(more mantissa, inference default) or 'e5m2' (more range).")
+declare("amp.fp8_history", int, 16, "MXNET_AMP_FP8_HISTORY",
+        "Delayed-scaling amax history length (steps) for fp8 training: "
+        "each tensor's quantization scale derives from the max |x| seen "
+        "over this many past steps (docs/PRECISION.md).")
+declare("amp.fp8_margin", float, 1.0, "MXNET_AMP_FP8_MARGIN",
+        "Safety margin multiplied into the delayed-scaling amax before "
+        "mapping it to the fp8 format's absmax; >1 trades headroom for "
+        "resolution against inter-step amax growth.")
+declare("amp.fp8_min_elems", int, 256, "MXNET_AMP_FP8_MIN_ELEMS",
+        "Smallest 2-D '.weight' parameter (elements) the fp8 training "
+        "path quantizes; smaller layers stay in the step's base dtype "
+        "(the scale bookkeeping would cost more than the matmul saves).")
+declare("comm.compress", str, "none", "MXNET_COMM_COMPRESS",
+        "Gradient compression for the dp-axis reduction inside "
+        "ShardedTrainStep: 'none', 'int8' (symmetric int8 with error "
+        "feedback, ~4x fewer wire bytes) or 'bf16' (~2x). Requires a "
+        "pure-dp mesh (docs/PRECISION.md).")
+declare("comm.bucket_mb", float, 4.0, "MXNET_COMM_BUCKET_MB",
+        "Flat gradient bucket size (MiB, fp32 element count) for the "
+        "compressed dp reduction; each bucket reduces as an independent "
+        "collective the XLA scheduler can overlap with backward compute.")
+declare("autotune.fp8_parity_tol", float, 0.05, "MXNET_AUTOTUNE_FP8_PARITY_TOL",
+        "Relative loss deviation vs an fp32 reference step above which a "
+        "precision='fp8' autotune trial is rejected (status 'parity') — "
+        "fp8 only ships on shape buckets that prove loss-curve parity.")
+declare("serve.allow_fp8_requant", bool, False, "MXNET_SERVE_ALLOW_FP8_REQUANT",
+        "Let int4_weights serve engines requantize fp8-trained "
+        "checkpoints anyway (default off: double quantization below the "
+        "fp8 grid's resolution degrades accuracy silently).")
 declare("serve.quantize_min_elems", int, 4096, "MXNET_SERVE_QUANTIZE_MIN_ELEMS",
         "Smallest parameter (elements) serve weight quantization touches; "
         "below it the bytes saved don't cover the dequant epilogue.")

@@ -10,6 +10,7 @@ import numpy as onp
 
 from ... import numpy as _np
 from ... import numpy_extension as npx
+from ...amp import fp8 as _fp8_scope
 from ...base import MXNetError
 from ..block import Block, HybridBlock
 from ..parameter import Parameter, Constant
@@ -110,6 +111,20 @@ class Dense(HybridBlock):
             self.weight._finish_deferred_init((self._units, in_units))
         if self.bias is not None and self.bias._data is None:
             self.bias._finish_deferred_init()
+        fp8 = _fp8_scope.current()
+        if fp8 is not None:
+            # fp8 training scope (amp/fp8.py): sites keyed by the
+            # structural name collect_params assigned; non-sites (tiny
+            # or aux-owned weights) fall through to the fp dense path
+            site = getattr(self.weight, "_structure_name", None)
+            if site in fp8.scales:
+                from ...numpy.multiarray import _wrap
+                raw = _fp8_scope.dense_fp8(
+                    x._data, self.weight.data()._data,
+                    self.bias.data()._data if self.bias is not None
+                    else None, site, flatten=self._flatten)
+                out = _wrap(raw)
+                return self.act(out) if self.act is not None else out
         out = npx.fully_connected(
             x, self.weight.data(),
             self.bias.data() if self.bias is not None else None,

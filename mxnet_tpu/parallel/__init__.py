@@ -12,7 +12,7 @@ from .mesh import (  # noqa: F401
 )
 from .collectives import (  # noqa: F401
     allreduce, allgather, reduce_scatter, ppermute,
-    allreduce_across_processes,
+    allreduce_across_processes, compressed_allreduce,
 )
 from .ring_attention import ring_attention  # noqa: F401
 from . import tp  # noqa: F401

@@ -22,10 +22,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import blackbox as _blackbox
+from .. import config as _config
 from .. import functional
 from .. import insight as _insight
 from .. import pipeline as _pipeline
 from .. import telemetry as _telemetry
+from ..amp import fp8 as _fp8
 from ..base import MXNetError
 from ..numpy.multiarray import ndarray, _wrap
 from .mesh import MeshConfig, activation_sharding
@@ -52,6 +54,25 @@ _telemetry.declare_metric(
     "mesh.pp_stage_transfer_bytes_total", "counter",
     "estimated residual-stream bytes handed stage-to-stage over the pp "
     "axis per step (forward + backward; logical estimate)")
+_telemetry.declare_metric(
+    "mesh.collective_bytes_total", "counter",
+    "per-axis breakdown of logical collective bytes moved by the training "
+    "step, labeled axis=dp|tp|pp; the dp sample counts WIRE bytes at the "
+    "compressed width when gradient compression is on, so the >=2x dp cut "
+    "is directly observable against mesh.dp_gradient_bytes_total")
+_telemetry.declare_metric(
+    "zero.collective_bytes_total", "counter",
+    "per-op breakdown of the ZeRO dp collectives, labeled "
+    "op=reduce_scatter|all_gather (same logical bytes the unlabeled "
+    "zero.*_bytes_total counters accumulate)")
+_telemetry.declare_metric(
+    "comm.compressed_bytes_total", "counter",
+    "dp gradient bytes actually placed on the wire by error-feedback "
+    "compression (int8 payload + one fp32 scale per bucket per rank)")
+_telemetry.declare_metric(
+    "comm.uncompressed_bytes_total", "counter",
+    "dp gradient bytes that WOULD have moved without compression (fp32 "
+    "per-microbatch reduce) — the denominator of the compression ratio")
 
 # params whose structural name matches <prefix>layer<i>.<suffix> with
 # identical shapes across i are the pipeline-stackable layer family
@@ -228,12 +249,28 @@ class ShardedTrainStep:
         same values as ``HybridBlock.hybridize(remat=...)`` (True,
         'dots', a policy callable); None inherits the block's hybridize
         flag.
+    precision: "fp32" (default) or "fp8" — fp8 runs eligible Dense
+        matmuls e4m3-forward / e5m2-backward with per-tensor delayed
+        scaling (mx.amp.fp8); the amax histories thread through the step
+        as donated state and checkpoint with the optimizer bundle.
+        Master weights, accumulation and the optimizer update stay fp32.
+    grad_compress: None (read the ``comm.compress`` knob), "none",
+        "int8" or "bf16" — error-feedback compression of the per-
+        microbatch dp gradient all-reduce.  Gradients flatten into
+        ``comm.bucket_mb`` buckets; each bucket quantizes (shared scale
+        = pmax over ranks), psums at the wire width and carries the
+        quantization error into the next step's gradient (EF-SGD), so
+        the compression error telescopes instead of accumulating.  The
+        independent per-bucket collectives are what XLA's latency-hiding
+        scheduler overlaps with backward compute.  Requires a pure-dp
+        mesh (tp=pp=sp=1) and every batch arg sharded over dp; silently
+        off at dp=1.
     """
 
     def __init__(self, block, loss_fn, optimizer, mesh, batch_specs,
                  n_labels=1, param_specs=None, donate=True,
                  steps_per_call=1, zero=0, grad_accum=1, remat=None,
-                 dp_axis="dp"):
+                 dp_axis="dp", precision="fp32", grad_compress=None):
         from ..optimizer import optimizer as opt_mod
         from ..gluon.block import resolve_remat_policy, _REMAT_OFF
         if isinstance(optimizer, str):
@@ -265,6 +302,36 @@ class ShardedTrainStep:
             raise MXNetError(f"zero must be 0, 1 or 2, got {zero}")
         if self.grad_accum < 1:
             raise MXNetError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.precision = str(precision)
+        if self.precision not in ("fp32", "fp8"):
+            raise MXNetError(
+                f"precision must be 'fp32' or 'fp8', got {precision!r}")
+        self._fp8 = self.precision == "fp8"
+        if grad_compress is None:
+            grad_compress = _config.get("comm.compress")
+        self._compress = str(grad_compress or "none").lower()
+        if self._compress not in ("none", "int8", "bf16"):
+            raise MXNetError(
+                "grad_compress must be 'none', 'int8' or 'bf16', got "
+                f"{grad_compress!r}")
+        if self._compress != "none":
+            others = {a: s for a, s in dict(mesh.shape).items()
+                      if a != dp_axis and int(s) > 1}
+            if others:
+                raise MXNetError(
+                    f"grad_compress='{self._compress}' needs a pure-dp "
+                    f"mesh (the compressed reduce runs in a shard_map "
+                    f"over '{dp_axis}' only); mesh also has {others}")
+            for s in self.batch_specs:
+                flat = []
+                for e in tuple(s):
+                    flat.extend(e if isinstance(e, tuple) else (e,))
+                if dp_axis not in flat:
+                    raise MXNetError(
+                        f"grad_compress='{self._compress}' requires every "
+                        f"batch arg sharded over '{dp_axis}'; got spec {s}")
+            if int(mesh.shape.get(dp_axis, 1)) <= 1:
+                self._compress = "none"   # nothing to reduce: plain path
         if remat is None and isinstance(getattr(block, "_flags", None), dict):
             remat = block._flags.get("remat")
         # kept as given so rebuild() can re-construct an equivalent step
@@ -400,6 +467,67 @@ class ShardedTrainStep:
                 if x is not None else None, s, is_leaf=lambda x: x is None)
         self.states = states
 
+        # -- fp8 delayed-scaling state (amax histories per eligible site) --
+        self._fp8_sites = []
+        self._fp8_margin = 1.0
+        fp8_state = {}
+        if self._fp8:
+            tshapes = {n: tuple(v.shape) for n, v in self.trainable.items()}
+            self._fp8_sites = _fp8.select_sites(tshapes)
+            if not self._fp8_sites:
+                raise MXNetError(
+                    "precision='fp8' found no eligible sites (2-D "
+                    "'*.weight' params with >= amp.fp8_min_elems "
+                    f"elements) among {sorted(tshapes)}")
+            self._fp8_margin = float(_config.get("amp.fp8_margin"))
+            fp8_state = {
+                site: {k: jax.device_put(v, sh(P())) for k, v in h.items()}
+                for site, h in _fp8.init_state(self._fp8_sites).items()}
+            # serve-side engines key quantization guards off this tag
+            # (it also rides save_states metadata for cold loads)
+            block._fp8_trained = True
+
+        # -- error-feedback compression buckets over the dp axis --
+        self._buckets = []
+        resid_state = {}
+        if self._compress != "none":
+            dp_n_c = int(mesh.shape[dp_axis])
+            bucket_elems = max(1, int(
+                float(_config.get("comm.bucket_mb")) * (1 << 20) / 4))
+            cur, cur_sz = [], 0
+            for n in sorted(self.trainable):
+                v = self.trainable[n]
+                size = int(v.size)
+                if cur and cur_sz + size > bucket_elems:
+                    self._buckets.append(cur)
+                    cur, cur_sz = [], 0
+                cur.append((n, tuple(v.shape), size))
+                cur_sz += size
+            if cur:
+                self._buckets.append(cur)
+            # residuals live as one (dp, bucket) row per rank so the EF
+            # error stays rank-local across steps (and across elastic
+            # resizes via the canonical sum in state_dict)
+            for i, members in enumerate(self._buckets):
+                bsz = sum(s for _, _, s in members)
+                resid_state[f"bucket{i}"] = jax.device_put(
+                    jnp.zeros((dp_n_c, bsz), jnp.float32), sh(P(dp_axis)))
+        self.extra = {"fp8": fp8_state, "resid": resid_state}
+
+        # dp wire bytes per UPDATE (for the axis="dp" counter): plain
+        # training reduces the full fp32 gradient once per update;
+        # compression reduces int8/bf16 payload + one fp32 scale per
+        # bucket PER MICROBATCH (EF must apply before accumulation)
+        if self._compress == "none":
+            self._dp_wire_bytes = sum(
+                int(v.size) * jnp.dtype(v.dtype).itemsize
+                for v in self.trainable.values())
+        else:
+            width = 1 if self._compress == "int8" else 2
+            payload = sum(sum(s for _, _, s in m) for m in self._buckets)
+            self._dp_wire_bytes = (
+                (payload * width + 4 * len(self._buckets)) * self.grad_accum)
+
         param_sh = {n: sh(param_specs.get(n, P())) for n in trainable}
         aux_sh = {n: sh(param_specs.get(n, P())) for n in aux}
         state_sh = {
@@ -448,14 +576,19 @@ class ShardedTrainStep:
                 self._pp_width = int(v.shape[-1])
                 break
 
-        def base_step(trainable, aux, states, rng, lr, t, *batch):
+        def base_step(trainable, aux, states, extra, rng, lr, t, *batch):
             inputs = batch[:len(batch) - self.n_labels]
             labels = batch[len(batch) - self.n_labels:]
-            (loss, mutated), grads = self._loss_and_grad(
-                trainable, aux, rng, inputs, labels)
+            scales = (_fp8.scales_from_state(extra["fp8"], self._fp8_margin)
+                      if self._fp8 else {})
+            loss, mutated, grads, fwd_amax, g_amax, resid = self._fwd_bwd(
+                trainable, aux, rng, inputs, labels, scales, extra["resid"])
+            new_fp8 = (_fp8.roll_state(extra["fp8"], fwd_amax, g_amax)
+                       if self._fp8 else extra["fp8"])
             new_tr, new_states = self._apply_updates(
                 trainable, grads, states, lr, t)
-            return new_tr, {**aux, **mutated}, new_states, loss
+            return (new_tr, {**aux, **mutated}, new_states,
+                    {"fp8": new_fp8, "resid": resid}, loss)
 
         spec_list = list(batch_specs)
         step = base_step
@@ -466,7 +599,7 @@ class ShardedTrainStep:
             zero2 = self._zero if self.zero >= 2 else {}
             zero2tp = self._zero_tp if self.zero >= 2 else {}
 
-            def step(trainable, aux, states, rng, lr, t, *batches):
+            def step(trainable, aux, states, extra, rng, lr, t, *batches):
                 # microbatches carry a leading K axis; ONE update at the end.
                 # At zero>=2 the accumulator holds flat dp shards — the
                 # long-lived gradient memory is 1/dp per device and each
@@ -483,14 +616,23 @@ class ShardedTrainStep:
                     return jnp.zeros(v.shape, v.dtype)
 
                 acc0 = {n: g_init(n, v) for n, v in trainable.items()}
+                # scales come from the PRE-update histories once for all
+                # microbatches; the history rolls ONCE per update with the
+                # max amax over the scan (delayed scaling's contract)
+                scales = (_fp8.scales_from_state(
+                    extra["fp8"], self._fp8_margin) if self._fp8 else {})
+                zf32 = jnp.zeros((), jnp.float32)
+                fwd0 = {s: (zf32, zf32) for s in self._fp8_sites}
+                g0 = {s: zf32 for s in self._fp8_sites}
 
                 def body(carry, xs):
-                    aux_c, acc, i = carry
+                    aux_c, acc, resid, fa, ga, i = carry
                     inputs = xs[:len(xs) - self.n_labels]
                     labels = xs[len(xs) - self.n_labels:]
-                    (loss, mutated), grads = self._loss_and_grad(
-                        trainable, aux_c, jax.random.fold_in(rng, i),
-                        inputs, labels)
+                    loss, mutated, grads, fwd_amax, g_amax, resid = (
+                        self._fwd_bwd(
+                            trainable, aux_c, jax.random.fold_in(rng, i),
+                            inputs, labels, scales, resid))
 
                     def add(n):
                         g = grads[n]
@@ -501,44 +643,56 @@ class ShardedTrainStep:
                         return acc[n] + g
 
                     acc = {n: add(n) for n in acc}
-                    return ({**aux_c, **mutated}, acc, i + 1), loss
+                    fa = _fp8.merge_amax(fa, fwd_amax)
+                    ga = _fp8.merge_amax(ga, g_amax)
+                    return ({**aux_c, **mutated}, acc, resid, fa, ga,
+                            i + 1), loss
 
-                (aux, acc, _), losses = lax.scan(
-                    body, (aux, acc0, 0), tuple(batches))
+                (aux, acc, resid, fa, ga, _), losses = lax.scan(
+                    body, (aux, acc0, extra["resid"], fwd0, g0, 0),
+                    tuple(batches))
                 grads = {n: a / K for n, a in acc.items()}
                 zflat = {n: grads.pop(n) for n in zero2} or None
+                new_fp8 = (_fp8.roll_state(extra["fp8"], fa, ga)
+                           if self._fp8 else extra["fp8"])
                 new_tr, new_states = self._apply_updates(
                     trainable, grads, states, lr, t, zero_flat_grads=zflat)
-                return new_tr, aux, new_states, jnp.mean(losses)
+                return (new_tr, aux, new_states,
+                        {"fp8": new_fp8, "resid": resid}, jnp.mean(losses))
 
             spec_list = [P(None, *s) for s in spec_list]
 
         if self.steps_per_call > 1:
             inner = step
 
-            def step(trainable, aux, states, rng, lr, t, *batches):
+            def step(trainable, aux, states, extra, rng, lr, t, *batches):
                 # batches carry a leading steps axis; one launch = K steps
                 # (implementation shared with the free function scan_steps)
-                def one(tr, ax, st, i, *xs):
-                    tr, ax, st, loss = inner(
-                        tr, ax, st, jax.random.fold_in(rng, i), lr, t + i,
-                        *xs)
-                    return tr, ax, st, i + 1, loss
+                def one(tr, ax, st, ex, i, *xs):
+                    tr, ax, st, ex, loss = inner(
+                        tr, ax, st, ex, jax.random.fold_in(rng, i), lr,
+                        t + i, *xs)
+                    return tr, ax, st, ex, i + 1, loss
 
-                out = scan_steps(one, n_state=4)(
-                    trainable, aux, states, 0, *batches)
-                return out[0], out[1], out[2], out[4]
+                out = scan_steps(one, n_state=5)(
+                    trainable, aux, states, extra, 0, *batches)
+                return out[0], out[1], out[2], out[3], out[5]
 
             spec_list = [P(None, *s) for s in spec_list]
 
         self.batch_shardings = tuple(sh(s) for s in spec_list)
 
-        donate_argnums = (0, 1, 2) if donate else ()
+        extra_sh = {
+            "fp8": {site: {k: sh(P()) for k in h}
+                    for site, h in self.extra["fp8"].items()},
+            "resid": {n: sh(P(dp_axis)) for n in self.extra["resid"]},
+        }
+        donate_argnums = (0, 1, 2, 3) if donate else ()
         self._step = jax.jit(
             step,
-            in_shardings=(param_sh, aux_sh, state_sh, sh(P()), sh(P()),
-                          sh(P())) + self.batch_shardings,
-            out_shardings=(param_sh, aux_sh, state_sh, sh(P())),
+            in_shardings=(param_sh, aux_sh, state_sh, extra_sh, sh(P()),
+                          sh(P()), sh(P())) + self.batch_shardings,
+            out_shardings=(param_sh, aux_sh, state_sh, extra_sh, sh(P())),
             donate_argnums=donate_argnums)
         self._n_step = 0
 
@@ -588,6 +742,129 @@ class ShardedTrainStep:
         if self._remat_on:
             lossf = jax.checkpoint(lossf, policy=self._remat_policy)
         return jax.value_and_grad(lossf, has_aux=True)(trainable)
+
+    def _fp8_loss_and_grad(self, trainable, aux, rng, inputs, labels,
+                           scales):
+        """fp8 forward/backward: the loss closure runs under the fp8
+        scope (Dense routes matching sites through amp.fp8.dense_fp8) and
+        differentiates w.r.t. BOTH the params and the per-site g_scales —
+        the g_scale "cotangents" are the measured gradient amaxes the
+        delayed-scaling history roll consumes (see amp/fp8.py)."""
+        gsc = {s: scales[s][2] for s in scales}
+
+        def lossf(tr, g):
+            sc = {s: (scales[s][0], scales[s][1], g[s]) for s in g}
+            with _fp8.scope(sc) as ctx:
+                out, mutated = functional.functional_call(
+                    self.block, self._expand_pp({**tr, **aux}), *inputs,
+                    train=True, rng_key=rng)
+                loss = self.loss_fn(out, *labels)
+                amax = dict(ctx.amax)
+            return loss, (self._collapse_pp(mutated), amax)
+
+        if self._remat_on:
+            lossf = jax.checkpoint(lossf, policy=self._remat_policy)
+        (loss, (mutated, fwd_amax)), (grads, g_amax) = jax.value_and_grad(
+            lossf, argnums=(0, 1), has_aux=True)(trainable, gsc)
+        # fixed pytree structure for scan carries: sites the forward never
+        # reached this trace report amax 0 (roll_state treats 0 as "no
+        # observation growth")
+        zf32 = jnp.zeros((), jnp.float32)
+        fwd_amax = {s: fwd_amax.get(s, (zf32, zf32)) for s in gsc}
+        return loss, mutated, grads, fwd_amax, g_amax
+
+    def _fwd_bwd(self, trainable, aux, rng, inputs, labels, scales, resid):
+        """One microbatch forward+backward; returns
+        ``(loss, mutated, grads, fwd_amax, g_amax, new_resid)`` with the
+        amax dicts empty unless fp8 and ``new_resid`` passed through
+        unchanged unless compression is on."""
+        if self._compress != "none":
+            return self._compressed_fwd_bwd(
+                trainable, aux, rng, inputs, labels, scales, resid)
+        if self._fp8:
+            loss, mutated, grads, fwd_amax, g_amax = (
+                self._fp8_loss_and_grad(
+                    trainable, aux, rng, inputs, labels, scales))
+            return loss, mutated, grads, fwd_amax, g_amax, resid
+        (loss, mutated), grads = self._loss_and_grad(
+            trainable, aux, rng, inputs, labels)
+        return loss, mutated, grads, {}, {}, resid
+
+    def _compressed_fwd_bwd(self, trainable, aux, rng, inputs, labels,
+                            scales, resid):
+        """Error-feedback compressed dp gradient reduction.
+
+        A shard_map over the dp axis makes the per-rank gradient explicit
+        (outside shard_map the dp reduction is implicit in XLA's psum of
+        the batch-sharded backward): each rank runs loss+grad on its
+        local microbatch shard, flattens grads into the configured
+        buckets, adds its carried residual, quantizes against a SHARED
+        scale (pmax over ranks — so dequantization is exact w.r.t. what
+        was sent) and psums the int8/bf16 payload.  The residual
+        ``c - dequant(sent)`` carries to the next microbatch (EF-SGD),
+        so the quantization error telescopes instead of biasing the
+        trajectory.  Each bucket's psum is an independent collective —
+        exactly the granularity XLA's latency-hiding scheduler overlaps
+        with the remaining backward compute.
+        """
+        from .._jax_compat import shard_map
+        dpx = self.dp_axis
+        dp_n = int(self.mesh.shape[dpx])
+        mode = self._compress
+        buckets = self._buckets
+        n_in = len(inputs)
+
+        def local(tr, ax, rngv, res, sc, *batch):
+            ins = batch[:n_in]
+            labs = batch[n_in:]
+            # decorrelate dropout across ranks: outside shard_map the
+            # same key spans the global batch, so fold in the rank
+            rngl = jax.random.fold_in(rngv, jax.lax.axis_index(dpx))
+            if self._fp8:
+                loss, mutated, grads, fwd_amax, g_amax = (
+                    self._fp8_loss_and_grad(tr, ax, rngl, ins, labs, sc))
+            else:
+                (loss, mutated), grads = self._loss_and_grad(
+                    tr, ax, rngl, ins, labs)
+                fwd_amax, g_amax = {}, {}
+            pmean = functools.partial(jax.lax.pmean, axis_name=dpx)
+            pmax = functools.partial(jax.lax.pmax, axis_name=dpx)
+            loss = pmean(loss)
+            mutated = jax.tree_util.tree_map(pmean, mutated)
+            fwd_amax = jax.tree_util.tree_map(pmax, fwd_amax)
+            g_amax = jax.tree_util.tree_map(pmax, g_amax)
+            new_res, out_g = {}, {}
+            for i, members in enumerate(buckets):
+                flat = jnp.concatenate([
+                    jnp.ravel(grads[n]).astype(jnp.float32)
+                    for n, _, _ in members])
+                c = flat + res[f"bucket{i}"][0]
+                if mode == "int8":
+                    s = pmax(jnp.max(jnp.abs(c))) / 127.0
+                    s = jnp.where(s > 0.0, s, jnp.float32(1.0))
+                    q = jnp.clip(jnp.round(c / s), -127.0, 127.0)
+                    # int8 payload on the wire; the f32 psum of integer
+                    # values is exact below 2^24, so dequant-after-reduce
+                    # equals the mean of per-rank dequants bitwise
+                    sent = q * s
+                    red = jax.lax.psum(q, dpx) * s / dp_n
+                else:   # bf16: value-snap through bf16, reduce in f32
+                    sent = c.astype(jnp.bfloat16).astype(jnp.float32)
+                    red = jax.lax.psum(sent, dpx) / dp_n
+                new_res[f"bucket{i}"] = (c - sent)[None]
+                off = 0
+                for n, shape, size in members:
+                    out_g[n] = red[off:off + size].reshape(shape).astype(
+                        grads[n].dtype)
+                    off += size
+            return loss, mutated, out_g, fwd_amax, g_amax, new_res
+
+        fn = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(dpx), P()) + tuple(self.batch_specs),
+            out_specs=(P(), P(), P(), P(), P(), P(dpx)),
+            check_vma=False)
+        return fn(trainable, aux, rng, resid, scales, *inputs, *labels)
 
     def _flat_pad(self, n, v):
         _, size, padded = self._zero[n]
@@ -714,8 +991,8 @@ class ShardedTrainStep:
             # detector and compile counters stay untouched
             self._insight_done = True
             label = getattr(self, "_insight_label", "parallel.train_step")
-            cap = (self.trainable, self.aux, self.states, rng, lr, t,
-                   *raws)
+            cap = (self.trainable, self.aux, self.states, self.extra, rng,
+                   lr, t, *raws)
             if self._act_rules:
                 with activation_sharding(self.mesh, **self._act_rules):
                     _insight.capture_jit(label, self._step, cap,
@@ -728,12 +1005,13 @@ class ShardedTrainStep:
             # them while jit traces (first call) — no-op afterwards
             with activation_sharding(self.mesh, **self._act_rules):
                 out = self._step(
-                    self.trainable, self.aux, self.states, rng, lr, t,
-                    *raws)
+                    self.trainable, self.aux, self.states, self.extra,
+                    rng, lr, t, *raws)
         else:
             out = self._step(
-                self.trainable, self.aux, self.states, rng, lr, t, *raws)
-        self.trainable, self.aux, self.states, loss = out
+                self.trainable, self.aux, self.states, self.extra, rng,
+                lr, t, *raws)
+        self.trainable, self.aux, self.states, self.extra, loss = out
         self._n_step += self.steps_per_call
         if (self._zero or self._zero_tp) and _telemetry.active():
             rs_per_update = self.grad_accum if self.zero >= 2 else 1
@@ -742,6 +1020,11 @@ class ShardedTrainStep:
                            zb * self.steps_per_call * rs_per_update)
             _telemetry.inc("zero.all_gather_bytes_total",
                            zb * self.steps_per_call)
+            _telemetry.inc("zero.collective_bytes_total",
+                           zb * self.steps_per_call * rs_per_update,
+                           op="reduce_scatter")
+            _telemetry.inc("zero.collective_bytes_total",
+                           zb * self.steps_per_call, op="all_gather")
         if _telemetry.active():
             # analytic per-axis mesh traffic (logical estimates, same
             # spirit as the zero.* counters) for the bench mesh rows
@@ -749,16 +1032,30 @@ class ShardedTrainStep:
             if shape.get(self.dp_axis, 1) > 1:
                 _telemetry.inc("mesh.dp_gradient_bytes_total",
                                self._trainable_bytes * self.steps_per_call)
+                wire = self._dp_wire_bytes * self.steps_per_call
+                _telemetry.inc("mesh.collective_bytes_total", wire,
+                               axis="dp")
+                if self._compress != "none":
+                    _telemetry.inc("comm.compressed_bytes_total", wire)
+                    _telemetry.inc(
+                        "comm.uncompressed_bytes_total",
+                        self._trainable_bytes * self.grad_accum
+                        * self.steps_per_call)
             tokens = int(raws[0].size) if raws else 0
             if self._tp_row_out_units and tokens:
                 act = sum(L * u for L, u in self._tp_row_out_units)
                 _telemetry.inc("mesh.tp_allreduce_bytes_total",
                                tokens * act * 4)
+                _telemetry.inc("mesh.collective_bytes_total",
+                               tokens * act * 4, axis="tp")
             pp_n = shape.get("pp", 1)
             if pp_n > 1 and self._pp_width and tokens:
+                pp_bytes = (tokens * self._pp_width * 4
+                            * (pp_n - 1) * 2)
                 _telemetry.inc("mesh.pp_stage_transfer_bytes_total",
-                               tokens * self._pp_width * 4
-                               * (pp_n - 1) * 2)
+                               pp_bytes)
+                _telemetry.inc("mesh.collective_bytes_total", pp_bytes,
+                               axis="pp")
         if _insight._active:
             # steady-state loop time from call inter-arrival: measured
             # on wall clocks the caller already pays, no device sync
@@ -824,13 +1121,17 @@ class ShardedTrainStep:
                 *[len(s) if s is not None else 2 for s in self.batch_specs])
             param_specs = None
             dp_axis = "dp"
+        precision = cfg.get("precision", "fp32")
         tuned = ShardedTrainStep(
             self.block, self.loss_fn, self.fopt.opt, mesh,
             batch_specs, n_labels=self.n_labels,
             param_specs=param_specs,
             steps_per_call=cfg["steps_per_call"], zero=cfg["zero"],
             grad_accum=cfg["grad_accum"], remat=cfg["remat"],
-            dp_axis=dp_axis)
+            dp_axis=dp_axis,
+            precision=precision if precision in ("fp32", "fp8")
+            else self.precision,
+            grad_compress=self._compress)
         tuned._n_step = self._n_step
         return tuned, result
 
@@ -880,7 +1181,8 @@ class ShardedTrainStep:
             batch_specs, n_labels=self.n_labels, param_specs=None,
             donate=self._donate, steps_per_call=self.steps_per_call,
             zero=self.zero, grad_accum=self.grad_accum,
-            remat=self._remat_arg, dp_axis="dp")
+            remat=self._remat_arg, dp_axis="dp",
+            precision=self.precision, grad_compress=self._compress)
         rebuilt._n_step = self._n_step
         return rebuilt
 
@@ -917,6 +1219,16 @@ class ShardedTrainStep:
                         arrays[f"state/{member}/{i}"] = a[j]
                 else:
                     arrays[f"state/{n}/{i}"] = a
+        for site, hist in self.extra["fp8"].items():
+            for k, v in hist.items():
+                arrays[f"fp8/{site}/{k}"] = onp.asarray(v)
+        for bname, v in self.extra["resid"].items():
+            # canonical EF residual = the SUM over dp ranks: what the sum
+            # of rank-local errors still owes the trajectory.  Restoring
+            # it into one rank (load_state_dict) preserves the total
+            # exactly at any dp size — f32 x + 0.0 is bitwise x.
+            a = onp.asarray(v)
+            arrays[f"efresid/{bname}"] = a.sum(axis=0, dtype=a.dtype)
         return {"arrays": arrays, "n_step": int(self._n_step)}
 
     def load_state_dict(self, bundle):
@@ -967,6 +1279,40 @@ class ShardedTrainStep:
                 else:
                     new.append(jax.device_put(a, sh(n)))
             self.states[n] = jax.tree_util.tree_unflatten(treedef, new)
+        # fp8 amax histories: replicated scalars, read back directly.
+        # Tolerate missing keys (resuming a pre-fp8 bundle into an fp8
+        # step keeps the fresh zero history) and a changed history length
+        # (clip newest-first / zero-pad oldest).
+        fp8_new = {}
+        for site, hist in self.extra["fp8"].items():
+            fp8_new[site] = {}
+            for k, v in hist.items():
+                key = f"fp8/{site}/{k}"
+                if key not in arrays:
+                    fp8_new[site][k] = v
+                    continue
+                a = onp.asarray(arrays[key]).astype(onp.float32)
+                h = int(v.shape[0])
+                if a.shape[0] >= h:
+                    a = a[:h]
+                else:
+                    a = onp.pad(a, (0, h - a.shape[0]))
+                fp8_new[site][k] = jax.device_put(
+                    a, NamedSharding(self.mesh, P()))
+        resid_new = {}
+        for bname, v in self.extra["resid"].items():
+            key = f"efresid/{bname}"
+            if key not in arrays:
+                resid_new[bname] = v
+                continue
+            # canonical sum restores into rank 0; other ranks start with
+            # zero error debt (bucket layout depends only on param names
+            # and comm.bucket_mb, so it is dp-size invariant)
+            a = onp.zeros(v.shape, onp.float32)
+            a[0] = onp.asarray(arrays[key])
+            resid_new[bname] = jax.device_put(
+                a, NamedSharding(self.mesh, P(self.dp_axis)))
+        self.extra = {"fp8": fp8_new, "resid": resid_new}
         self._n_step = int(bundle["n_step"])
         # keep lr schedules / bias correction on the restored timeline
         self.fopt.opt.num_update = self._n_step
@@ -982,7 +1328,9 @@ class ShardedTrainStep:
         bundle = self.state_dict()
         return serialization.save_safetensors(
             fname, bundle["arrays"],
-            metadata={"n_step": bundle["n_step"], "zero": self.zero})
+            metadata={"n_step": bundle["n_step"], "zero": self.zero,
+                      "precision": self.precision,
+                      "grad_compress": self._compress})
 
     def load_states(self, fname):
         """Resume from save_states: values re-sharded per param_specs
@@ -990,5 +1338,9 @@ class ShardedTrainStep:
         from .. import serialization
         loaded, meta = serialization.load_safetensors(
             fname, return_metadata=True)
+        if str(meta.get("precision", "")) == "fp8":
+            # tag survives cold loads so serve engines can apply their
+            # quantization interaction guard (serve/engine.py)
+            self.block._fp8_trained = True
         self.load_state_dict(
             {"arrays": loaded, "n_step": int(meta.get("n_step", 0))})

@@ -373,6 +373,22 @@ class ServeEngine:
         params = _functional.param_arrays(model)
         self.quantize, weight_mode, kv_int8 = _parse_quantize(quantize)
         self._weight_mode = weight_mode
+        if (weight_mode == "int4_weights"
+                and getattr(model, "_fp8_trained", False)
+                and not _config.get("serve.allow_fp8_requant")):
+            # fp8-trained weights already carry ~2 mantissa bits of
+            # quantization noise at every matmul site; stacking group-wise
+            # int4 on top compounds it past the accuracy contract int4
+            # was validated under.  int8_weights / int8_kv compose fine
+            # (int8's grid is strictly finer than e4m3's).
+            raise MXNetError(
+                "quantize='int4_weights' on an fp8-trained checkpoint "
+                "(model._fp8_trained is set): compounding int4 weight "
+                "quantization on fp8 training noise is refused by "
+                "default. Serve with 'int8_weights'/'int8_kv' (which "
+                "compose with fp8 training), or set "
+                "mx.config.set('serve.allow_fp8_requant', True) to "
+                "override after validating accuracy.")
         if kv_int8:
             cache_dtype = "int8"
         pt, qt, qdt = self._quantize_weights(params)

@@ -199,6 +199,10 @@ declare_metric("autotune.trials_total", "counter",
 declare_metric("autotune.trials_oom_total", "counter",
                "autotune trials that died of device OOM (recorded, "
                "search continues)")
+declare_metric("autotune.trials_parity_total", "counter",
+               "fp8 autotune trials rejected by the loss-parity probe "
+               "(relative delta vs the fp32 reference beyond "
+               "autotune.fp8_parity_tol; search continues)")
 declare_metric("autotune.search_seconds", "histogram",
                "wall time of one full autotune search",
                buckets=TIME_BUCKETS)
